@@ -1,0 +1,43 @@
+"""fm_returnprediction_tpu — a TPU-native Fama-MacBeth return-prediction framework.
+
+A brand-new JAX/XLA implementation of the capabilities of the reference
+empirical asset-pricing pipeline (``BaileyMeche/FM-ReturnPrediction``): it
+replicates Lewellen (2015), "The Cross-Section of Expected Stock Returns" —
+Table 1 (summary statistics), Table 2 (Fama-MacBeth regressions with
+Newey-West t-stats) and Figure 1 (10-year rolling slopes) — from CRSP and
+Compustat data.
+
+Architecture (TPU-first, not a translation of the reference):
+
+- ``settings``   — L0 config: ``.env``-backed key/value config with a
+                   ``BACKEND={cpu,tpu}`` switch (reference: ``src/settings.py``).
+- ``utils``      — cache substrate (parquet/csv/zip, reference-compatible file
+                   names), figure saving, stage timing (reference: ``src/utils.py``).
+- ``ops``        — the compute core, pure JAX: masked batched cross-sectional
+                   OLS under ``vmap``, Newey-West / Fama-MacBeth reductions,
+                   masked rolling-window primitives via ``lax.reduce_window``,
+                   per-month winsorization and masked quantiles
+                   (reference: ``src/regressions.py``, rolling kernels in
+                   ``src/calc_Lewellen_2014.py``).
+- ``panel``      — host-side relational transforms (pandas) and the ragged→
+                   dense ``(T, N, K)`` device panel with validity masks
+                   (reference: ``src/transform_crsp.py``,
+                   ``src/transform_compustat.py``).
+- ``models``     — the Lewellen model zoo (Models 1-3), expected-return
+                   projections and decile portfolio sorts.
+- ``data``       — WRDS acquisition (same SQL/universe filters as the
+                   reference, defects fixed) and a deterministic synthetic
+                   fake-WRDS backend for hermetic runs
+                   (reference: ``src/pull_crsp.py``, ``src/pull_compustat.py``).
+- ``parallel``   — the one place mesh topology lives: ``jax.sharding.Mesh``
+                   construction, sharding rules, ``shard_map`` bootstrap.
+- ``reporting``  — Table 1/2 builders, Figure 1, LaTeX report generation
+                   (reference: ``src/calc_Lewellen_2014.py:577-1231``).
+- ``taskgraph``  — a file-dependency DAG runner standing in for ``doit``
+                   (reference: ``dodo.py``).
+
+Everything under ``ops``/``models``/``parallel`` is jit-friendly: static
+shapes, masks instead of ragged data, ``lax`` control flow only.
+"""
+
+__version__ = "0.1.0"

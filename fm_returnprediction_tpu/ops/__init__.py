@@ -1,0 +1,38 @@
+"""Pure-JAX compute core: batched OLS, FM/NW reductions, rolling windows,
+compaction, masked quantiles. Everything here is jit-friendly (static shapes,
+masks, ``lax`` control flow) and dtype-polymorphic (f64 for CPU parity runs,
+f32 for TPU)."""
+
+from fm_returnprediction_tpu.ops.compaction import (
+    Compaction,
+    compact,
+    lag,
+    make_compaction,
+    scatter_back,
+)
+from fm_returnprediction_tpu.ops.fama_macbeth import (
+    FamaMacbethSummary,
+    fama_macbeth,
+    fama_macbeth_summary,
+)
+from fm_returnprediction_tpu.ops.newey_west import compact_front, nw_mean_se
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult, monthly_cs_ols, row_validity
+from fm_returnprediction_tpu.ops.quantiles import masked_quantile, winsorize_cs
+from fm_returnprediction_tpu.ops.rolling import (
+    rolling_mean,
+    rolling_prod,
+    rolling_std,
+    rolling_sum,
+    windowed_count,
+    windowed_sum,
+)
+
+__all__ = [
+    "Compaction", "compact", "lag", "make_compaction", "scatter_back",
+    "FamaMacbethSummary", "fama_macbeth", "fama_macbeth_summary",
+    "compact_front", "nw_mean_se",
+    "CSRegressionResult", "monthly_cs_ols", "row_validity",
+    "masked_quantile", "winsorize_cs",
+    "rolling_mean", "rolling_prod", "rolling_std", "rolling_sum",
+    "windowed_count", "windowed_sum",
+]

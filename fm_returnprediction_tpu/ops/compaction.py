@@ -1,0 +1,65 @@
+"""Per-firm time compaction — pandas row semantics on dense arrays.
+
+The reference computes every lag and rolling window with pandas
+``groupby("permno").shift/rolling`` on row-sorted long frames
+(``src/calc_Lewellen_2014.py:137-341``). Those are ROW operations: a firm
+with a month gap sees its previous *row*, which may be several calendar
+months earlier (SURVEY §7 hard part (b)). On the dense ``(T, N)`` panel the
+equivalent is: stably compact each firm's observed rows to the front of the
+time axis, run the window op on the compacted axis, and scatter results back
+to the original slots. All steps are gather/scatter-free ``argsort`` +
+``take_along_axis`` — static shapes, jit- and shard-friendly (firms are
+independent, so the N axis shards cleanly).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+__all__ = ["Compaction", "make_compaction", "compact", "scatter_back", "lag"]
+
+
+class Compaction(NamedTuple):
+    """Reusable per-firm compaction plan for one (T, N) mask."""
+
+    order: jnp.ndarray      # (T, N) row permutation putting valid rows first
+    inv_order: jnp.ndarray  # (T, N) inverse permutation
+    count: jnp.ndarray      # (N,) valid rows per firm
+    valid: jnp.ndarray      # (T, N) bool: compacted slot j < count[n]
+    mask: jnp.ndarray       # (T, N) original mask
+
+
+def make_compaction(mask: jnp.ndarray) -> Compaction:
+    """Build the compaction plan for a (T, N) validity mask. ``stable=True``
+    preserves chronological order within each firm, matching the reference's
+    ``sort_values(["permno", "mthcaldt"])`` row order."""
+    order = jnp.argsort(~mask, axis=0, stable=True)
+    inv_order = jnp.argsort(order, axis=0, stable=True)
+    count = mask.sum(axis=0)
+    valid = jnp.arange(mask.shape[0])[:, None] < count[None, :]
+    return Compaction(order, inv_order, count, valid, mask)
+
+
+def compact(values: jnp.ndarray, plan: Compaction) -> jnp.ndarray:
+    """Gather a (T, N) variable into compacted row order (invalid tail slots
+    hold whatever the masked-out rows held; gate on ``plan.valid``)."""
+    return jnp.take_along_axis(values, plan.order, axis=0)
+
+
+def scatter_back(comp_values: jnp.ndarray, plan: Compaction, fill=jnp.nan) -> jnp.ndarray:
+    """Inverse of :func:`compact`: place compacted-row results back at their
+    original calendar slots; absent rows get ``fill``."""
+    out = jnp.take_along_axis(comp_values, plan.inv_order, axis=0)
+    return jnp.where(plan.mask, out, fill)
+
+
+def lag(comp_values: jnp.ndarray, k: int, fill=jnp.nan) -> jnp.ndarray:
+    """Row-shift by ``k`` on the compacted axis — the dense equivalent of
+    ``groupby("permno")[col].shift(k)`` (e.g. ``src/calc_Lewellen_2014.py:144``).
+    The first ``k`` compacted slots of each firm become ``fill``."""
+    if k == 0:
+        return comp_values
+    pad = jnp.full((k,) + comp_values.shape[1:], fill, dtype=comp_values.dtype)
+    return jnp.concatenate([pad, comp_values[:-k]], axis=0)

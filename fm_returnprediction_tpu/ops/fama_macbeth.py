@@ -1,0 +1,85 @@
+"""Fama-MacBeth aggregation of monthly cross-sectional regressions.
+
+Batched re-provision of the reference's ``fama_macbeth_summary``
+(``src/regressions.py:102-130``):
+
+- mean slope per predictor over the months whose regression ran AND whose
+  slope is finite (the reference's per-column ``.dropna()``);
+- predictors with fewer than ``min_months`` valid months report NaN
+  coefficient and t-stat (``src/regressions.py:114-117``);
+- t-stat = mean / NW-SE with the reference's ``1 - k/n`` Bartlett weight by
+  default (see ``ops.newey_west``);
+- mean R² and mean N over all months that ran (``src/regressions.py:128-129``).
+
+Combined with ``ops.ols.monthly_cs_ols`` this is the whole hot path of
+Table 2 (call stack SURVEY §3.4) in two fused device computations.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult, monthly_cs_ols
+
+__all__ = ["FamaMacbethSummary", "fama_macbeth_summary", "fama_macbeth"]
+
+
+class FamaMacbethSummary(NamedTuple):
+    coef: jnp.ndarray     # (P,) mean slope per predictor
+    tstat: jnp.ndarray    # (P,) mean / NW-SE
+    nw_se: jnp.ndarray    # (P,) NW standard error of the mean slope
+    mean_r2: jnp.ndarray  # () mean cross-sectional R² over run months
+    mean_n: jnp.ndarray   # () mean per-month N over run months
+    n_months: jnp.ndarray # () number of months that ran
+
+
+def fama_macbeth_summary(
+    cs: CSRegressionResult,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> FamaMacbethSummary:
+    """Aggregate a batched cross-sectional regression result."""
+    month_valid = cs.month_valid
+    mf = month_valid.astype(cs.slopes.dtype)
+    n_months = month_valid.sum()
+
+    # Per-predictor validity: month ran and slope is finite.
+    slope_valid = month_valid[:, None] & jnp.isfinite(cs.slopes)     # (T, P)
+    count = slope_valid.sum(axis=0)                                   # (P,)
+    slopes_z = jnp.where(slope_valid, cs.slopes, 0.0)
+    mean_slope = slopes_z.sum(axis=0) / jnp.maximum(count, 1).astype(cs.slopes.dtype)
+
+    se = jax.vmap(
+        lambda s, v: nw_mean_se(s, v, lags=nw_lags, weight=weight),
+        in_axes=(1, 1),
+    )(cs.slopes, slope_valid)                                          # (P,)
+
+    enough = count >= min_months
+    coef = jnp.where(enough, mean_slope, jnp.nan)
+    tstat = jnp.where(enough, mean_slope / se, jnp.nan)
+
+    denom = jnp.maximum(n_months, 1).astype(cs.r2.dtype)
+    mean_r2 = jnp.sum(cs.r2 * mf) / denom
+    mean_n = jnp.sum(cs.n_obs.astype(cs.r2.dtype) * mf) / denom
+
+    return FamaMacbethSummary(coef, tstat, se, mean_r2, mean_n, n_months)
+
+
+def fama_macbeth(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> tuple[CSRegressionResult, FamaMacbethSummary]:
+    """End-to-end FM: batched monthly OLS + aggregation, one jittable call."""
+    cs = monthly_cs_ols(y, x, mask)
+    return cs, fama_macbeth_summary(
+        cs, nw_lags=nw_lags, min_months=min_months, weight=weight
+    )

@@ -1,0 +1,71 @@
+"""Newey-West standard error of a time-series mean.
+
+Vectorized re-provision of the reference's ``newey_west_mean_se``
+(``src/regressions.py:78-100``), including its NON-textbook Bartlett weight:
+the reference uses ``w_k = 1 - k/T`` where ``T`` is the number of valid
+months in the series — not the conventional ``1 - k/(L+1)``. With T≈600 the
+weights are ≈1 (nearly unweighted autocovariances up to lag 4). Parity to the
+reference requires this exact formula (SURVEY §2.2.9), so it is the default;
+the textbook kernel is available behind ``weight="textbook"``.
+
+Validity handling: the reference computes NW on ``.dropna()``'d slope
+series — autocovariance lag k pairs ADJACENT SURVIVING months, not calendar
+neighbors (``fama_macbeth_summary``, ``src/regressions.py:113``). The masked
+version therefore compacts valid entries to the front (stable chronological
+order) before forming lagged products, which reproduces that semantics
+exactly under static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["nw_mean_se", "compact_front"]
+
+
+def compact_front(x: jnp.ndarray, valid: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable-partition ``x`` so valid entries come first in original order.
+
+    Returns (compacted values with invalid tail zeroed, count of valid).
+    """
+    order = jnp.argsort(~valid, stable=True)
+    n = valid.sum()
+    xc = jnp.where(jnp.arange(x.shape[0]) < n, x[order], 0.0)
+    return xc, n
+
+
+def nw_mean_se(
+    x: jnp.ndarray,
+    valid: jnp.ndarray,
+    lags: int = 4,
+    weight: str = "reference",
+) -> jnp.ndarray:
+    """NW standard error for the mean of the valid entries of ``x``.
+
+    ``var(mean) = (γ₀ + 2 Σ_{k=1..L} w_k γ_k) / n²`` with
+    ``γ_k = Σ_i u_i u_{i-k}`` over demeaned compacted values, and
+    ``w_k = max(1 - k/n, 0)`` (reference) or ``1 - k/(L+1)`` (textbook).
+    Series with fewer than 2 valid entries return NaN
+    (``src/regressions.py:84-85``).
+    """
+    xc, n = compact_front(x, valid)
+    nf = n.astype(xc.dtype)
+    in_range = jnp.arange(xc.shape[0]) < n
+
+    mean = jnp.where(n > 0, xc.sum() / jnp.maximum(nf, 1.0), 0.0)
+    u = jnp.where(in_range, xc - mean, 0.0)
+
+    gamma0 = jnp.dot(u, u)
+    acc = jnp.zeros((), dtype=xc.dtype)
+    for k in range(1, lags + 1):
+        gamma_k = jnp.dot(u[k:], u[:-k]) if k < u.shape[0] else jnp.zeros((), xc.dtype)
+        if weight == "reference":
+            w = jnp.maximum(1.0 - k / jnp.maximum(nf, 1.0), 0.0)
+        elif weight == "textbook":
+            w = jnp.asarray(1.0 - k / (lags + 1.0), dtype=xc.dtype)
+        else:
+            raise ValueError(f"Unknown NW weight scheme: {weight}")
+        acc = acc + w * gamma_k
+
+    var_mean = (gamma0 + 2.0 * acc) / jnp.maximum(nf, 1.0) ** 2
+    return jnp.where(n >= 2, jnp.sqrt(var_mean), jnp.nan)

@@ -1,0 +1,75 @@
+"""Masked cross-sectional quantiles and winsorization.
+
+Two consumers in the pipeline (SURVEY §7 hard part (a): quantiles over
+masked data are the subtle one):
+
+- NYSE size breakpoints: monthly 20th/50th percentiles of NYSE market equity
+  (pandas ``.quantile``, linear interpolation — ``src/calc_Lewellen_2014.py:74-82``);
+- per-month winsorization at [1%, 99%] per variable, skipping months with
+  fewer than 5 valid observations (``np.percentile``, also linear —
+  ``src/calc_Lewellen_2014.py:505-529``).
+
+Both reduce to one masked-quantile primitive: sort each month's cross-section
+with invalid entries pushed to +inf, then linearly interpolate at rank
+``q · (n_valid − 1)``. Sorting is per-month along the firm axis — a batched
+``sort`` XLA handles natively on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["masked_quantile", "winsorize_cs"]
+
+
+def masked_quantile(values: jnp.ndarray, valid: jnp.ndarray, q) -> jnp.ndarray:
+    """Linear-interpolated quantile(s) of the valid entries of each row.
+
+    Parameters
+    ----------
+    values : (T, N) — quantiles are taken along the last axis.
+    valid : (T, N) bool.
+    q : scalar or (Q,) quantiles in [0, 1].
+
+    Returns (T,) for scalar q, else (T, Q); rows with no valid entries give
+    NaN. Matches ``np.percentile``/``pd.Series.quantile`` 'linear'
+    interpolation exactly.
+    """
+    q_arr = jnp.atleast_1d(jnp.asarray(q, dtype=values.dtype))
+    big = jnp.asarray(jnp.inf, dtype=values.dtype)
+    data = jnp.where(valid & jnp.isfinite(values), values, big)
+    data = jnp.sort(data, axis=-1)                          # (T, N)
+    n = (valid & jnp.isfinite(values)).sum(axis=-1)         # (T,)
+
+    rank = q_arr[None, :] * jnp.maximum(n - 1, 0)[:, None].astype(values.dtype)
+    lo = jnp.floor(rank).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, jnp.maximum(n - 1, 0)[:, None].astype(jnp.int32))
+    frac = rank - lo.astype(values.dtype)
+
+    take = lambda idx: jnp.take_along_axis(data, idx, axis=-1)
+    out = take(lo) * (1.0 - frac) + take(hi) * frac          # (T, Q)
+    out = jnp.where((n > 0)[:, None], out, jnp.nan)
+    return out[:, 0] if jnp.ndim(q) == 0 else out
+
+
+def winsorize_cs(
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+    lower_percentile: float = 1.0,
+    upper_percentile: float = 99.0,
+    min_obs: int = 5,
+) -> jnp.ndarray:
+    """Per-month cross-sectional clip at the given percentiles.
+
+    Months with fewer than ``min_obs`` valid observations pass through
+    unclipped (``src/calc_Lewellen_2014.py:520-521``). NaN entries stay NaN
+    (clip of NaN is NaN, as in pandas ``.clip``).
+    """
+    qs = masked_quantile(
+        values, valid, jnp.asarray([lower_percentile / 100.0, upper_percentile / 100.0])
+    )                                                        # (T, 2)
+    low, high = qs[:, 0][:, None], qs[:, 1][:, None]
+    n = (valid & jnp.isfinite(values)).sum(axis=-1)
+    clipped = jnp.clip(values, low, high)
+    apply = (n >= min_obs)[:, None]
+    return jnp.where(apply, clipped, values)

@@ -1,0 +1,107 @@
+"""Masked trailing-window reductions along the time axis.
+
+The reference's rolling-window kernel family (SURVEY §2.1 ★ rows):
+
+- 11-month product of gross returns (momentum, ``calc_return_12_2``,
+  ``src/calc_Lewellen_2014.py:180-186``);
+- 24-month sum of log returns (``calc_log_return_13_36``, ``:302-307``);
+- 12-month dividend sum with ``min_periods=1`` (``calc_dy``, ``:274-279``);
+- 252-day std with ``min_periods=100`` (``calc_std_12``, ``:448-453``);
+- 120-month slope mean with ``min_periods=60`` (Figure 1, ``:926``).
+
+All are pandas ``rolling(window, min_periods)`` trailing windows: the window
+covers the trailing ``window`` ROWS (truncated at the series start), NaN
+entries occupy window positions but are excluded from the reduction, and the
+result is NaN until ``min_periods`` non-NaN entries are present.
+
+TPU design: windowed sums are O(T) cumulative-sum differences (one scan per
+reduction, HBM-friendly); the windowed product uses ``lax.reduce_window``
+with a multiply reducer (window ≤ 36 in this pipeline, so the O(T·w) cost is
+trivial and exact — no log/exp detour that would break sign/zero handling).
+Everything operates on axis 0 of (T, N) arrays with firms independent along
+N, so the firm axis shards with no communication.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "windowed_sum",
+    "windowed_count",
+    "rolling_sum",
+    "rolling_mean",
+    "rolling_std",
+    "rolling_prod",
+]
+
+
+def windowed_sum(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Exact trailing-window sum (window truncated at the start) of a
+    NaN-free array via cumulative-sum difference."""
+    cs = jnp.cumsum(x, axis=0)
+    shifted = jnp.concatenate(
+        [jnp.zeros((window,) + x.shape[1:], dtype=cs.dtype), cs[:-window]], axis=0
+    )[: x.shape[0]]
+    return cs - shifted
+
+
+def windowed_count(finite: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing-window count of True entries."""
+    return windowed_sum(finite.astype(jnp.int32), window)
+
+
+def _gate(value: jnp.ndarray, count: jnp.ndarray, min_periods: int) -> jnp.ndarray:
+    return jnp.where(count >= min_periods, value, jnp.nan)
+
+
+def rolling_sum(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).sum()`` on axis 0."""
+    finite = jnp.isfinite(x)
+    total = windowed_sum(jnp.where(finite, x, 0.0), window)
+    return _gate(total, windowed_count(finite, window), min_periods)
+
+
+def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).mean()`` on axis 0."""
+    finite = jnp.isfinite(x)
+    total = windowed_sum(jnp.where(finite, x, 0.0), window)
+    count = windowed_count(finite, window)
+    mean = total / jnp.maximum(count, 1).astype(total.dtype)
+    return _gate(mean, count, min_periods)
+
+
+def rolling_std(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).std()`` (ddof=1) on axis 0."""
+    finite = jnp.isfinite(x)
+    xz = jnp.where(finite, x, 0.0)
+    count = windowed_count(finite, window)
+    cf = count.astype(xz.dtype)
+    s1 = windowed_sum(xz, window)
+    s2 = windowed_sum(xz * xz, window)
+    denom = jnp.maximum(cf - 1.0, 1.0)
+    var = jnp.maximum(s2 - s1 * s1 / jnp.maximum(cf, 1.0), 0.0) / denom
+    out = jnp.sqrt(var)
+    return _gate(jnp.where(count >= 2, out, jnp.nan), count, min_periods)
+
+
+def rolling_prod(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).apply(np.prod)`` on axis 0.
+
+    Exact windowed product via ``lax.reduce_window`` with a multiply reducer
+    (no cumulative-division trick, so zeros and sign changes are exact). NaNs
+    PROPAGATE through the product — pandas calls ``np.prod`` on the raw window
+    once ``min_periods`` non-NaN entries are present, and ``np.prod`` of a
+    window containing NaN is NaN.
+    """
+    finite = jnp.isfinite(x)
+    prod = jax.lax.reduce_window(
+        x,
+        jnp.ones((), dtype=x.dtype),
+        jax.lax.mul,
+        window_dimensions=(window,) + (1,) * (x.ndim - 1),
+        window_strides=(1,) * x.ndim,
+        padding=((window - 1, 0),) + ((0, 0),) * (x.ndim - 1),
+    )
+    return _gate(prod, windowed_count(finite, window), min_periods)

@@ -1,0 +1,129 @@
+"""Ragged→dense panel materialization.
+
+The reference keeps the firm-month panel "long" (one DataFrame row per
+firm-month) and loops over months (``src/regressions.py:43``). On TPU the
+panel lives as one dense ``(T, N, K)`` device array with a validity mask, so
+the per-month OLS loop becomes a single batched solve under ``vmap`` and
+rolling-window characteristics become windowed reductions — static shapes,
+no data-dependent control flow.
+
+``T`` indexes the observed months (sorted unique), ``N`` indexes firm slots
+(one per permno), ``K`` the variables. Firm-months absent from the long frame
+are masked out and hold NaN. Pandas' row-shift semantics (``groupby.shift``
+skips over calendar gaps) are reproduced downstream by compacting each firm's
+observed rows (see ``ops.compaction``), so T does not need to be
+calendar-contiguous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["DensePanel", "long_to_dense", "dense_to_long"]
+
+
+@dataclasses.dataclass
+class DensePanel:
+    """A dense firm-month panel.
+
+    Attributes
+    ----------
+    values : (T, N, K) float array, NaN where absent/missing.
+    mask   : (T, N) bool, True where the firm-month row exists in the source.
+    months : (T,) datetime64[ns], sorted unique observation dates.
+    ids    : (N,) array of firm identifiers (permno order = column order).
+    var_names : list of K variable names (K axis order).
+    """
+
+    values: np.ndarray
+    mask: np.ndarray
+    months: np.ndarray
+    ids: np.ndarray
+    var_names: List[str]
+
+    @property
+    def shape(self) -> tuple:
+        return self.values.shape
+
+    def var_index(self, name: str) -> int:
+        return self.var_names.index(name)
+
+    def var(self, name: str) -> np.ndarray:
+        """The (T, N) slice for one variable."""
+        return self.values[:, :, self.var_index(name)]
+
+    def with_vars(self, new_vars: Dict[str, np.ndarray]) -> "DensePanel":
+        """Return a panel extended (or overwritten) with (T, N) variables."""
+        names = list(self.var_names)
+        columns = [self.values[:, :, k] for k in range(len(names))]
+        for name, arr in new_vars.items():
+            arr = np.asarray(arr)
+            if arr.shape != self.mask.shape:
+                raise ValueError(f"{name}: expected {self.mask.shape}, got {arr.shape}")
+            if name in names:
+                columns[names.index(name)] = arr
+            else:
+                names.append(name)
+                columns.append(arr)
+        return DensePanel(
+            values=np.stack(columns, axis=-1),
+            mask=self.mask,
+            months=self.months,
+            ids=self.ids,
+            var_names=names,
+        )
+
+    def select(self, names: Sequence[str]) -> np.ndarray:
+        """The (T, N, len(names)) sub-array in the given variable order."""
+        idx = [self.var_index(n) for n in names]
+        return self.values[:, :, idx]
+
+
+def long_to_dense(
+    df: pd.DataFrame,
+    date_col: str,
+    id_col: str,
+    value_cols: Sequence[str],
+    dtype=np.float64,
+) -> DensePanel:
+    """Pack a long firm-month frame into a ``DensePanel``.
+
+    Duplicate (id, date) rows keep the last occurrence (mirrors the
+    keep-last dedup convention of the reference's merges, e.g.
+    ``src/calc_Lewellen_2014.py:430,461``).
+    """
+    months, t_idx = np.unique(df[date_col].to_numpy(), return_inverse=True)
+    ids, n_idx = np.unique(df[id_col].to_numpy(), return_inverse=True)
+
+    T, N, K = len(months), len(ids), len(value_cols)
+    values = np.full((T, N, K), np.nan, dtype=dtype)
+    mask = np.zeros((T, N), dtype=bool)
+
+    data = df[list(value_cols)].to_numpy(dtype=dtype)
+    values[t_idx, n_idx, :] = data  # later duplicates overwrite earlier ones
+    mask[t_idx, n_idx] = True
+
+    return DensePanel(
+        values=values,
+        mask=mask,
+        months=pd.DatetimeIndex(months).values,
+        ids=ids,
+        var_names=list(value_cols),
+    )
+
+
+def dense_to_long(panel: DensePanel) -> pd.DataFrame:
+    """Unpack a ``DensePanel`` back into a long frame of existing rows
+    (inverse of ``long_to_dense`` up to row order)."""
+    t_idx, n_idx = np.nonzero(panel.mask)
+    out = {
+        "date": pd.DatetimeIndex(panel.months)[t_idx],
+        "id": panel.ids[n_idx],
+    }
+    for k, name in enumerate(panel.var_names):
+        out[name] = panel.values[t_idx, n_idx, k]
+    return pd.DataFrame(out)

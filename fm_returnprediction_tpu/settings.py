@@ -1,0 +1,128 @@
+"""Project configuration from ``.env`` files and environment variables.
+
+Behavioral equivalent of the reference's ``src/settings.py:27-105`` (which
+uses ``python-decouple``; not available in this environment, so a small
+compatible loader is implemented here). Same keys and defaults, plus
+TPU-framework keys:
+
+- ``BACKEND``      — ``"tpu"`` or ``"cpu"``; selects the JAX platform used by
+  the compute core (north-star requirement: a ``BACKEND=tpu`` flag at this
+  layer).
+- ``MESH_DEVICES`` — number of devices in the 1-D compute mesh (``0`` = all
+  available).
+- ``DTYPE``        — ``"float32"`` or ``"float64"`` for the econometrics
+  kernels.
+
+Precedence: OS environment > ``.env`` file in ``BASE_DIR`` > in-code default.
+
+The ``config(key)`` accessor keeps the reference's guard semantics
+(``src/settings.py:72-94``): asking for a key already defined here while
+passing a ``default`` raises, and a ``cast`` that would change the type of an
+already-defined key raises.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from platform import system
+
+import pandas as pd
+
+__all__ = ["config", "create_dirs", "get_os", "if_relative_make_abs", "read_env_file"]
+
+
+def get_os() -> str:
+    """OS family tag; 'nix' for Linux/macOS (reference ``src/settings.py:27-36``)."""
+    return {"Windows": "windows", "Darwin": "nix", "Linux": "nix"}.get(system(), "unknown")
+
+
+def read_env_file(path: Path) -> dict[str, str]:
+    """Parse a ``KEY=VALUE`` .env file (comments and blank lines ignored)."""
+    values: dict[str, str] = {}
+    if not path.exists():
+        return values
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, raw = line.partition("=")
+        values[key.strip()] = raw.strip().strip("'\"")
+    return values
+
+
+_BASE_DIR = Path(__file__).absolute().parent.parent
+_ENV_FILE = read_env_file(_BASE_DIR / ".env")
+
+
+def _env(key: str, default=None, cast=None):
+    raw = os.environ.get(key, _ENV_FILE.get(key, default))
+    if cast is not None and raw is not None:
+        return cast(raw)
+    return raw
+
+
+def if_relative_make_abs(path) -> Path:
+    """Resolve ``path`` against BASE_DIR unless already absolute
+    (reference ``src/settings.py:39-45``)."""
+    path = Path(path)
+    return path.resolve() if path.is_absolute() else (_BASE_DIR / path).resolve()
+
+
+d: dict = {}
+d["OS_TYPE"] = get_os()
+d["BASE_DIR"] = _BASE_DIR
+
+# Reference keys and defaults (``src/settings.py:58-69``).
+d["WRDS_USERNAME"] = _env("WRDS_USERNAME", default="")
+d["NASDAQ_API_KEY"] = _env("NASDAQ_API_KEY", default="")
+d["START_DATE"] = _env("START_DATE", default="1964-01-01", cast=pd.to_datetime)
+d["END_DATE"] = _env("END_DATE", default="2013-12-31", cast=pd.to_datetime)
+d["USER"] = _env("USER", default="")
+
+d["DATA_DIR"] = if_relative_make_abs(_env("DATA_DIR", default="_data"))
+d["RAW_DATA_DIR"] = d["DATA_DIR"] / "raw"
+d["PROCESSED_DATA_DIR"] = d["DATA_DIR"] / "processed"
+d["MANUAL_DATA_DIR"] = d["DATA_DIR"] / "manual"
+d["OUTPUT_DIR"] = if_relative_make_abs(_env("OUTPUT_DIR", default="_output"))
+
+# TPU-framework keys (new in this framework).
+d["BACKEND"] = _env("BACKEND", default="tpu")
+d["MESH_DEVICES"] = int(_env("MESH_DEVICES", default="0"))
+d["DTYPE"] = _env("DTYPE", default="float32")
+
+
+def config(*args, **kwargs):
+    """Guarded accessor for configuration values.
+
+    Mirrors the reference's double-default and type-change guards
+    (``src/settings.py:72-94``): keys defined in this module may not be given
+    a new default, and a ``cast`` may re-assert but not change their type.
+    Unknown keys fall back to environment/.env lookup with the provided
+    ``default``/``cast``.
+    """
+    key = args[0]
+    default = kwargs.get("default", None)
+    cast = kwargs.get("cast", None)
+    if key in d:
+        var = d[key]
+        if default is not None:
+            raise ValueError(f"Default for {key} already exists. Check settings.py.")
+        if cast is not None and type(cast(var)) is not type(var):
+            raise ValueError(f"Type for {key} is already set. Check settings.py.")
+        return var
+    var = _env(key, default=default, cast=cast)
+    if var is None:
+        raise KeyError(f"{key} not found in settings, environment, or .env file.")
+    return var
+
+
+def create_dirs() -> None:
+    """Create the regenerable data/output directory tree
+    (reference ``src/settings.py:96-102``)."""
+    for key in ("DATA_DIR", "RAW_DATA_DIR", "PROCESSED_DATA_DIR", "MANUAL_DATA_DIR", "OUTPUT_DIR"):
+        d[key].mkdir(parents=True, exist_ok=True)
+
+
+if __name__ == "__main__":
+    create_dirs()

@@ -1,0 +1,209 @@
+"""Parquet/CSV/zip file cache — the framework's checkpoint substrate.
+
+Re-provides the reference's cache layer (``src/utils.py:68-329``) with the
+same on-disk contract so existing reference caches drop in unchanged:
+
+- explicit file names like ``CRSP_stock_m.parquet`` (the names the pipeline
+  actually uses, ``src/calc_Lewellen_2014.py:1236-1240``);
+- derived verbose names ``<code>__<safe-filter-str>.<ext>`` for keyed pulls;
+- sha256-hashed names keeping date components readable;
+- first-hit-wins lookup across ``.parquet``/``.csv``/``.zip``.
+
+The cache IS the checkpoint/resume system of the pipeline (SURVEY §5): raw
+pulls and intermediate dense panels persist here and short-circuit recompute.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import re
+import zipfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+import pandas as pd
+
+__all__ = [
+    "cache_filename",
+    "hash_cache_filename",
+    "file_cached",
+    "read_cached_data",
+    "write_cache_data",
+    "save_cache_data",
+    "load_cache_data",
+    "flatten_dict_to_str",
+]
+
+_DEFAULT_EXTS = ("parquet", "csv", "zip")
+
+
+def flatten_dict_to_str(filters: Dict[str, Any]) -> str:
+    """Flatten a (possibly nested) filter dict into a stable string key.
+
+    ``{'ticker': ['AAPL'], 'date': {'gte': '2020-01-01'}}`` →
+    ``"ticker=['AAPL'],date.gte=2020-01-01"`` (reference ``src/utils.py:238-253``).
+    """
+    items: List[str] = []
+    for key, value in filters.items():
+        if isinstance(value, dict):
+            items.extend(f"{key}.{sub}={subval}" for sub, subval in value.items())
+        else:
+            items.append(f"{key}={value}")
+    return ",".join(items)
+
+
+def _strip_keys(text: str) -> str:
+    return re.sub(r"export=[a-zA-Z]*|[^,]*=", "", text)
+
+
+def _char_clean(text: str) -> str:
+    for old, new in (("/", "_"), ("=", "_"), (",", "_"), ("-", ""), (" ", ""), ("'", "")):
+        text = text.replace(old, new)
+    return text
+
+
+def _sanitize(text: str) -> str:
+    return _char_clean(_strip_keys(text))
+
+
+def _split_filters(filters_str: str) -> List[str]:
+    """Split a flattened filter string on top-level commas only, keeping
+    bracketed list values (``date=['a', 'b']``) intact."""
+    parts, cur, depth = [], [], 0
+    for ch in filters_str:
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(depth - 1, 0)
+        cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in parts if p]
+
+
+def _cleanup(name: str) -> str:
+    return name.replace("__.", ".").replace("_.", ".")
+
+
+def cache_filename(
+    code: str,
+    filters_str: str,
+    data_dir: Union[Path, str],
+    file_ext_list: tuple = _DEFAULT_EXTS,
+) -> List[Path]:
+    """Verbose cache paths ``<code>__<safe-filters>.<ext>`` for each extension
+    (reference ``src/utils.py:68-109``). Filter strings without a date
+    component get today's date appended so un-dated pulls age out daily."""
+    if "date" not in filters_str:
+        filters_str += f"_{datetime.date.today().strftime('%Y%m%d')}"
+    safe = _sanitize(filters_str)
+    data_dir = Path(data_dir)
+    return [
+        data_dir / _cleanup(f"{code.replace('/', '_')}__{safe}.{ext}")
+        for ext in file_ext_list
+    ]
+
+
+def hash_cache_filename(
+    code: str,
+    filters_str: str,
+    data_dir: Union[Path, str],
+    file_ext_list: tuple = _DEFAULT_EXTS,
+) -> List[Path]:
+    """Hashed cache paths: ``<code>_<date-parts>_<9-hex sha256 of the rest>``.
+
+    Date-bearing filter assignments ('date' in the KEY, bracketed list values
+    kept whole) stay readable in the filename; all other filters fold into the
+    hash (reference ``src/utils.py:112-180``). The dataset ``code`` always
+    prefixes the name so distinct datasets with identical filters can never
+    collide."""
+    if "date" not in filters_str and "end_date" not in filters_str:
+        filters_str += f"_{datetime.date.today().strftime('%Y%m%d')}"
+    parts = _split_filters(filters_str)
+    keep_parts = [p for p in parts if "date" in p.partition("=")[0]]
+    hash_parts = [p for p in parts if "date" not in p.partition("=")[0]]
+    safe_keep = _char_clean(code) + "_" + _sanitize(",".join(keep_parts))
+    digest = hashlib.sha256(_sanitize(",".join(hash_parts)).encode()).hexdigest()[:9]
+    data_dir = Path(data_dir)
+    return [
+        data_dir / _cleanup(f"{safe_keep}_{digest}.{ext}") for ext in file_ext_list
+    ]
+
+
+def file_cached(filepaths: List[Path]) -> Optional[Path]:
+    """First existing path among candidates, else None (``src/utils.py:183-191``)."""
+    for filepath in filepaths:
+        if Path(filepath).exists():
+            return Path(filepath)
+    return None
+
+
+def read_cached_data(filepath: Path) -> pd.DataFrame:
+    """Read a cached frame; zip archives are assumed to hold one member
+    (``src/utils.py:194-218``)."""
+    fmt = Path(filepath).suffix.lstrip(".")
+    if fmt == "csv":
+        return pd.read_csv(filepath)
+    if fmt == "parquet":
+        return pd.read_parquet(filepath)
+    if fmt == "zip":
+        with zipfile.ZipFile(filepath, "r") as archive:
+            member = archive.namelist()[0]
+            with archive.open(member) as handle:
+                if member.endswith(".parquet"):
+                    return pd.read_parquet(handle)
+                return pd.read_csv(handle)
+    raise ValueError(f"Unsupported file format: {fmt}")
+
+
+def write_cache_data(df: pd.DataFrame, filepath: Path) -> None:
+    """Write a frame by extension; parquet is the default interchange format
+    (``src/utils.py:221-235``)."""
+    filepath = Path(filepath)
+    fmt = filepath.suffix.lstrip(".")
+    filepath.parent.mkdir(parents=True, exist_ok=True)
+    if fmt == "parquet":
+        df.to_parquet(filepath, index=False)
+    elif fmt == "csv":
+        df.to_csv(filepath, index=False)
+    elif fmt == "xlsx":
+        df.to_excel(filepath, index=False)
+    else:
+        raise ValueError(f"Unsupported file format: {fmt}")
+
+
+def save_cache_data(
+    df: pd.DataFrame,
+    data_dir: Union[Path, str],
+    cache_paths: Optional[List[Path]] = None,
+    file_name: Optional[str] = None,
+    file_type: Optional[str] = None,
+) -> Path:
+    """Save ``df`` under an explicit ``file_name`` or the first ``cache_paths``
+    entry matching ``file_type`` (``src/utils.py:277-319``)."""
+    if file_name is None:
+        file_type = file_type or "parquet"
+        cache_path = next(
+            (p for p in (cache_paths or []) if p.suffix == f".{file_type}"), None
+        )
+        if cache_path is None:
+            raise ValueError("No cache path matches the requested file type.")
+    elif not any(file_name.endswith(f".{ext}") for ext in _DEFAULT_EXTS):
+        cache_path = Path(data_dir, f"{file_name}.{file_type or 'parquet'}")
+    else:
+        cache_path = Path(data_dir, file_name)
+    write_cache_data(df, cache_path)
+    return cache_path
+
+
+def load_cache_data(data_dir: Union[Path, str], file_name: str) -> pd.DataFrame:
+    """Load a cached frame by exact name, raising if absent
+    (``src/utils.py:322-329``)."""
+    path = Path(data_dir, file_name)
+    if not path.exists():
+        raise FileNotFoundError(f"File {file_name} not found in {data_dir}.")
+    return read_cached_data(path)

@@ -1,0 +1,72 @@
+"""Stage timing / tracing.
+
+The reference's only instrumentation is wall-clock prints around notebook
+execution (``dodo.py:176,189``). The framework's headline metric is
+wall-clock, so every pipeline stage runs under a ``StageTimer`` that records
+per-stage durations, and ``trace`` optionally wraps a region in a
+``jax.profiler`` trace for TPU profiling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from pathlib import Path
+from typing import Dict, Iterator, Optional
+
+__all__ = ["StageTimer", "stage", "trace"]
+
+
+class StageTimer:
+    """Accumulates named stage durations; can persist them as JSON."""
+
+    def __init__(self) -> None:
+        self.durations: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.durations[name] = self.durations.get(name, 0.0) + (
+                time.perf_counter() - start
+            )
+
+    def total(self) -> float:
+        return sum(self.durations.values())
+
+    def dump(self, path: Path) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        Path(path).write_text(json.dumps(self.durations, indent=2))
+
+    def report(self) -> str:
+        lines = [f"{name:<40s} {secs:9.3f}s" for name, secs in self.durations.items()]
+        lines.append(f"{'TOTAL':<40s} {self.total():9.3f}s")
+        return "\n".join(lines)
+
+
+_GLOBAL_TIMER = StageTimer()
+
+
+@contextlib.contextmanager
+def stage(name: str, timer: Optional[StageTimer] = None) -> Iterator[None]:
+    """Time a pipeline stage on the global (or given) timer."""
+    with (timer or _GLOBAL_TIMER).stage(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Wrap a region in a ``jax.profiler`` trace when ``log_dir`` is given."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

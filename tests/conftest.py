@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Must run before any ``jax`` import:
+
+- Force the CPU platform with 8 virtual devices so ``Mesh``/``shard_map``
+  code paths are exercised without TPU hardware (SURVEY §4d).
+- Enable x64 so JAX kernels match the float64 numpy/pandas oracles bit-close
+  (parity tolerance 1e-4 per BASELINE.md; tests assert far tighter).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("FMRP_TEST_PLATFORM", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Plugins (e.g. jaxtyping's) may import jax before this conftest runs, so the
+# env vars alone are not enough; config.update works until the backend
+# initializes, which only happens at the first device query/computation.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_enable_x64", os.environ["JAX_ENABLE_X64"] == "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng() -> np.random.Generator:
+    return np.random.default_rng(20140131)

@@ -1,0 +1,112 @@
+"""Slow numpy/pandas oracle transcribing the REFERENCE's exact formulas.
+
+This is test infrastructure, not framework code: an independent, loop-based
+implementation of the reference pipeline's numerical behavior
+(``/root/reference/src/regressions.py`` and the rolling kernels in
+``calc_Lewellen_2014.py``), written from the formulas — including the quirks
+the framework must reproduce (SURVEY §2.2): the ``1 - k/T`` Bartlett weight,
+complete-case dropna before the monthly loop, the min-10-months rule, and
+skipping months with fewer than P+1 observations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def oracle_monthly_cs_ols(
+    df: pd.DataFrame,
+    return_col: str,
+    predictor_cols: list,
+    date_col: str = "mthcaldt",
+) -> pd.DataFrame:
+    """Per-month OLS loop (reference ``run_monthly_cs_regressions``,
+    ``src/regressions.py:9-76``). One output row per month that ran."""
+    data = df[[return_col, date_col] + predictor_cols].sort_values(date_col).dropna()
+    rows = []
+    for month, grp in data.groupby(date_col):
+        if len(grp) < len(predictor_cols) + 1:
+            continue
+        y = grp[return_col].to_numpy(dtype=float)
+        x = np.column_stack(
+            [np.ones(len(grp)), grp[predictor_cols].to_numpy(dtype=float)]
+        )
+        beta, *_ = np.linalg.lstsq(x, y, rcond=None)
+        resid = y - x @ beta
+        sst = float(((y - y.mean()) ** 2).sum())
+        r2 = 1.0 - float((resid**2).sum()) / sst if sst > 0 else 0.0
+        row = {date_col: month, "N": len(grp), "R2": r2}
+        for i, col in enumerate(predictor_cols):
+            row[f"slope_{col}"] = beta[1 + i]
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def oracle_nw_mean_se(series: np.ndarray, lags: int = 4) -> float:
+    """Reference ``newey_west_mean_se`` (``src/regressions.py:78-100``):
+    Bartlett weight ``1 - k/T`` with T the series length, variance scaled by
+    ``T²``, loop broken once a weight would go negative."""
+    x = np.asarray(series, dtype=float)
+    T = x.size
+    if T < 2:
+        return np.nan
+    u = x - x.mean()
+    gamma0 = float(np.sum(u * u))
+    acc = 0.0
+    for k in range(1, lags + 1):
+        weight = 1.0 - k / T
+        if weight < 0:
+            break
+        acc += weight * float(np.sum(u[k:] * u[:-k]))
+    return float(np.sqrt((gamma0 + 2.0 * acc) / T**2))
+
+
+def oracle_fama_macbeth_summary(
+    cs_results: pd.DataFrame,
+    predictor_cols: list,
+    nw_lags: int = 4,
+) -> dict:
+    """Reference ``fama_macbeth_summary`` (``src/regressions.py:102-130``)."""
+    out = {}
+    for col in predictor_cols:
+        slopes = cs_results[f"slope_{col}"].dropna()
+        if len(slopes) < 10:
+            out[f"{col}_coef"] = np.nan
+            out[f"{col}_tstat"] = np.nan
+            continue
+        mean_slope = float(slopes.mean())
+        se = oracle_nw_mean_se(slopes.to_numpy(), lags=nw_lags)
+        out[f"{col}_coef"] = mean_slope
+        out[f"{col}_tstat"] = mean_slope / se
+    out["mean_R2"] = float(cs_results["R2"].mean())
+    out["mean_N"] = float(cs_results["N"].mean())
+    return out
+
+
+def make_synthetic_long_panel(
+    rng: np.random.Generator,
+    n_months: int = 48,
+    n_firms: int = 60,
+    n_predictors: int = 3,
+    missing_frac: float = 0.15,
+    absent_frac: float = 0.10,
+) -> tuple[pd.DataFrame, list]:
+    """A small long firm-month panel with realistic raggedness: firms enter
+    and exit (absent rows) and surviving rows have scattered missing values,
+    so complete-case and skip-month paths are exercised."""
+    months = pd.date_range("1980-01-31", periods=n_months, freq="ME")
+    pred_cols = [f"x{i}" for i in range(n_predictors)]
+    records = []
+    for firm in range(n_firms):
+        start = rng.integers(0, n_months // 3)
+        stop = rng.integers(2 * n_months // 3, n_months)
+        for t in range(start, stop):
+            if rng.random() < absent_frac:
+                continue  # firm-month row absent entirely (gap)
+            row = {"permno": 10000 + firm, "mthcaldt": months[t]}
+            row["retx"] = rng.normal(0.01, 0.08)
+            for col in pred_cols:
+                row[col] = np.nan if rng.random() < missing_frac else rng.normal()
+            records.append(row)
+    return pd.DataFrame(records), pred_cols

@@ -1,0 +1,103 @@
+"""Cache substrate tests (reference contract: ``src/utils.py:68-329``)."""
+
+import zipfile
+
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.utils import cache
+
+
+@pytest.fixture
+def frame():
+    return pd.DataFrame({"permno": [1, 2, 3], "retx": [0.01, -0.02, 0.03]})
+
+
+def test_flatten_dict_to_str():
+    out = cache.flatten_dict_to_str(
+        {"ticker": ["AAPL", "MSFT"], "date": {"gte": "2020-01-01"}}
+    )
+    assert out == "ticker=['AAPL', 'MSFT'],date.gte=2020-01-01"
+
+
+def test_cache_filename_shape(tmp_path):
+    paths = cache.cache_filename(
+        "crsp/msf_v2", "start_date=1964-01-01,end_date=2013-12-31", tmp_path
+    )
+    assert [p.suffix for p in paths] == [".parquet", ".csv", ".zip"]
+    assert all(p.name.startswith("crsp_msf_v2__") for p in paths)
+    # date components survive sanitization
+    assert "19640101" in paths[0].name
+
+
+def test_hash_cache_filename_stable(tmp_path):
+    a = cache.hash_cache_filename("comp_funda", "vars=x,start_date=1964-01-01", tmp_path)
+    b = cache.hash_cache_filename("comp_funda", "vars=x,start_date=1964-01-01", tmp_path)
+    assert a == b
+    c = cache.hash_cache_filename("comp_funda", "vars=y,start_date=1964-01-01", tmp_path)
+    assert a != c  # different non-date filters hash differently
+
+
+def test_roundtrip_parquet_and_csv(tmp_path, frame):
+    for ext in ("parquet", "csv"):
+        path = tmp_path / f"data.{ext}"
+        cache.write_cache_data(frame, path)
+        out = cache.read_cached_data(path)
+        pd.testing.assert_frame_equal(out, frame, check_dtype=False)
+
+
+def test_zip_roundtrip(tmp_path, frame):
+    csv_path = tmp_path / "inner.csv"
+    frame.to_csv(csv_path, index=False)
+    zip_path = tmp_path / "data.zip"
+    with zipfile.ZipFile(zip_path, "w") as archive:
+        archive.write(csv_path, "inner.csv")
+    out = cache.read_cached_data(zip_path)
+    pd.testing.assert_frame_equal(out, frame, check_dtype=False)
+
+
+def test_first_hit_wins(tmp_path, frame):
+    paths = [tmp_path / "x.parquet", tmp_path / "x.csv"]
+    assert cache.file_cached(paths) is None
+    cache.write_cache_data(frame, paths[1])
+    assert cache.file_cached(paths) == paths[1]
+    cache.write_cache_data(frame, paths[0])
+    assert cache.file_cached(paths) == paths[0]
+
+
+def test_save_and_load_by_name(tmp_path, frame):
+    path = cache.save_cache_data(frame, tmp_path, file_name="CRSP_stock_m")
+    assert path.name == "CRSP_stock_m.parquet"
+    out = cache.load_cache_data(tmp_path, "CRSP_stock_m.parquet")
+    pd.testing.assert_frame_equal(out, frame, check_dtype=False)
+    with pytest.raises(FileNotFoundError):
+        cache.load_cache_data(tmp_path, "missing.parquet")
+
+
+def test_hash_filename_keeps_dataset_code(tmp_path):
+    """Distinct dataset codes with identical filters must never collide."""
+    a = cache.hash_cache_filename(
+        "crsp_msf_v2", "start_date=1964-01-01,end_date=2013-12-31", tmp_path
+    )
+    b = cache.hash_cache_filename(
+        "crsp_dsf_v2", "start_date=1964-01-01,end_date=2013-12-31", tmp_path
+    )
+    assert a != b
+    assert a[0].name.startswith("crsp_msf_v2_")
+    assert b[0].name.startswith("crsp_dsf_v2_")
+
+
+def test_hash_filename_bracketed_date_list_kept_whole(tmp_path):
+    paths = cache.hash_cache_filename(
+        "q", "date=['2020-01-01', '2021-06-30'],ticker=AAPL", tmp_path
+    )
+    # both dates stay readable; ticker is folded into the hash
+    assert "20200101" in paths[0].name and "20210630" in paths[0].name
+    assert "AAPL" not in paths[0].name
+
+
+def test_hash_filename_date_in_value_is_hashed(tmp_path):
+    """'date' must appear in the KEY to stay readable, not in the value."""
+    paths = cache.hash_cache_filename("q", "table=stkdatedelist,start_date=2020-01-01", tmp_path)
+    assert "stkdatedelist" not in paths[0].name
+    assert "20200101" in paths[0].name

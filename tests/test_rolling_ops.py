@@ -1,0 +1,174 @@
+"""Rolling/compaction/quantile ops vs pandas ground truth.
+
+pandas IS the semantics oracle here: the reference's characteristic kernels
+are pandas ``groupby.shift``/``rolling``/``percentile`` calls, and 1e-4
+parity hinges on matching their row-based window rules exactly (SURVEY §7
+hard part (b))."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.ops import (
+    compact,
+    lag,
+    make_compaction,
+    masked_quantile,
+    rolling_mean,
+    rolling_prod,
+    rolling_std,
+    rolling_sum,
+    scatter_back,
+    winsorize_cs,
+)
+
+
+@pytest.fixture(scope="module")
+def ragged():
+    """(T, N) values + mask with gaps and NaNs, plus the equivalent long frame."""
+    rng = np.random.default_rng(11)
+    T, N = 60, 25
+    values = rng.normal(size=(T, N))
+    values[rng.random((T, N)) < 0.1] = np.nan  # missing value, row present
+    mask = rng.random((T, N)) > 0.15           # row absent entirely
+    months = pd.date_range("1990-01-31", periods=T, freq="ME")
+    t_idx, n_idx = np.nonzero(mask)
+    df = pd.DataFrame(
+        {"permno": n_idx, "mthcaldt": months[t_idx], "x": values[t_idx, n_idx]}
+    ).sort_values(["permno", "mthcaldt"])
+    return values, mask, df
+
+
+def _pandas_groupby_apply(df, fn):
+    out = fn(df.groupby("permno")["x"])
+    if isinstance(out.index, pd.MultiIndex):  # rolling ops prepend the group key
+        out = out.reset_index(level=0, drop=True)
+    return df.assign(out=out)
+
+
+def _compare(ragged, device_out, pandas_df):
+    """Compare a (T, N) device result against the long pandas result."""
+    values, mask, _ = ragged
+    got = np.asarray(device_out)
+    for _, row in pandas_df.iterrows():
+        t = (pd.Timestamp(row["mthcaldt"]).year - 1990) * 12 + (
+            pd.Timestamp(row["mthcaldt"]).month - 1
+        )
+        n = int(row["permno"])
+        want = row["out"]
+        if np.isnan(want):
+            assert np.isnan(got[t, n]), (t, n, got[t, n])
+        else:
+            np.testing.assert_allclose(got[t, n], want, rtol=1e-10, err_msg=f"{t},{n}")
+
+
+def test_lag_matches_groupby_shift(ragged):
+    values, mask, df = ragged
+    plan = make_compaction(jnp.asarray(mask))
+    comp = compact(jnp.asarray(values), plan)
+    out = scatter_back(lag(comp, 2), plan)
+    expect = _pandas_groupby_apply(df, lambda g: g.shift(2))
+    _compare(ragged, out, expect)
+
+
+def test_rolling_sum_matches_pandas(ragged):
+    values, mask, df = ragged
+    plan = make_compaction(jnp.asarray(mask))
+    comp = jnp.where(plan.valid, compact(jnp.asarray(values), plan), jnp.nan)
+    out = scatter_back(rolling_sum(comp, 12, 1), plan)
+    expect = _pandas_groupby_apply(
+        df, lambda g: g.rolling(window=12, min_periods=1).sum()
+    )
+    _compare(ragged, out, expect)
+
+
+def test_rolling_std_matches_pandas(ragged):
+    values, mask, df = ragged
+    plan = make_compaction(jnp.asarray(mask))
+    comp = jnp.where(plan.valid, compact(jnp.asarray(values), plan), jnp.nan)
+    out = scatter_back(rolling_std(comp, 10, 4), plan)
+    expect = _pandas_groupby_apply(
+        df, lambda g: g.rolling(window=10, min_periods=4).std()
+    )
+    _compare(ragged, out, expect)
+
+
+def test_rolling_prod_matches_pandas(ragged):
+    values, mask, df = ragged
+    plan = make_compaction(jnp.asarray(mask))
+    gross = 1.0 + 0.1 * jnp.where(plan.valid, compact(jnp.asarray(values), plan), jnp.nan)
+    out = scatter_back(rolling_prod(gross, 11, 11), plan)
+    df2 = df.assign(x=1.0 + 0.1 * df["x"])
+    expect = _pandas_groupby_apply(
+        df2, lambda g: g.rolling(window=11, min_periods=11).apply(np.prod, raw=True)
+    )
+    _compare(ragged, out, expect)
+
+
+def test_rolling_mean_matches_pandas(ragged):
+    values, mask, df = ragged
+    plan = make_compaction(jnp.asarray(mask))
+    comp = jnp.where(plan.valid, compact(jnp.asarray(values), plan), jnp.nan)
+    out = scatter_back(rolling_mean(comp, 24, 12), plan)
+    expect = _pandas_groupby_apply(
+        df, lambda g: g.rolling(window=24, min_periods=12).mean()
+    )
+    _compare(ragged, out, expect)
+
+
+def test_masked_quantile_matches_numpy(ragged):
+    values, mask, _ = ragged
+    valid = mask & np.isfinite(values)
+    got = np.asarray(
+        masked_quantile(jnp.asarray(values), jnp.asarray(valid), jnp.asarray([0.2, 0.5]))
+    )
+    for t in range(values.shape[0]):
+        vals = values[t][valid[t]]
+        if len(vals) == 0:
+            assert np.all(np.isnan(got[t]))
+            continue
+        np.testing.assert_allclose(got[t, 0], np.percentile(vals, 20), rtol=1e-12)
+        np.testing.assert_allclose(got[t, 1], np.percentile(vals, 50), rtol=1e-12)
+
+
+def test_masked_quantile_scalar_q(ragged):
+    values, mask, _ = ragged
+    valid = mask & np.isfinite(values)
+    got = np.asarray(masked_quantile(jnp.asarray(values), jnp.asarray(valid), 0.5))
+    assert got.shape == (values.shape[0],)
+
+
+def test_winsorize_matches_reference_semantics(ragged):
+    values, mask, _ = ragged
+    valid = mask & np.isfinite(values)
+    got = np.asarray(winsorize_cs(jnp.asarray(values), jnp.asarray(mask)))
+    for t in range(values.shape[0]):
+        vals = values[t][valid[t]]
+        if len(vals) < 5:
+            np.testing.assert_array_equal(got[t][mask[t]], values[t][mask[t]])
+            continue
+        lo, hi = np.percentile(vals, 1), np.percentile(vals, 99)
+        want = np.clip(values[t], lo, hi)
+        np.testing.assert_allclose(
+            got[t][valid[t]], want[valid[t]], rtol=1e-12
+        )
+
+
+def test_winsorize_small_month_skipped():
+    """Months with <5 valid obs pass through (src/calc_Lewellen_2014.py:520)."""
+    values = np.array([[5.0, -3.0, 100.0, np.nan, np.nan, np.nan, np.nan, np.nan]])
+    mask = np.ones_like(values, dtype=bool)
+    got = np.asarray(winsorize_cs(jnp.asarray(values), jnp.asarray(mask)))
+    np.testing.assert_array_equal(got[0, :3], values[0, :3])
+
+
+def test_rolling_prod_nan_propagates_like_numpy_prod():
+    """pandas rolling.apply(np.prod) yields NaN for any window containing NaN
+    once min_periods is met — NaN must propagate, not be treated as 1."""
+    x = np.array([1.1, np.nan, 1.2, 1.3, 1.4])
+    got = np.asarray(rolling_prod(jnp.asarray(x)[:, None], 3, 2))[:, 0]
+    want = (
+        pd.Series(x).rolling(3, min_periods=2).apply(np.prod, raw=True).to_numpy()
+    )
+    np.testing.assert_allclose(got, want)

@@ -1,0 +1,57 @@
+"""L0 config tests (reference semantics: ``src/settings.py:72-94``)."""
+
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu import settings
+from fm_returnprediction_tpu.settings import config, read_env_file
+
+
+def test_default_sample_period():
+    assert config("START_DATE") == pd.Timestamp("1964-01-01")
+    assert config("END_DATE") == pd.Timestamp("2013-12-31")
+
+
+def test_directory_layout():
+    data_dir = config("DATA_DIR")
+    assert config("RAW_DATA_DIR") == data_dir / "raw"
+    assert config("PROCESSED_DATA_DIR") == data_dir / "processed"
+    assert config("MANUAL_DATA_DIR") == data_dir / "manual"
+
+
+def test_backend_key_exists():
+    assert config("BACKEND") in {"cpu", "tpu"}
+
+
+def test_double_default_guard():
+    with pytest.raises(ValueError):
+        config("START_DATE", default="1999-01-01")
+
+
+def test_type_change_guard():
+    with pytest.raises(ValueError):
+        config("START_DATE", cast=str)
+
+
+def test_unknown_key_raises():
+    with pytest.raises(KeyError):
+        config("NO_SUCH_KEY_EVER")
+
+
+def test_unknown_key_with_default():
+    assert config("NO_SUCH_KEY_EVER", default="fallback") == "fallback"
+
+
+def test_read_env_file(tmp_path):
+    env = tmp_path / ".env"
+    env.write_text("# comment\nFOO=bar\nQUOTED='baz'\n\nBAD_LINE\n")
+    values = read_env_file(env)
+    assert values == {"FOO": "bar", "QUOTED": "baz"}
+
+
+def test_create_dirs(tmp_path, monkeypatch):
+    for key in ("DATA_DIR", "RAW_DATA_DIR", "PROCESSED_DATA_DIR",
+                "MANUAL_DATA_DIR", "OUTPUT_DIR"):
+        monkeypatch.setitem(settings.d, key, tmp_path / key.lower())
+    settings.create_dirs()
+    assert (tmp_path / "raw_data_dir").is_dir()
