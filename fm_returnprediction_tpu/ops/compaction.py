@@ -62,4 +62,4 @@ def lag(comp_values: jnp.ndarray, k: int, fill=jnp.nan) -> jnp.ndarray:
     if k == 0:
         return comp_values
     pad = jnp.full((k,) + comp_values.shape[1:], fill, dtype=comp_values.dtype)
-    return jnp.concatenate([pad, comp_values[:-k]], axis=0)
+    return jnp.concatenate([pad, comp_values[:-k]], axis=0)[: comp_values.shape[0]]

@@ -1,0 +1,162 @@
+"""Daily-data kernels: weekly-grid rolling beta and 252-day volatility.
+
+These are the two largest-volume computations in the pipeline (SURVEY §3.5:
+daily CRSP 1964-2013 is O(10⁷-10⁸) rows).
+
+Rolling beta (reference ``calculate_rolling_beta``,
+``src/calc_Lewellen_2014.py:344-434``): the reference inner-joins daily stock
+and index returns, takes log gross returns, and runs polars
+``group_by_dynamic(every="1w", period="156w", by="permno")`` to get rolling
+partial sums, from which ``beta = (ΣRiRm − ΣRiΣRm/n)/(ΣRm² − (ΣRm)²/n)``.
+The polars window semantics replicated here (best-effort transcription —
+polars is not installed in this environment; semantics documented from the
+polars 1.x API contract):
+
+- window starts lie on the global Monday lattice (polars ``truncate("1w")``);
+- each window is label-LEFT and forward: ``[start, start + 156 weeks)`` —
+  note this makes the reference's "beta over months -36..-1" actually a
+  FORWARD-looking window (SURVEY flags this; parity targets the reference's
+  behavior, not the paper's);
+- per firm, windows are emitted for week-starts from its first to its last
+  observation week;
+- the weekly rows are then stamped with the month-end of the window START
+  and deduplicated keep-last per (firm, month).
+
+TPU design: daily obs → weekly partial sums via ``segment_sum`` (one pass
+over the (D, N) panel), then 156-week FORWARD windowed sums via reversed
+cumsum-difference along the ~2,600-week axis, then a ``segment_max`` pick of
+the last valid week per month. Everything is per-firm independent along N.
+
+252-day volatility (reference ``calc_std_12``, ``:438-465``): per-firm
+252-row rolling std (min 100 obs) of daily retx, annualized by √252, sampled
+at the last observed day of each month.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.compaction import compact, make_compaction, scatter_back
+from fm_returnprediction_tpu.ops.rolling import rolling_std, windowed_count, windowed_sum
+
+__all__ = ["last_obs_per_month", "rolling_vol_252_monthly", "weekly_rolling_beta_monthly"]
+
+
+def _forward_windowed_sum(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Sum over [j, j+window) along axis 0 (the mirror of the trailing
+    window): reverse, trailing-window sum, reverse."""
+    return windowed_sum(x[::-1], window)[::-1]
+
+
+def last_obs_per_month(
+    values: jnp.ndarray,
+    present: jnp.ndarray,
+    month_id: jnp.ndarray,
+    n_months: int,
+) -> jnp.ndarray:
+    """Per (month, firm): the value at the firm's LAST present row of that
+    month — the dense analog of ``drop_duplicates(['permno','jdate'],
+    keep='last')`` on row-sorted daily data (``src/calc_Lewellen_2014.py:430,461``).
+
+    Parameters
+    ----------
+    values : (D, N); present : (D, N) bool; month_id : (D,) int in
+    [0, n_months] where ``n_months`` is a trash segment for out-of-panel
+    months. Returns (n_months, N) with NaN where a firm has no row in a month.
+    """
+    day_pos = jnp.arange(values.shape[0])[:, None]
+    pos = jnp.where(present, day_pos, -1)
+    last_pos = jax.ops.segment_max(
+        pos, month_id, num_segments=n_months + 1
+    )[:n_months]
+    has = last_pos >= 0
+    picked = jnp.take_along_axis(values, jnp.maximum(last_pos, 0), axis=0)
+    return jnp.where(has, picked, jnp.nan)
+
+
+def rolling_vol_252_monthly(
+    ret_d: jnp.ndarray,
+    mask_d: jnp.ndarray,
+    month_id: jnp.ndarray,
+    n_months: int,
+    window: int = 252,
+    min_periods: int = 100,
+) -> jnp.ndarray:
+    """Annualized 252-row rolling std of daily returns, sampled at each
+    firm-month's last observed day. Returns (n_months, N)."""
+    plan = make_compaction(mask_d)
+    comp_ret = jnp.where(plan.valid, compact(ret_d, plan), jnp.nan)
+    vol = rolling_std(comp_ret, window, min_periods) * jnp.sqrt(
+        jnp.asarray(float(window), dtype=ret_d.dtype)
+    )
+    vol_cal = scatter_back(vol, plan)
+    return last_obs_per_month(vol_cal, mask_d, month_id, n_months)
+
+
+def weekly_rolling_beta_monthly(
+    ret_d: jnp.ndarray,
+    mask_d: jnp.ndarray,
+    mkt_d: jnp.ndarray,
+    week_id: jnp.ndarray,
+    n_weeks: int,
+    week_month_id: jnp.ndarray,
+    n_months: int,
+    window_weeks: int = 156,
+    mkt_present: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Rolling beta on the weekly Monday lattice, one value per (month, firm).
+
+    Parameters
+    ----------
+    ret_d : (D, N) daily stock returns (retx); NaN values follow the
+        reference's polars semantics: ``pl.DataFrame(pandas_df)`` converts
+        NaN→null (``nan_to_null=True`` default), polars aggregate sums SKIP
+        nulls, but ``pl.count()`` counts ALL rows — so each partial sum
+        covers its non-null rows while the denominator n is the window's row
+        count (``src/calc_Lewellen_2014.py:376,404-410``).
+    mask_d : (D, N) bool, firm-day row present.
+    mkt_d : (D,) daily market return (vwretx).
+    mkt_present : (D,) bool, the index table HAS a row for the day — days it
+        lacks are dropped by the reference's inner join (``:380``) and
+        contribute no rows at all.
+    week_id : (D,) int, Monday-lattice week index of each day (0..n_weeks-1).
+    week_month_id : (n_weeks,) int month index of each week's Monday in the
+        monthly panel vocabulary, ``n_months`` for out-of-panel months.
+    Returns (n_months, N) betas, NaN where no valid window start in month.
+    """
+    if mkt_present is None:
+        mkt_present = jnp.isfinite(mkt_d)
+    present = mask_d & mkt_present[:, None]          # row exists in the join
+    ri_valid = present & jnp.isfinite(ret_d)
+    rm_valid = present & jnp.isfinite(mkt_d)[:, None]
+    log_ri = jnp.where(ri_valid, jnp.log1p(ret_d), 0.0)
+    log_rm = jnp.where(rm_valid, jnp.log1p(mkt_d)[:, None], 0.0)
+
+    seg = lambda a: jax.ops.segment_sum(
+        a, week_id, num_segments=n_weeks
+    )
+    w_ri, w_rm = seg(log_ri), seg(log_rm)
+    w_rirm = seg(jnp.where(ri_valid & rm_valid, log_ri * log_rm, 0.0))
+    w_rm2 = seg(log_rm * log_rm)
+    w_cnt = seg(present.astype(log_ri.dtype))        # pl.count(): all rows
+
+    s_ri = _forward_windowed_sum(w_ri, window_weeks)
+    s_rm = _forward_windowed_sum(w_rm, window_weeks)
+    s_rirm = _forward_windowed_sum(w_rirm, window_weeks)
+    s_rm2 = _forward_windowed_sum(w_rm2, window_weeks)
+    n = _forward_windowed_sum(w_cnt, window_weeks)
+
+    n_safe = jnp.maximum(n, 1.0)
+    cov = s_rirm - s_ri * s_rm / n_safe
+    var = s_rm2 - s_rm * s_rm / n_safe
+    beta = cov / var  # var == 0 (e.g. single obs) -> ±inf/NaN flows, as in polars
+
+    # Window starts are emitted per firm from its first to its last obs week.
+    week_pos = jnp.arange(n_weeks)[:, None]
+    has = w_cnt > 0
+    first = jnp.min(jnp.where(has, week_pos, n_weeks), axis=0)
+    last = jnp.max(jnp.where(has, week_pos, -1), axis=0)
+    win_valid = (week_pos >= first[None, :]) & (week_pos <= last[None, :]) & (n >= 1)
+
+    return last_obs_per_month(beta, win_valid, week_month_id, n_months)

@@ -63,9 +63,24 @@ def fama_macbeth_summary(
     coef = jnp.where(enough, mean_slope, jnp.nan)
     tstat = jnp.where(enough, mean_slope / se, jnp.nan)
 
-    denom = jnp.maximum(n_months, 1).astype(cs.r2.dtype)
-    mean_r2 = jnp.sum(cs.r2 * mf) / denom
-    mean_n = jnp.sum(cs.n_obs.astype(cs.r2.dtype) * mf) / denom
+    # mean R² over months that ran AND have a finite R² (pandas .mean()
+    # skips NaN — a non-finite solve's R² must not poison the average);
+    # both means are NaN when no month ran (empty-frame .mean() is NaN,
+    # which Table 2 renders as a blank cell).
+    r2_valid = month_valid & jnp.isfinite(cs.r2)
+    r2_count = r2_valid.sum()
+    mean_r2 = jnp.where(
+        r2_count > 0,
+        jnp.sum(jnp.where(r2_valid, cs.r2, 0.0))
+        / jnp.maximum(r2_count, 1).astype(cs.r2.dtype),
+        jnp.nan,
+    )
+    mean_n = jnp.where(
+        n_months > 0,
+        jnp.sum(cs.n_obs.astype(cs.r2.dtype) * mf)
+        / jnp.maximum(n_months, 1).astype(cs.r2.dtype),
+        jnp.nan,
+    )
 
     return FamaMacbethSummary(coef, tstat, se, mean_r2, mean_n, n_months)
 
@@ -77,9 +92,10 @@ def fama_macbeth(
     nw_lags: int = 4,
     min_months: int = 10,
     weight: str = "reference",
+    solver: str = "lstsq",
 ) -> tuple[CSRegressionResult, FamaMacbethSummary]:
     """End-to-end FM: batched monthly OLS + aggregation, one jittable call."""
-    cs = monthly_cs_ols(y, x, mask)
+    cs = monthly_cs_ols(y, x, mask, solver=solver)
     return cs, fama_macbeth_summary(
         cs, nw_lags=nw_lags, min_months=min_months, weight=weight
     )

@@ -12,10 +12,13 @@ LAPACK calls) with ONE batched solve over the dense ``(T, N, P)`` panel:
   the per-month row count N are returned for every month with a validity
   flag instead of a ragged result list.
 
-TPU mapping: the Gram matrices ``XᵀX`` are one ``(T, N, P+1) × (T, N, P+1)``
-einsum that XLA tiles onto the MXU; the ``(P+1, P+1)`` solves are batched.
-``precision=HIGHEST`` keeps f32 matmuls out of bf16 truncation so single-chip
-f32 runs stay within the 1e-4 parity budget.
+TPU mapping: the default solver is a batched SVD least-squares on the
+``(T, N, P+1)`` design tensor (exact statsmodels/pinv parity, robust to the
+near-singular boundary months the reference's gate admits); ``solver=
+"normal"`` instead forms the Gram matrices with one big MXU einsum + tiny
+batched pinv — faster when months are well-conditioned. ``precision=HIGHEST``
+keeps f32 matmuls out of bf16 truncation so single-chip f32 runs stay within
+the 1e-4 parity budget.
 """
 
 from __future__ import annotations
@@ -48,9 +51,24 @@ def row_validity(y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarr
     return mask & jnp.isfinite(y) & jnp.all(jnp.isfinite(x), axis=-1)
 
 
-def _solve_month(y, x, valid):
-    """One month's masked OLS via normal equations. Shapes: y (N,), x (N, P),
-    valid (N,) bool."""
+def _solve_month(y, x, valid, solver="lstsq"):
+    """One month's masked OLS. Shapes: y (N,), x (N, P), valid (N,) bool.
+
+    ``solver="lstsq"`` (default): SVD least squares on the zero-padded design
+    matrix — the minimum-norm solution, numerically identical to
+    numpy ``lstsq``/statsmodels' pinv-based OLS even for ill-conditioned or
+    rank-deficient months. The reference's gate ``n >= P+1`` admits months
+    with exactly as many rows as design columns (intercept + P), which are
+    square and often NEARLY singular (observed cond(X) ~ 1e6 on synthetic
+    data); the Gram route squares that condition number and visibly drifts
+    from the reference there, while direct SVD does not. Zero rows leave
+    singular values/V untouched, so the padded solve equals the subset solve
+    exactly.
+
+    ``solver="normal"``: Gram pseudo-inverse (X⁺ = (XᵀX)⁺Xᵀ). One big MXU
+    einsum + tiny (P+1)² pinv — much faster, but squares the condition
+    number, so ill-conditioned months can drift from the reference.
+    """
     n = valid.sum()
     p_aug = x.shape[-1] + 1
 
@@ -60,34 +78,40 @@ def _solve_month(y, x, valid):
     x_aug = x_aug * v[:, None]
     y_z = jnp.where(valid, y, 0.0)
 
-    gram = jnp.einsum("np,nq->pq", x_aug, x_aug, precision=_PRECISION)
-    moment = jnp.einsum("np,n->p", x_aug, y_z, precision=_PRECISION)
-
     month_valid = n >= p_aug
-    safe_gram = jnp.where(month_valid, gram, jnp.eye(p_aug, dtype=gram.dtype))
-    # Pseudo-inverse of the Gram matrix: X⁺ = (XᵀX)⁺Xᵀ, so this equals the
-    # minimum-norm least-squares solution statsmodels' pinv-based OLS returns —
-    # finite even for singular months (e.g. a predictor constant across the
-    # cross-section in a thin subset), which a plain solve would turn into
-    # NaNs that poison the FM mean_R². The matrices are (P+1, P+1), so the
-    # batched SVD is negligible next to the Gram einsum.
-    beta = jnp.einsum(
-        "pq,q->p", jnp.linalg.pinv(safe_gram), moment, precision=_PRECISION
-    )
+    # default_matmul_precision keeps the lstsq SVD and the residual matmuls
+    # below off the bf16 MXU path on TPU f32 runs (1e-4 parity budget).
+    with jax.default_matmul_precision("highest"):
+        if solver == "lstsq":
+            beta, _, _, _ = jnp.linalg.lstsq(x_aug, y_z)
+        elif solver == "normal":
+            gram = jnp.einsum("np,nq->pq", x_aug, x_aug, precision=_PRECISION)
+            moment = jnp.einsum("np,n->p", x_aug, y_z, precision=_PRECISION)
+            safe_gram = jnp.where(month_valid, gram, jnp.eye(p_aug, dtype=gram.dtype))
+            beta = jnp.einsum(
+                "pq,q->p", jnp.linalg.pinv(safe_gram), moment, precision=_PRECISION
+            )
+        else:
+            raise ValueError(f"Unknown solver: {solver}")
+    # Skipped months carry zeros; a non-finite solve on a month that RAN is
+    # left as NaN — the reference's statsmodels would also emit NaN slopes
+    # and a NaN R² there, and the FM layer drops them per-column (.dropna()
+    # semantics) and skips the month's R² in the mean.
     beta = jnp.where(month_valid, beta, 0.0)
 
-    resid = (y_z - x_aug @ beta) * v
+    with jax.default_matmul_precision("highest"):
+        resid = (y_z - x_aug @ beta) * v
     sse = jnp.sum(resid * resid)
     ybar = jnp.where(n > 0, jnp.sum(y_z) / jnp.maximum(n, 1), 0.0)
     sst = jnp.sum(v * (y_z - ybar) ** 2)
     r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
-    r2 = jnp.where(month_valid, r2, 0.0)
+    r2 = jnp.where(month_valid, r2, 0.0)  # NaN sse (non-finite solve) flows
 
     return beta[1:], beta[0], r2, n, month_valid
 
 
 def monthly_cs_ols(
-    y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray
+    y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray, solver: str = "lstsq"
 ) -> CSRegressionResult:
     """Run every month's cross-sectional regression in one batched call.
 
@@ -104,5 +128,7 @@ def monthly_cs_ols(
     reference's "skip month" continue at ``src/regressions.py:52-54``).
     """
     valid = row_validity(y, x, mask)
-    slopes, intercept, r2, n_obs, month_valid = jax.vmap(_solve_month)(y, x, valid)
+    slopes, intercept, r2, n_obs, month_valid = jax.vmap(
+        lambda yy, xx, vv: _solve_month(yy, xx, vv, solver=solver)
+    )(y, x, valid)
     return CSRegressionResult(slopes, intercept, r2, n_obs, month_valid)

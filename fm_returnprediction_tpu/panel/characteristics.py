@@ -1,0 +1,230 @@
+"""The characteristic engine: all 15 Lewellen firm-month variables on device.
+
+Replaces the reference's 14 pandas ``calc_*`` kernels plus orchestrator
+(``src/calc_Lewellen_2014.py:137-574``) with one jitted device computation
+over the dense monthly panel (lags and rolling windows on the per-firm
+compacted axis, reproducing ``groupby("permno")`` row semantics) plus the two
+daily kernels (``ops.daily_kernels``). Winsorization at [1%, 99%] per month
+over the full cross-section runs last, as in ``get_factors``
+(``src/calc_Lewellen_2014.py:572``).
+
+Variable definitions (reference lines in parentheses; quirks preserved —
+parity targets the reference, not the paper):
+
+- ``log_size``        = log(me_{t-1})                              (:137-148)
+- ``log_bm``          = log(be_{t-1}) − log(me_{t-1})              (:150-163)
+- ``return_12_2``     = prod(1+retx_{t-12..t-2}) − 1, 11 full rows (:166-192)
+- ``accruals_final``  = accruals − depreciation (annual, ffilled)  (:195-204)
+- ``roa``             = earnings / assets  (END-of-year assets — the
+                        reference ignores its own "average assets" docstring,
+                        SURVEY §2.2.10)                            (:241-249)
+- ``log_assets_growth`` = log(assets_t / assets_{t-12})            (:252-262)
+- ``dy``              = 12-row sum of annual-ffilled dvc / prc_{t-1} (~12×
+                        the annual dividend — reference quirk,
+                        SURVEY §2.2.11)                            (:265-287)
+- ``log_return_13_36``= 24-row sum of log(1+retx) shifted 13       (:290-313)
+- ``log_issues_12/36``= log(shrout_{t-1}) − log(shrout_{t-12/36})  (:207-238)
+- ``debt_price``      = total_debt / me_{t-1}                      (:316-327)
+- ``sales_price``     = sales / me_{t-1}                           (:330-341)
+- ``beta``            = weekly-grid rolling beta                   (:344-434)
+- ``rolling_std_252`` = annualized 252-day rolling std             (:438-465)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.ops.compaction import compact, lag, make_compaction, scatter_back
+from fm_returnprediction_tpu.ops.daily_kernels import (
+    rolling_vol_252_monthly,
+    weekly_rolling_beta_monthly,
+)
+from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
+from fm_returnprediction_tpu.ops.rolling import rolling_prod, rolling_sum
+from fm_returnprediction_tpu.panel.daily import build_daily_panel
+from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
+
+__all__ = ["FACTORS_DICT", "BASE_COLUMNS", "compute_monthly_characteristics", "get_factors"]
+
+# Display-name → column map, matching the notebook's working mapping
+# (reference cell 24; the .py's "rolling_beta" name is the known defect
+# SURVEY §2.2.3 — the working name is "beta").
+FACTORS_DICT: Dict[str, str] = {
+    "Return (%)": "retx",
+    "Log Size (-1)": "log_size",
+    "Log B/M (-1)": "log_bm",
+    "Return (-2, -12)": "return_12_2",
+    "Log Issues (-1,-12)": "log_issues_12",
+    "Accruals (-1)": "accruals_final",
+    "ROA (-1)": "roa",
+    "Log Assets Growth (-1)": "log_assets_growth",
+    "Dividend Yield (-1,-12)": "dy",
+    "Log Return (-13,-36)": "log_return_13_36",
+    "Log Issues (-1,-36)": "log_issues_36",
+    "Beta (-1,-36)": "beta",
+    "Std Dev (-1,-12)": "rolling_std_252",
+    "Debt/Price (-1)": "debt_price",
+    "Sales/Price (-1)": "sales_price",
+}
+
+BASE_COLUMNS = [
+    "retx",
+    "prc",
+    "shrout",
+    "me",
+    "be",
+    "accruals",
+    "depreciation",
+    "earnings",
+    "assets",
+    "sales",
+    "total_debt",
+    "dvc",
+    "is_nyse",
+]
+
+_MONTHLY_OUT = [
+    "log_size",
+    "log_bm",
+    "return_12_2",
+    "accruals_final",
+    "roa",
+    "log_assets_growth",
+    "dy",
+    "log_return_13_36",
+    "log_issues_12",
+    "log_issues_36",
+    "debt_price",
+    "sales_price",
+]
+
+
+@partial(jax.jit, static_argnames=("var_index",))
+def compute_monthly_characteristics(
+    values: jnp.ndarray, mask: jnp.ndarray, var_index: tuple
+) -> Dict[str, jnp.ndarray]:
+    """All monthly (non-daily) characteristics in one fused device call.
+
+    ``values``: (T, N, K) base panel; ``var_index``: static tuple of
+    (name, index) pairs locating BASE_COLUMNS in K.
+    """
+    idx = dict(var_index)
+    plan = make_compaction(mask)
+
+    def comp(name):
+        v = compact(values[:, :, idx[name]], plan)
+        return jnp.where(plan.valid, v, jnp.nan)
+
+    retx, prc, shrout = comp("retx"), comp("prc"), comp("shrout")
+    me, be = comp("me"), comp("be")
+    accruals, depreciation = comp("accruals"), comp("depreciation")
+    earnings, assets = comp("earnings"), comp("assets")
+    sales, total_debt, dvc = comp("sales"), comp("total_debt"), comp("dvc")
+
+    me_lag, be_lag = lag(me, 1), lag(be, 1)
+    out = {
+        "log_size": jnp.log(me_lag),
+        "log_bm": jnp.log(be_lag) - jnp.log(me_lag),
+        "return_12_2": rolling_prod(1.0 + lag(retx, 2), 11, 11) - 1.0,
+        "accruals_final": accruals - depreciation,
+        "roa": earnings / assets,
+        "log_assets_growth": jnp.log(assets / lag(assets, 12)),
+        "dy": rolling_sum(dvc, 12, 1) / lag(prc, 1),
+        "log_return_13_36": rolling_sum(lag(jnp.log1p(retx), 13), 24, 24),
+        "log_issues_12": jnp.log(lag(shrout, 1)) - jnp.log(lag(shrout, 12)),
+        "log_issues_36": jnp.log(lag(shrout, 1)) - jnp.log(lag(shrout, 36)),
+        "debt_price": total_debt / me_lag,
+        "sales_price": sales / me_lag,
+    }
+    return {name: scatter_back(arr, plan) for name, arr in out.items()}
+
+
+@partial(jax.jit, static_argnames=("var_names", "winsorize_names"))
+def _winsorize_panel(
+    values: jnp.ndarray, mask: jnp.ndarray, var_names: tuple, winsorize_names: tuple
+) -> jnp.ndarray:
+    """Winsorize the named variables per month over the full cross-section."""
+    cols = []
+    for k, name in enumerate(var_names):
+        col = values[:, :, k]
+        if name in winsorize_names:
+            col = winsorize_cs(col, mask)
+        cols.append(col)
+    return jnp.stack(cols, axis=-1)
+
+
+def get_factors(
+    crsp_comp: pd.DataFrame,
+    crsp_d: pd.DataFrame,
+    crsp_index_d: pd.DataFrame,
+    dtype=np.float64,
+) -> Tuple[DensePanel, Dict[str, str]]:
+    """Dense-panel equivalent of the reference's ``get_factors``
+    (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
+    and winsorizes them, returning the enriched panel and the display-name map.
+
+    ``crsp_comp`` is the merged monthly panel (needs BASE_COLUMNS sources +
+    permno/jdate/primaryexch); ``crsp_d``/``crsp_index_d`` the daily data.
+    """
+    df = crsp_comp.copy()
+    df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
+    panel = long_to_dense(df, "jdate", "permno", BASE_COLUMNS, dtype=dtype)
+
+    var_index = tuple((name, panel.var_index(name)) for name in BASE_COLUMNS)
+    monthly = compute_monthly_characteristics(
+        jnp.asarray(panel.values), jnp.asarray(panel.mask), var_index
+    )
+
+    daily = build_daily_panel(crsp_d, crsp_index_d, panel.months, dtype=dtype)
+    vol = rolling_vol_252_monthly(
+        jnp.asarray(daily.ret),
+        jnp.asarray(daily.mask),
+        jnp.asarray(daily.day_month_id),
+        daily.n_months,
+    )
+    beta = weekly_rolling_beta_monthly(
+        jnp.asarray(daily.ret),
+        jnp.asarray(daily.mask),
+        jnp.asarray(daily.mkt),
+        jnp.asarray(daily.week_id),
+        daily.n_weeks,
+        jnp.asarray(daily.week_month_id),
+        daily.n_months,
+        mkt_present=jnp.asarray(daily.mkt_present),
+    )
+
+    # Align daily-firm columns onto the monthly panel's permno vocabulary
+    # (left-merge semantics: monthly firms absent from daily data get NaN).
+    vol_np, beta_np = np.asarray(vol), np.asarray(beta)
+    pos = np.searchsorted(daily.ids, panel.ids)
+    pos_c = np.clip(pos, 0, len(daily.ids) - 1)
+    hit = daily.ids[pos_c] == panel.ids          # (N,) daily data exists
+    keep = hit[None, :] & panel.mask             # left-merge: panel rows only
+    vol_m = np.where(keep, vol_np[:, pos_c], np.nan)
+    beta_m = np.where(keep, beta_np[:, pos_c], np.nan)
+
+    new_vars = {name: np.asarray(arr) for name, arr in monthly.items()}
+    new_vars["rolling_std_252"] = vol_m
+    new_vars["beta"] = beta_m
+    enriched = panel.with_vars(new_vars)
+
+    winsorized = _winsorize_panel(
+        jnp.asarray(enriched.values),
+        jnp.asarray(enriched.mask),
+        tuple(enriched.var_names),
+        tuple(FACTORS_DICT.values()),
+    )
+    final = DensePanel(
+        values=np.asarray(winsorized),
+        mask=enriched.mask,
+        months=enriched.months,
+        ids=enriched.ids,
+        var_names=enriched.var_names,
+    )
+    return final, dict(FACTORS_DICT)

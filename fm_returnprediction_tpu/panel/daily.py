@@ -1,0 +1,103 @@
+"""Daily dense panel construction and calendar mappings (host side).
+
+Builds the (D, N) daily return panel plus the integer index maps the daily
+kernels need: per-day month index into a monthly vocabulary and per-day /
+per-week Monday-lattice indices (polars ``truncate("1w")`` anchors weeks on
+Mondays). Out-of-vocabulary months map to the trash segment ``n_months``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+from pandas.tseries.offsets import MonthEnd
+
+from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
+
+__all__ = ["DailyPanel", "build_daily_panel", "month_index_of"]
+
+
+def month_index_of(dates: pd.DatetimeIndex, months: np.ndarray) -> np.ndarray:
+    """Map month-end timestamps to indices in the monthly vocabulary
+    (``months``, sorted datetime64); unmatched dates map to ``len(months)``."""
+    # Unit-robust: pandas 3 frames may carry datetime64[us]/[s]/[ns]; compare
+    # everything at second resolution.
+    months_i8 = np.asarray(pd.DatetimeIndex(months), dtype="datetime64[s]").astype(np.int64)
+    dates_i8 = np.asarray(pd.DatetimeIndex(dates), dtype="datetime64[s]").astype(np.int64)
+    pos = np.searchsorted(months_i8, dates_i8)
+    pos_clipped = np.minimum(pos, len(months_i8) - 1)
+    hit = months_i8[pos_clipped] == dates_i8
+    return np.where(hit, pos_clipped, len(months_i8)).astype(np.int32)
+
+
+@dataclasses.dataclass
+class DailyPanel:
+    """Dense daily data aligned to a monthly panel's vocabularies."""
+
+    ret: np.ndarray            # (D, N) daily retx
+    prc: np.ndarray            # (D, N) daily price
+    mask: np.ndarray           # (D, N) firm-day present
+    mkt: np.ndarray            # (D,) market return (vwretx), NaN if absent/null
+    mkt_present: np.ndarray    # (D,) bool, index table has a row for the day
+    days: np.ndarray           # (D,) datetime64
+    ids: np.ndarray            # (N,) permnos
+    day_month_id: np.ndarray   # (D,) month index into monthly vocab (trash=M)
+    week_id: np.ndarray        # (D,) Monday-lattice week index
+    n_weeks: int
+    week_month_id: np.ndarray  # (n_weeks,) month index of each week's Monday
+    n_months: int
+
+
+def build_daily_panel(
+    crsp_d: pd.DataFrame,
+    crsp_index_d: pd.DataFrame,
+    months: np.ndarray,
+    dtype=np.float64,
+) -> DailyPanel:
+    """Pack daily CRSP + index data into dense arrays aligned to ``months``.
+
+    ``crsp_d`` needs [permno, dlycaldt, retx, prc]; ``crsp_index_d`` needs
+    [caldt, vwretx]. The market series is aligned to the observed trading-day
+    vocabulary of ``crsp_d`` (days the index lacks become NaN → excluded from
+    beta, reproducing the reference's inner join at
+    ``src/calc_Lewellen_2014.py:380``).
+    """
+    dense = long_to_dense(crsp_d, "dlycaldt", "permno", ["retx", "prc"], dtype=dtype)
+    days = pd.DatetimeIndex(dense.months)
+
+    idx = crsp_index_d.drop_duplicates(subset=["caldt"], keep="last").set_index("caldt")
+    mkt = idx["vwretx"].reindex(days).to_numpy(dtype=dtype)
+    mkt_present = days.isin(idx.index).to_numpy() if hasattr(
+        days.isin(idx.index), "to_numpy"
+    ) else np.asarray(days.isin(idx.index))
+
+    day_month = days + MonthEnd(0)
+    day_month_id = month_index_of(day_month, months)
+
+    # Monday lattice: numpy day-of-epoch arithmetic (1970-01-01 was a Thursday,
+    # so epoch day 4 was the first Monday; (d + 3) // 7 indexes Monday weeks).
+    epoch_days = np.asarray(days, dtype="datetime64[D]").astype(np.int64)
+    monday_week = (epoch_days + 3) // 7
+    week0 = monday_week.min()
+    week_id = (monday_week - week0).astype(np.int32)
+    n_weeks = int(week_id.max()) + 1
+
+    week_mondays = pd.to_datetime((np.arange(n_weeks) + week0) * 7 - 3, unit="D")
+    week_month_id = month_index_of(week_mondays + MonthEnd(0), months)
+
+    return DailyPanel(
+        ret=dense.var("retx"),
+        prc=dense.var("prc"),
+        mask=dense.mask,
+        mkt=mkt,
+        mkt_present=mkt_present,
+        days=dense.months,
+        ids=dense.ids,
+        day_month_id=day_month_id,
+        week_id=week_id,
+        n_weeks=n_weeks,
+        week_month_id=week_month_id,
+        n_months=len(months),
+    )

@@ -1,0 +1,45 @@
+"""NYSE-breakpoint stock universes as subset masks.
+
+The reference builds three COPIES of the panel DataFrame (All /
+All-but-tiny / Large, ``get_subsets``, ``src/calc_Lewellen_2014.py:44-112``).
+On the dense panel a universe is just a (T, N) boolean mask over the shared
+arrays — no copies, and every downstream reduction simply ANDs its mask,
+which is the TPU-idiomatic form (subset masks ride along with shardings).
+
+Rules (reference lines): monthly 20th/50th percentiles of NYSE market equity
+(pandas linear-interpolated ``.quantile``); a month with no NYSE stocks has
+NaN breakpoints, so its rows drop out of the two filtered universes
+(NaN comparisons are False).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.quantiles import masked_quantile
+from fm_returnprediction_tpu.panel.dense import DensePanel
+
+__all__ = ["SUBSET_ORDER", "compute_subset_masks"]
+
+SUBSET_ORDER = ["All stocks", "All-but-tiny stocks", "Large stocks"]
+
+
+def compute_subset_masks(panel: DensePanel) -> Dict[str, jnp.ndarray]:
+    """(T, N) boolean masks for the three universes.
+
+    Needs panel variables ``me`` and ``is_nyse`` (1.0 for NYSE rows).
+    """
+    me = jnp.asarray(panel.var("me"))
+    mask = jnp.asarray(panel.mask)
+    nyse = mask & (jnp.asarray(panel.var("is_nyse")) > 0)
+
+    breakpoints = masked_quantile(me, nyse, jnp.asarray([0.2, 0.5]))  # (T, 2)
+    me_20, me_50 = breakpoints[:, 0][:, None], breakpoints[:, 1][:, None]
+
+    return {
+        "All stocks": mask,
+        "All-but-tiny stocks": mask & (me >= me_20),
+        "Large stocks": mask & (me >= me_50),
+    }

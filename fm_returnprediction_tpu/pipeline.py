@@ -1,0 +1,178 @@
+"""End-to-end Lewellen pipeline driver.
+
+The working equivalent of the reference's driver notebook
+(``src/get_data.ipynb`` cells 0-32 — the ``calc_Lewellen_2014.py`` script
+entry is broken, SURVEY §2.2) as a plain function: load the five cached raw
+datasets (or a synthetic universe), run the relational transforms, compute
+all characteristics on device, build subset masks, and produce Table 1,
+Table 2, Figure 1 and the LaTeX report.
+
+Run it:
+
+    python -m fm_returnprediction_tpu.pipeline --synthetic --output-dir /tmp/out
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.panel.characteristics import get_factors
+from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+from fm_returnprediction_tpu.panel.transform_compustat import (
+    add_report_date,
+    calc_book_equity,
+    expand_compustat_annual_to_monthly,
+    merge_CRSP_and_Compustat,
+)
+from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
+from fm_returnprediction_tpu.data.wrds_pull import subset_to_common_stock_and_exchanges
+from fm_returnprediction_tpu.reporting.figure1 import create_figure_1
+from fm_returnprediction_tpu.reporting.latex import (
+    compile_latex_document,
+    create_latex_document,
+    save_data,
+)
+from fm_returnprediction_tpu.reporting.table1 import build_table_1
+from fm_returnprediction_tpu.reporting.table2 import build_table_2
+from fm_returnprediction_tpu.utils.cache import load_cache_data
+from fm_returnprediction_tpu.utils.timing import StageTimer
+
+__all__ = ["PipelineResult", "load_raw_data", "build_panel", "run_pipeline"]
+
+RAW_FILE_NAMES = {
+    "comp": "Compustat_fund.parquet",
+    "ccm": "CRSP_Comp_Link_Table.parquet",
+    "crsp_d": "CRSP_stock_d.parquet",
+    "crsp_m": "CRSP_stock_m.parquet",
+    "crsp_index_d": "CRSP_index_d.parquet",
+}
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    panel: DensePanel
+    factors_dict: Dict[str, str]
+    subset_masks: Dict
+    table_1: pd.DataFrame
+    table_2: pd.DataFrame
+    figure_1: Optional[tuple]
+    timer: StageTimer
+
+
+def load_raw_data(raw_data_dir) -> Dict[str, pd.DataFrame]:
+    """Load the five cached raw datasets by their canonical file names
+    (reference ``src/calc_Lewellen_2014.py:1236-1240``)."""
+    return {
+        key: load_cache_data(raw_data_dir, name) for key, name in RAW_FILE_NAMES.items()
+    }
+
+
+def build_panel(
+    data: Dict[str, pd.DataFrame], dtype=np.float64
+) -> tuple[DensePanel, Dict[str, str]]:
+    """Raw frames → merged monthly panel → dense characteristic panel.
+
+    The common-stock/exchange universe filter is applied to BOTH the monthly
+    and daily data here, regardless of whether the raw frames came from a
+    cache (the reference filters only on fresh pulls and returns unfiltered
+    frames on cache hits — defect SURVEY §2.2.7; this framework filters
+    consistently)."""
+    crsp_m = subset_to_common_stock_and_exchanges(data["crsp_m"])
+    data = {**data, "crsp_m": crsp_m, "crsp_d": subset_to_common_stock_and_exchanges(data["crsp_d"])}
+    crsp = calculate_market_equity(data["crsp_m"])
+    comp = add_report_date(data["comp"].copy())
+    comp = calc_book_equity(comp)
+    comp = expand_compustat_annual_to_monthly(comp)
+    merged = merge_CRSP_and_Compustat(crsp, comp, data["ccm"])
+    if "mthcaldt" not in merged.columns:
+        merged["mthcaldt"] = merged["jdate"]
+    return get_factors(merged, data["crsp_d"], data["crsp_index_d"], dtype=dtype)
+
+
+def run_pipeline(
+    raw_data_dir=None,
+    output_dir=None,
+    synthetic: bool = False,
+    synthetic_config: Optional[SyntheticConfig] = None,
+    dtype=np.float64,
+    make_figure: bool = True,
+    compile_pdf: bool = True,
+) -> PipelineResult:
+    """The full Lewellen pipeline: data → panel → tables/figure → artifacts."""
+    timer = StageTimer()
+
+    with timer.stage("load_raw_data"):
+        if synthetic:
+            data = generate_synthetic_wrds(synthetic_config)
+        else:
+            data = load_raw_data(raw_data_dir)
+
+    with timer.stage("build_panel"):
+        panel, factors_dict = build_panel(data, dtype=dtype)
+
+    with timer.stage("subset_masks"):
+        subset_masks = compute_subset_masks(panel)
+
+    with timer.stage("table_1"):
+        table_1 = build_table_1(panel, subset_masks, factors_dict)
+
+    with timer.stage("table_2"):
+        table_2 = build_table_2(panel, subset_masks, factors_dict)
+
+    figure_1 = None
+    if make_figure:
+        with timer.stage("figure_1"):
+            figure_1 = create_figure_1(panel, subset_masks)
+
+    if output_dir is not None:
+        with timer.stage("save_artifacts"):
+            save_data(table_1, table_2, figure_1, output_dir)
+            tex = create_latex_document(output_dir)
+            if tex is not None and compile_pdf:
+                compile_latex_document(tex)
+
+    return PipelineResult(
+        panel=panel,
+        factors_dict=factors_dict,
+        subset_masks=subset_masks,
+        table_1=table_1,
+        table_2=table_2,
+        figure_1=figure_1,
+        timer=timer,
+    )
+
+
+def _main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Run the Lewellen FM pipeline.")
+    parser.add_argument("--raw-data-dir", default=None)
+    parser.add_argument("--output-dir", default=None)
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--firms", type=int, default=100)
+    parser.add_argument("--months", type=int, default=120)
+    args = parser.parse_args()
+
+    cfg = SyntheticConfig(n_firms=args.firms, n_months=args.months)
+    result = run_pipeline(
+        raw_data_dir=args.raw_data_dir,
+        output_dir=args.output_dir,
+        synthetic=args.synthetic,
+        synthetic_config=cfg if args.synthetic else None,
+    )
+    print(result.table_1.round(3).to_string())
+    print()
+    print(result.table_2.to_string())
+    print()
+    print(result.timer.report())
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,104 @@
+"""Figure 1 — 10-year rolling Fama-MacBeth slopes, two stacked panels.
+
+Re-provides the reference's ``create_figure_1``
+(``src/calc_Lewellen_2014.py:871-957``): per subset ("All stocks" and
+"Large stocks"), monthly cross-sectional OLS of retx on the FIGURE's own
+5-variable set (complete-case over exactly those columns), then a 120-month
+rolling mean (min 60) over the CONSECUTIVE result months (row-based, as
+pandas ``rolling`` on the slope frame). The reference re-implements the
+monthly loop inline (``:910-922``, duplicating L5); here the same batched
+kernel serves both paths.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
+from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
+from fm_returnprediction_tpu.ops.rolling import rolling_mean
+from fm_returnprediction_tpu.panel.dense import DensePanel
+
+__all__ = ["rolling_slopes", "create_figure_1"]
+
+
+def rolling_slopes(
+    panel: DensePanel,
+    subset_mask: jnp.ndarray,
+    window: int = 120,
+    min_periods: int = 60,
+    return_col: str = "retx",
+) -> pd.DataFrame:
+    """120-month rolling mean of monthly Model-2(figure) slopes for one subset.
+
+    Returns a DataFrame indexed by month with one column per figure variable.
+    """
+    xvars = list(FIGURE1_VARS.keys())
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(xvars))
+    cs = monthly_cs_ols(y, x, jnp.asarray(subset_mask))
+
+    # Compact the surviving months to the front (chronological), roll over
+    # consecutive result rows, then label by the surviving months' dates.
+    valid = cs.month_valid
+    order = jnp.argsort(~valid, stable=True)
+    in_range = (jnp.arange(valid.shape[0]) < valid.sum())[:, None]
+    comp_slopes = jnp.where(in_range, cs.slopes[order], jnp.nan)
+    rolled = rolling_mean(comp_slopes, window, min_periods)
+
+    n_valid = int(valid.sum())
+    months = pd.DatetimeIndex(panel.months)[np.asarray(valid)]
+    frame = pd.DataFrame(
+        np.asarray(rolled)[:n_valid], index=months, columns=xvars
+    )
+    frame.index.name = "mthcaldt"
+    return frame
+
+
+def create_figure_1(
+    panel: DensePanel,
+    subset_masks: Dict[str, jnp.ndarray],
+    save_plot: bool = False,
+    output_dir=None,
+) -> Tuple[object, object]:
+    """Two stacked panels (All / Large stocks) of 10-year rolling slopes."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    slopes_dict = {}
+    for subset_name in ["All stocks", "Large stocks"]:
+        if subset_name in subset_masks:
+            slopes_dict[subset_name] = rolling_slopes(
+                panel, subset_masks[subset_name]
+            )
+
+    fig, axes = plt.subplots(nrows=2, ncols=1, figsize=(14, 10), sharex=True)
+    panel_specs = [
+        ("All stocks", axes[0], "Panel A: All Stocks (10-Year Rolling Slopes)"),
+        ("Large stocks", axes[1], "Panel B: Large Stocks (10-Year Rolling Slopes)"),
+    ]
+    for subset_name, ax, title in panel_specs:
+        if subset_name not in slopes_dict:
+            continue
+        frame = slopes_dict[subset_name]
+        for var, label in FIGURE1_VARS.items():
+            ax.plot(frame.index, frame[var], label=label)
+        ax.set_title(title)
+        ax.set_ylabel("Slope Coefficient")
+        ax.legend()
+        ax.margins(x=0)
+    axes[1].set_xlabel("Month")
+    fig.tight_layout()
+
+    if save_plot and output_dir is not None:
+        from pathlib import Path
+
+        Path(output_dir).mkdir(parents=True, exist_ok=True)
+        fig.savefig(Path(output_dir) / "figure_1.pdf", bbox_inches="tight")
+    return fig, axes

@@ -1,0 +1,91 @@
+"""Table 1 — time-series averages of monthly cross-sectional statistics.
+
+Dense re-provision of the reference's ``build_table_1``
+(``src/calc_Lewellen_2014.py:577-670``), same output contract:
+MultiIndex columns (subset, {Avg, Std, N}), one row per display variable.
+
+Semantics preserved exactly:
+- ±inf treated as missing (``:625``);
+- monthly cross-sectional std is the sample std (ddof=1) — months with one
+  observation contribute NaN and are skipped by the time-series average;
+- Avg averages monthly means over months with ≥1 valid observation;
+- N is the number of DISTINCT firms ever valid for the variable in the
+  subset (``:643-644``), not an average count.
+
+One jitted device call computes every (variable × subset) cell batch-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.panel.dense import DensePanel
+
+__all__ = ["build_table_1", "table1_stats"]
+
+
+@jax.jit
+def table1_stats(values: jnp.ndarray, subset_mask: jnp.ndarray):
+    """Per-variable stats under one subset mask.
+
+    values: (T, N, K); subset_mask: (T, N) → (avg (K,), std (K,), n (K,)).
+    """
+    valid = subset_mask[:, :, None] & jnp.isfinite(values)
+    x = jnp.where(valid, values, 0.0)
+    cnt = valid.sum(axis=1)                                    # (T, K)
+    cf = cnt.astype(x.dtype)
+    mean_t = x.sum(axis=1) / jnp.maximum(cf, 1.0)
+
+    # Two-pass variance: the one-pass Σx² − n·mean² form cancels
+    # catastrophically for near-constant cross-sections.
+    centered = jnp.where(valid, values - mean_t[:, None, :], 0.0)
+    var_t = (centered**2).sum(axis=1) / jnp.maximum(cf - 1.0, 1.0)
+    std_t = jnp.sqrt(var_t)
+
+    has_mean = cnt >= 1
+    has_std = cnt >= 2
+    avg = jnp.sum(jnp.where(has_mean, mean_t, 0.0), axis=0) / jnp.maximum(
+        has_mean.sum(axis=0), 1
+    )
+    std = jnp.sum(jnp.where(has_std, std_t, 0.0), axis=0) / jnp.maximum(
+        has_std.sum(axis=0), 1
+    )
+    n_distinct = jnp.any(valid, axis=0).sum(axis=0)            # (K,)
+
+    month_count = has_mean.sum(axis=0)
+    avg = jnp.where(month_count > 0, avg, jnp.nan)
+    std = jnp.where(has_std.sum(axis=0) > 0, std, jnp.nan)
+    return avg, std, n_distinct
+
+
+def build_table_1(
+    panel: DensePanel,
+    subset_masks: Dict[str, jnp.ndarray],
+    variables_dict: Dict[str, str],
+) -> pd.DataFrame:
+    """Assemble the reference-layout Table 1 DataFrame."""
+    var_cols = [panel.var_index(col) for col in variables_dict.values()]
+    values = jnp.asarray(panel.values[:, :, var_cols])
+
+    partials = []
+    for subset_name, mask in subset_masks.items():
+        avg, std, n = table1_stats(values, jnp.asarray(mask))
+        partial = pd.DataFrame(
+            {
+                "Avg": np.asarray(avg),
+                "Std": np.asarray(std),
+                "N": np.asarray(n),
+            },
+            index=list(variables_dict.keys()),
+        )
+        partial.columns = pd.MultiIndex.from_product([[subset_name], partial.columns])
+        partials.append(partial)
+
+    table = pd.concat(partials, axis=1)
+    table.index.name = "Column"
+    return table

@@ -110,3 +110,128 @@ def make_synthetic_long_panel(
                 row[col] = np.nan if rng.random() < missing_frac else rng.normal()
             records.append(row)
     return pd.DataFrame(records), pred_cols
+
+
+# ---------------------------------------------------------------------------
+# Characteristic oracles (reference formulas in pandas, loop-based and slow)
+# ---------------------------------------------------------------------------
+
+
+def _groupby_rolling(df, col, window, min_periods, fn):
+    out = (
+        df.groupby("permno")[col]
+        .rolling(window=window, min_periods=min_periods)
+        .apply(fn, raw=True)
+        if fn is not None
+        else df.groupby("permno")[col].rolling(window=window, min_periods=min_periods).sum()
+    )
+    return out.reset_index(level=0, drop=True)
+
+
+def oracle_monthly_characteristics(crsp_comp: pd.DataFrame) -> pd.DataFrame:
+    """The 12 monthly characteristics, transcribing the reference formulas
+    (src/calc_Lewellen_2014.py:137-341)."""
+    df = crsp_comp.sort_values(["permno", "mthcaldt"], kind="stable").copy()
+    g = lambda col: df.groupby("permno")[col]
+
+    df["log_size"] = np.log(g("me").shift(1))
+    df["log_bm"] = np.log(g("be").shift(1)) - np.log(g("me").shift(1))
+
+    df["_one_plus"] = 1 + g("retx").shift(2)
+    df["return_12_2"] = _groupby_rolling(df, "_one_plus", 11, 11, np.prod) - 1
+
+    df["accruals_final"] = df["accruals"] - df["depreciation"]
+    df["roa"] = df["earnings"] / df["assets"]
+    df["log_assets_growth"] = np.log(df["assets"] / g("assets").shift(12))
+
+    df["_div12"] = _groupby_rolling(df, "dvc", 12, 1, None)
+    df["dy"] = df["_div12"] / g("prc").shift(1)
+
+    df["_l13"] = df.groupby("permno")["retx"].transform(lambda s: np.log1p(s).shift(13))
+    # .rolling().sum() (the reference's call), NOT .apply(np.sum): they
+    # differ when a window holds -inf from a -100% return (sum -> NaN).
+    df["log_return_13_36"] = _groupby_rolling(df, "_l13", 24, 24, None)
+
+    df["log_issues_12"] = np.log(g("shrout").shift(1)) - np.log(g("shrout").shift(12))
+    df["log_issues_36"] = np.log(g("shrout").shift(1)) - np.log(g("shrout").shift(36))
+    df["debt_price"] = df["total_debt"] / g("me").shift(1)
+    df["sales_price"] = df["sales"] / g("me").shift(1)
+
+    return df.drop(columns=["_one_plus", "_div12", "_l13"])
+
+
+def oracle_std_12(crsp_d: pd.DataFrame, crsp_comp: pd.DataFrame) -> pd.DataFrame:
+    """252-day rolling std sampled at month end (src/calc_Lewellen_2014.py:438-465)."""
+    d = crsp_d.sort_values(["permno", "dlycaldt"], kind="stable").copy()
+    d["rolling_std_252"] = (
+        d.groupby("permno")["retx"]
+        .rolling(window=252, min_periods=100)
+        .std()
+        .reset_index(level=0, drop=True)
+        * np.sqrt(252)
+    )
+    d["jdate"] = d["dlycaldt"].dt.to_period("M").dt.to_timestamp("M")
+    d = d.drop_duplicates(subset=["permno", "jdate"], keep="last")
+    return crsp_comp.merge(
+        d[["permno", "jdate", "rolling_std_252"]], on=["permno", "jdate"], how="left"
+    )
+
+
+def oracle_weekly_beta(crsp_d: pd.DataFrame, crsp_index_d: pd.DataFrame,
+                       crsp_comp: pd.DataFrame) -> pd.DataFrame:
+    """Weekly-grid forward-window rolling beta, loop transcription of the
+    polars group_by_dynamic semantics (src/calc_Lewellen_2014.py:344-434):
+    Monday-lattice window starts per firm from first to last observation,
+    window [start, start + 156 weeks), label = start, month-end stamp of the
+    label, keep-last per (permno, month)."""
+    joined = crsp_d[["permno", "dlycaldt", "retx"]].merge(
+        crsp_index_d[["caldt", "vwretx"]].rename(columns={"caldt": "dlycaldt"}),
+        on="dlycaldt",
+    )
+    joined["ri"] = np.log1p(joined["retx"])
+    joined["rm"] = np.log1p(joined["vwretx"])
+    joined = joined.sort_values(["permno", "dlycaldt"], kind="stable")
+
+    rows = []
+    for permno, grp in joined.groupby("permno"):
+        dates = grp["dlycaldt"]
+        week_start = dates - pd.to_timedelta(dates.dt.weekday, unit="D")
+        starts = pd.date_range(week_start.min(), week_start.max(), freq="7D")
+        for start in starts:
+            win = grp[(dates >= start) & (dates < start + pd.Timedelta(weeks=156))]
+            n = len(win)
+            if n == 0:
+                continue
+            # polars semantics: pl.DataFrame(pandas_df) converts NaN->null
+            # (nan_to_null=True default), aggregate sums SKIP nulls, but
+            # pl.count() counts ALL rows in the window -> null-skipping sums
+            # over a row-count denominator (pandas skipna sums match).
+            s_ri, s_rm = win["ri"].sum(), win["rm"].sum()
+            s_rirm = (win["ri"] * win["rm"]).sum()
+            s_rm2 = (win["rm"] ** 2).sum()
+            denom = s_rm2 - s_rm**2 / n
+            beta = (s_rirm - s_ri * s_rm / n) / denom if denom != 0 else np.nan
+            rows.append({"permno": permno, "date": start, "beta": beta})
+
+    b = pd.DataFrame(rows)
+    b["jdate"] = b["date"].dt.to_period("M").dt.to_timestamp("M")
+    b = b.drop_duplicates(subset=["permno", "jdate"], keep="last")
+    return crsp_comp.merge(b[["permno", "jdate", "beta"]], on=["permno", "jdate"], how="left")
+
+
+def oracle_winsorize(crsp_comp: pd.DataFrame, varlist) -> pd.DataFrame:
+    """Per-month [1%, 99%] clip, skipping months with <5 valid obs
+    (src/calc_Lewellen_2014.py:505-529)."""
+    df = crsp_comp.sort_values(["mthcaldt", "permno"], kind="stable").copy()
+    for var in varlist:
+        parts = []
+        for _, sub in df.groupby("mthcaldt"):
+            vals = sub[var].dropna()
+            if len(vals) >= 5:
+                low = np.percentile(vals, 1)
+                high = np.percentile(vals, 99)
+                sub = sub.copy()
+                sub[var] = sub[var].clip(lower=low, upper=high)
+            parts.append(sub)
+        df = pd.concat(parts)
+    return df

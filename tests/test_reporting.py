@@ -1,0 +1,165 @@
+"""End-to-end reporting layer vs pandas oracles: subsets, Table 1, Table 2,
+Figure 1 rolling slopes — on the same synthetic universe."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from oracle import (
+    oracle_fama_macbeth_summary,
+    oracle_monthly_cs_ols,
+    oracle_monthly_characteristics,
+    oracle_std_12,
+    oracle_weekly_beta,
+    oracle_winsorize,
+)
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS, MODELS
+from fm_returnprediction_tpu.panel.characteristics import FACTORS_DICT, get_factors
+from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+from fm_returnprediction_tpu.panel.transform_compustat import (
+    add_report_date,
+    calc_book_equity,
+    expand_compustat_annual_to_monthly,
+    merge_CRSP_and_Compustat,
+)
+from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
+from fm_returnprediction_tpu.reporting.figure1 import rolling_slopes
+from fm_returnprediction_tpu.reporting.table1 import build_table_1
+from fm_returnprediction_tpu.reporting.table2 import build_table_2, run_model_fm
+
+
+@pytest.fixture(scope="module")
+def world():
+    wrds = generate_synthetic_wrds(SyntheticConfig(n_firms=40, n_months=84))
+    crsp = calculate_market_equity(wrds["crsp_m"])
+    comp = expand_compustat_annual_to_monthly(
+        calc_book_equity(add_report_date(wrds["comp"].copy()))
+    )
+    merged = merge_CRSP_and_Compustat(crsp, comp, wrds["ccm"])
+    merged["mthcaldt"] = merged["jdate"]
+    panel, factors = get_factors(merged, wrds["crsp_d"], wrds["crsp_index_d"])
+    masks = compute_subset_masks(panel)
+
+    # oracle long panel with identical characteristic values
+    df = oracle_monthly_characteristics(merged)
+    df = oracle_std_12(wrds["crsp_d"], df)
+    df = oracle_weekly_beta(wrds["crsp_d"], wrds["crsp_index_d"], df)
+    df = oracle_winsorize(df, list(FACTORS_DICT.values()))
+
+    # oracle subsets (reference get_subsets, src/calc_Lewellen_2014.py:44-112)
+    nyse = df[df["primaryexch"] == "N"]
+    pct = (
+        nyse.groupby("mthcaldt")["me"].quantile([0.2, 0.5]).unstack(level=1)
+        .rename(columns={0.2: "me_20", 0.5: "me_50"}).reset_index()
+    )
+    df = df.merge(pct, on="mthcaldt", how="left")
+    oracle_subsets = {
+        "All stocks": df,
+        "All-but-tiny stocks": df[df["me"] >= df["me_20"]],
+        "Large stocks": df[df["me"] >= df["me_50"]],
+    }
+    return panel, factors, masks, oracle_subsets
+
+
+def test_subset_masks_match_oracle(world):
+    panel, _, masks, oracle_subsets = world
+    months = pd.DatetimeIndex(panel.months)
+    for name, mask in masks.items():
+        got = np.asarray(mask)
+        want = oracle_subsets[name]
+        want_keys = set(zip(want["permno"], want["jdate"]))
+        got_keys = set()
+        t_idx, n_idx = np.nonzero(got)
+        for t, n in zip(t_idx, n_idx):
+            got_keys.add((panel.ids[n], months[t]))
+        assert got_keys == want_keys, name
+
+
+def test_table_1_matches_oracle(world):
+    panel, factors, masks, oracle_subsets = world
+    table = build_table_1(panel, masks, factors)
+    for subset_name, sub in oracle_subsets.items():
+        for label, col in factors.items():
+            clean = sub[[col, "mthcaldt", "permno"]].replace(
+                [np.inf, -np.inf], np.nan
+            ).dropna(subset=[col])
+            if clean.empty:
+                continue
+            stats = clean.groupby("mthcaldt")[col].agg(["mean", "std"])
+            np.testing.assert_allclose(
+                table.loc[label, (subset_name, "Avg")], stats["mean"].mean(),
+                rtol=1e-8, err_msg=f"{subset_name}/{label}/Avg",
+            )
+            np.testing.assert_allclose(
+                table.loc[label, (subset_name, "Std")], stats["std"].mean(),
+                rtol=1e-8, err_msg=f"{subset_name}/{label}/Std",
+            )
+            assert table.loc[label, (subset_name, "N")] == clean["permno"].nunique()
+
+
+@pytest.mark.parametrize("model_idx", [0, 1, 2])
+def test_table_2_fm_matches_oracle(world, model_idx):
+    panel, factors, masks, oracle_subsets = world
+    model = MODELS[model_idx]
+    xvars = [factors[label] for label in model.predictors]
+    for subset_name, sub in oracle_subsets.items():
+        cs = oracle_monthly_cs_ols(sub, "retx", xvars)
+        _, fm = run_model_fm(panel, masks[subset_name], model, factors)
+        if cs.empty:
+            # no month had enough complete-case rows: both sides must agree,
+            # and the means must be NaN (empty .mean()) so Table 2 blanks them
+            assert int(fm.n_months) == 0, subset_name
+            assert np.isnan(np.asarray(fm.coef)).all()
+            assert np.isnan(float(fm.mean_r2)) and np.isnan(float(fm.mean_n))
+            continue
+        want = oracle_fama_macbeth_summary(cs, xvars)
+        for i, col in enumerate(xvars):
+            np.testing.assert_allclose(
+                float(fm.coef[i]), want[f"{col}_coef"], rtol=1e-6,
+                err_msg=f"{subset_name}/{col}",
+            )
+            np.testing.assert_allclose(
+                float(fm.tstat[i]), want[f"{col}_tstat"], rtol=1e-6,
+                err_msg=f"{subset_name}/{col}/t",
+            )
+        np.testing.assert_allclose(float(fm.mean_r2), want["mean_R2"], rtol=1e-8)
+        np.testing.assert_allclose(float(fm.mean_n), want["mean_N"], rtol=1e-12)
+
+
+def test_table_2_layout_contract(world):
+    panel, factors, masks, _ = world
+    table = build_table_2(panel, masks, factors)
+    # rows: each model block ends with N; columns: 3 subsets × 3 metrics
+    assert list(table.columns.get_level_values(0).unique()) == [
+        "All stocks", "All-but-tiny stocks", "Large stocks",
+    ]
+    assert list(table.columns.get_level_values(1).unique()) == ["Slope", "t-stat", "R^2"]
+    for model in MODELS:
+        block = table.loc[model.name]
+        assert list(block.index) == model.predictors + ["N"]
+        r2_col = block[("All stocks", "R^2")]
+        assert r2_col.iloc[0] != ""  # first row shows R²
+        assert (r2_col.iloc[1:] == "").all()  # rest blanked
+        n_cell = block.loc["N", ("All stocks", "Slope")]
+        assert isinstance(n_cell, str) and n_cell != ""
+
+
+def test_figure1_rolling_slopes_match_oracle(world):
+    panel, factors, masks, oracle_subsets = world
+    xvars = list(FIGURE1_VARS.keys())
+    for subset_name in ["All stocks", "Large stocks"]:
+        sub = oracle_subsets[subset_name]
+        cs = oracle_monthly_cs_ols(sub, "retx", xvars)
+        slopes = cs.set_index("mthcaldt")[[f"slope_{v}" for v in xvars]]
+        slopes.columns = xvars
+        want = slopes.rolling(window=120, min_periods=60).mean()
+        got = rolling_slopes(panel, masks[subset_name])
+        assert got.index.equals(want.index)
+        g, w = got.to_numpy(), want.to_numpy()
+        both_nan = np.isnan(g) & np.isnan(w)
+        np.testing.assert_allclose(
+            np.where(both_nan, 0, g), np.where(both_nan, 0, w), rtol=1e-6, atol=1e-10
+        )

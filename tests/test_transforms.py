@@ -1,0 +1,136 @@
+"""Host relational transforms vs reference-semantics oracles on synthetic data."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.panel.transform_compustat import (
+    add_report_date,
+    calc_book_equity,
+    expand_compustat_annual_to_monthly,
+    merge_CRSP_and_Compustat,
+)
+from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
+
+
+@pytest.fixture(scope="module")
+def wrds():
+    return generate_synthetic_wrds(SyntheticConfig(n_firms=30, n_months=48))
+
+
+def oracle_expand(comp_annual, id_col="gvkey"):
+    """Per-gvkey groupby/reindex/ffill expansion, transcribing the reference
+    (src/transform_compustat.py:101-181) loop semantics exactly."""
+    df = comp_annual.drop(columns=["fyear"], errors="ignore").copy()
+    df["fund_date"] = df["report_date"]
+    df = df.set_index([id_col, "fund_date"]).sort_index()
+    max_all = pd.to_datetime(df.index.get_level_values("fund_date")).max()
+    pieces = []
+    for gvkey, group in df.groupby(level=id_col):
+        dates = pd.to_datetime(group.index.get_level_values("fund_date"))
+        extended_max = min(max_all, dates.max() + pd.DateOffset(months=12))
+        monthly = pd.date_range(dates.min(), extended_max, freq="ME")
+        new_index = pd.MultiIndex.from_product(
+            [[gvkey], monthly], names=[id_col, "fund_date"]
+        )
+        pieces.append(group.reindex(new_index, method="ffill"))
+    out = pd.concat(pieces).rename_axis([id_col, "fund_date"]).reset_index()
+    return out
+
+
+def test_market_equity_aggregation(wrds):
+    me = calculate_market_equity(wrds["crsp_m"])
+    # one row per (permco, jdate)
+    assert not me.duplicated(subset=["permco", "jdate"]).any()
+    # firm ME equals the sum of security MEs of that permco-date
+    raw = wrds["crsp_m"].dropna(subset=["prc", "shrout"]).copy()
+    raw["sec_me"] = raw["prc"].abs() * raw["shrout"]
+    want = raw.groupby(["permco", "jdate"])["sec_me"].sum()
+    got = me.set_index(["permco", "jdate"])["me"]
+    pd.testing.assert_series_equal(
+        got.sort_index(), want.sort_index(), check_names=False
+    )
+    # the representative permno is the one with the largest security ME
+    multi = raw.groupby(["permco", "jdate"]).filter(lambda g: len(g) > 1)
+    if len(multi):
+        top = multi.sort_values("sec_me").groupby(["permco", "jdate"]).tail(1)
+        merged = top.merge(me, on=["permco", "jdate"], suffixes=("_want", ""))
+        assert (merged["permno_want"] == merged["permno"]).all()
+
+
+def test_report_date_four_month_lag(wrds):
+    comp = add_report_date(wrds["comp"].copy())
+    assert (
+        comp["report_date"] == comp["datadate"] + pd.DateOffset(months=4)
+    ).all()
+
+
+def test_book_equity_fallback_chain():
+    comp = pd.DataFrame(
+        {
+            "seq": [100.0, 100.0, 100.0, 100.0, 1.0],
+            "txditc": [10.0, np.nan, 10.0, 10.0, np.nan],
+            "pstkrv": [5.0, np.nan, np.nan, np.nan, np.nan],
+            "pstkl": [7.0, 6.0, np.nan, np.nan, np.nan],
+            "pstk": [8.0, 8.0, 8.0, np.nan, 50.0],
+        }
+    )
+    out = calc_book_equity(comp.copy())
+    # ps chain: pstkrv -> pstkl -> pstk -> 0; be = seq + txditc - ps, be>0 only
+    np.testing.assert_allclose(out["be"].to_numpy(), [105.0, 94.0, 102.0, 110.0])
+    assert len(out) == 4  # last row: be = 1 + 0 - 50 < 0 -> dropped
+
+
+def test_expand_matches_reference_oracle(wrds):
+    comp = calc_book_equity(add_report_date(wrds["comp"].copy()))
+    got = expand_compustat_annual_to_monthly(comp)
+    want = oracle_expand(comp)
+    key = ["gvkey", "fund_date"]
+    got_s = got.sort_values(key).reset_index(drop=True)
+    want_s = want.sort_values(key).reset_index(drop=True)
+    assert len(got_s) == len(want_s)
+    value_cols = [c for c in want_s.columns if c not in key]
+    for col in value_cols:
+        a, b = got_s[col], want_s[col]
+        if a.dtype.kind in "fi":
+            np.testing.assert_allclose(
+                a.to_numpy(dtype=float), b.to_numpy(dtype=float), err_msg=col
+            )
+        else:
+            assert (a.fillna("") == b.fillna("")).all(), col
+
+
+def test_expand_midmonth_report_dates():
+    """Fiscal year ending Jun 30 -> report date Oct 30 (mid-month): the grid
+    must start at Oct 31 and end at the capped month, matching date_range."""
+    comp = pd.DataFrame(
+        {
+            "gvkey": ["1", "1"],
+            "datadate": pd.to_datetime(["1980-06-30", "1981-06-30"]),
+            "fyear": [1980, 1981],
+            "assets": [100.0, 120.0],
+        }
+    )
+    comp = add_report_date(comp)
+    got = expand_compustat_annual_to_monthly(comp)
+    want = oracle_expand(comp)
+    assert list(got["fund_date"]) == list(want["fund_date"])
+    np.testing.assert_allclose(got["assets"].to_numpy(), want["assets"].to_numpy())
+
+
+def test_merge_link_window(wrds):
+    crsp = calculate_market_equity(wrds["crsp_m"])
+    comp = expand_compustat_annual_to_monthly(
+        calc_book_equity(add_report_date(wrds["comp"].copy()))
+    )
+    merged = merge_CRSP_and_Compustat(crsp, comp, wrds["ccm"])
+    assert len(merged) > 0
+    # every merged row respects its link window
+    ccm = wrds["ccm"].copy()
+    ccm["linkenddt"] = ccm["linkenddt"].fillna(pd.Timestamp.now())
+    check = merged.merge(ccm[["gvkey", "linkdt", "linkenddt"]], on="gvkey")
+    assert (check["jdate"] >= check["linkdt"]).all()
+    assert (check["jdate"] <= check["linkenddt"]).all()
+    # fundamentals and market data coexist on each row
+    assert merged[["me", "be", "assets", "retx"]].notna().all(axis=None)

@@ -1,0 +1,95 @@
+"""Acquisition layer: SQL builders, universe filter, cache-contract behavior.
+
+Network pulls are not exercised (the ``wrds`` package import is deferred);
+cache-hit paths are driven with synthetic parquet files.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.data.wrds_pull import (
+    build_compustat_sql,
+    build_crsp_stock_sql,
+    build_link_table_sql,
+    pull_CRSP_index,
+    pull_CRSP_stock,
+    subset_to_common_stock_and_exchanges,
+)
+
+
+@pytest.fixture(scope="module")
+def wrds():
+    return generate_synthetic_wrds(SyntheticConfig(n_firms=30, n_months=24))
+
+
+def test_universe_filter(wrds):
+    out = subset_to_common_stock_and_exchanges(wrds["crsp_m"])
+    assert len(out) > 0
+    assert (out["securitysubtype"] == "COM").all()
+    assert (out["usincflg"] == "Y").all()
+    assert out["primaryexch"].isin(["N", "A", "Q"]).all()
+    assert out["issuertype"].isin(["ACOR", "CORP"]).all()
+    # the synthetic universe deliberately contains excluded rows
+    assert len(out) < len(wrds["crsp_m"])
+
+
+def test_crsp_sql_monthly_vs_daily():
+    monthly = build_crsp_stock_sql("M", "1964-01-01", "2013-12-31")
+    daily = build_crsp_stock_sql("D", "1964-01-01", "2013-12-31")
+    assert "crsp.msf_v2" in monthly and "mthret AS totret" in monthly
+    assert "crsp.dsf_v2" in daily and "dlyretx AS retx" in daily
+    assert "mthcaldt >= '1964-01-01'" in monthly
+    with pytest.raises(ValueError):
+        build_crsp_stock_sql("W", "a", "b")
+
+
+def test_crsp_sql_filter_clause():
+    sql = build_crsp_stock_sql("M", "1964-01-01", "2013-12-31", "permno", ["10001", "10002"])
+    assert "AND permno IN ('10001', '10002')" in sql
+
+
+def test_compustat_sql_standard_filters_and_gvkey_column():
+    sql = build_compustat_sql("gvkey, datadate", "1964-01-01", "2013-12-31", gvkey="001234")
+    for clause in ("indfmt='INDL'", "datafmt='STD'", "popsrc='D'", "consol='C'"):
+        assert clause in sql
+    # defect SURVEY §2.2.5 fixed: the COLUMN name is interpolated, not the value
+    assert "AND gvkey IN ('001234')" in sql
+
+
+def test_link_table_sql():
+    sql = build_link_table_sql()
+    assert "substr(linktype,1,1)='L'" in sql
+    assert "NOT IN ('LX', 'LD', 'LN')" in sql
+
+
+def test_cache_hit_returns_filtered_universe(tmp_path, wrds):
+    """Defect SURVEY §2.2.7 fixed: a cache hit must return the same filtered
+    universe a fresh pull would."""
+    raw = wrds["crsp_m"]
+    raw.to_parquet(tmp_path / "CRSP_stock_m.parquet", index=False)
+    out = pull_CRSP_stock(
+        freq="M", data_dir=tmp_path, file_name="CRSP_stock_m.parquet"
+    )
+    want = subset_to_common_stock_and_exchanges(raw)
+    assert len(out) == len(want)
+    assert (out["securitysubtype"] == "COM").all()
+
+
+def test_cache_hit_index_unfiltered(tmp_path, wrds):
+    wrds["crsp_index_d"].to_parquet(tmp_path / "CRSP_index_d.parquet", index=False)
+    out = pull_CRSP_index(freq="D", data_dir=tmp_path, file_name="CRSP_index_d.parquet")
+    assert len(out) == len(wrds["crsp_index_d"])
+
+
+def test_pipeline_applies_universe_filter(wrds):
+    """build_panel must exclude non-common/ADR/non-US rows even when raw
+    frames come from an (unfiltered) cache."""
+    from fm_returnprediction_tpu.pipeline import build_panel
+
+    panel, _ = build_panel(wrds)
+    bad_permnos = set(
+        wrds["crsp_m"].loc[wrds["crsp_m"]["usincflg"] != "Y", "permno"]
+    )
+    assert not bad_permnos.intersection(panel.ids)
