@@ -69,9 +69,7 @@ def build_daily_panel(
 
     idx = crsp_index_d.drop_duplicates(subset=["caldt"], keep="last").set_index("caldt")
     mkt = idx["vwretx"].reindex(days).to_numpy(dtype=dtype)
-    mkt_present = days.isin(idx.index).to_numpy() if hasattr(
-        days.isin(idx.index), "to_numpy"
-    ) else np.asarray(days.isin(idx.index))
+    mkt_present = np.asarray(days.isin(idx.index))
 
     day_month = days + MonthEnd(0)
     day_month_id = month_index_of(day_month, months)

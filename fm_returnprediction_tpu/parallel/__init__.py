@@ -1,0 +1,36 @@
+"""Multi-chip layer: device mesh, firm-sharded FM, replicate-sharded bootstrap.
+
+The reference is single-process serial (SURVEY §2.1 rows "Data parallelism",
+"Distributed communication backend": Absent). This package is the TPU-native
+replacement: a named home for the ``jax.sharding.Mesh`` plus the two sharded
+stages of the north-star workload — Gram-psum cross-sectional OLS over the
+firm axis and the 10k moving-block bootstrap over the replicate axis.
+"""
+
+from fm_returnprediction_tpu.parallel.bootstrap import (
+    BootstrapResult,
+    block_bootstrap_se,
+    bootstrap_replicate_means,
+)
+from fm_returnprediction_tpu.parallel.fm_sharded import (
+    fama_macbeth_sharded,
+    monthly_cs_ols_sharded,
+)
+from fm_returnprediction_tpu.parallel.mesh import (
+    host_local_mesh,
+    make_mesh,
+    pad_to_multiple,
+    shard_panel,
+)
+
+__all__ = [
+    "BootstrapResult",
+    "block_bootstrap_se",
+    "bootstrap_replicate_means",
+    "fama_macbeth_sharded",
+    "monthly_cs_ols_sharded",
+    "host_local_mesh",
+    "make_mesh",
+    "pad_to_multiple",
+    "shard_panel",
+]

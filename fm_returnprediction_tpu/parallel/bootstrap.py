@@ -1,0 +1,143 @@
+"""Sharded moving-block bootstrap for Fama-MacBeth standard errors.
+
+The reference reports only Newey-West analytic SEs (``src/regressions.py:
+78-100``); the north-star workload (BASELINE.json configs[4]) adds a
+10k-replicate block bootstrap of the monthly slope series, sharded across
+the chip mesh. Replicates are embarrassingly parallel: each device draws its
+own replicate slice with a folded PRNG key, computes local replicate means,
+and contributes moment sums to one final ``psum`` — communication is
+O(P) floats regardless of replicate count.
+
+Design (matching the FM layer's validity semantics):
+
+- Each predictor's slope series is compacted to its valid months in
+  chronological order (exactly how ``nw_mean_se`` pairs adjacent SURVIVING
+  months, ``src/regressions.py:113`` + SURVEY §2.2.8) of length ``n_p``.
+- A replicate resamples the compacted series with a moving-block bootstrap:
+  position ``j`` of the pseudo-series takes block ``j // L`` at offset
+  ``j % L`` from a uniformly drawn start in ``[0, n_p − L]``; the replicate
+  statistic is the mean of the first ``n_p`` positions. With static shapes
+  this is a gather — no dynamic control flow, jit/TPU friendly.
+- Bootstrap SE per predictor = std (ddof=1) of replicate means; also
+  returned are the replicate-mean means for bias diagnostics.
+
+Block length defaults to ``nw_lags + 1 = 5`` months, the standard choice for
+matching a lag-L Newey-West horizon.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fm_returnprediction_tpu.ops.newey_west import compact_front
+from fm_returnprediction_tpu.parallel.mesh import make_mesh
+
+__all__ = ["BootstrapResult", "block_bootstrap_se", "bootstrap_replicate_means"]
+
+
+class BootstrapResult(NamedTuple):
+    se: jnp.ndarray          # (P,) bootstrap SE of the mean slope
+    mean: jnp.ndarray        # (P,) mean of replicate means (bias diagnostic)
+    n_replicates: int        # B actually drawn
+    block_length: int
+
+
+def _replicate_means_one_predictor(series, n_valid, keys, block_length):
+    """Replicate means for ONE predictor's compacted slope series.
+
+    series : (T,) compacted values (valid entries first, tail zeroed)
+    n_valid: () number of valid entries
+    keys   : (B, 2) PRNG keys, one per replicate
+    Returns (B,) replicate means. Predictors with n_valid < 2 yield NaN.
+    """
+    t_max = series.shape[0]
+    n = jnp.maximum(n_valid, 1)
+    # Highest valid block start: n - L (clamped at 0 when the series is
+    # shorter than one block — the block then wraps within the valid region
+    # via the index clamp below).
+    max_start = jnp.maximum(n - block_length, 0)
+    n_blocks = -(-t_max // block_length)  # ceil over the static axis
+
+    def one_rep(key):
+        starts = jax.random.randint(key, (n_blocks,), 0, max_start + 1)
+        j = jnp.arange(t_max)
+        idx = starts[j // block_length] + (j % block_length)
+        idx = jnp.minimum(idx, n - 1)  # clamp inside the valid region
+        pseudo = series[idx]
+        w = (j < n_valid).astype(series.dtype)
+        return jnp.sum(pseudo * w) / jnp.maximum(n_valid, 1).astype(series.dtype)
+
+    means = jax.vmap(one_rep)(keys)
+    return jnp.where(n_valid >= 2, means, jnp.nan)
+
+
+def bootstrap_replicate_means(
+    slopes: jnp.ndarray,
+    slope_valid: jnp.ndarray,
+    keys: jnp.ndarray,
+    block_length: int,
+) -> jnp.ndarray:
+    """(B, P) replicate means for every predictor. Pure function of the
+    replicate keys — the unit the mesh shards over."""
+    series, counts = jax.vmap(compact_front, in_axes=(1, 1))(slopes, slope_valid)
+    return jax.vmap(
+        lambda s, c: _replicate_means_one_predictor(s, c, keys, block_length),
+        out_axes=1,
+    )(series, counts)
+
+
+def block_bootstrap_se(
+    slopes: jnp.ndarray,
+    slope_valid: jnp.ndarray,
+    key: jax.Array,
+    n_replicates: int = 10_000,
+    block_length: int = 5,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "boot",
+) -> BootstrapResult:
+    """Moving-block bootstrap SE of the mean slope, per predictor.
+
+    Parameters
+    ----------
+    slopes      : (T, P) monthly slope estimates (from ``monthly_cs_ols``).
+    slope_valid : (T, P) bool — month ran AND slope finite.
+    key         : PRNG key.
+    n_replicates: total replicates B (rounded up to a mesh multiple).
+    mesh        : optional 1-D mesh; replicates shard over ``axis_name``.
+                  None = single-device vmap.
+    """
+    slopes = jnp.asarray(slopes)
+    slope_valid = jnp.asarray(slope_valid)
+
+    if mesh is None:
+        keys = jax.random.split(key, n_replicates)
+        means = bootstrap_replicate_means(slopes, slope_valid, keys, block_length)
+        b = n_replicates
+    else:
+        d = mesh.shape[axis_name]
+        b = -(-n_replicates // d) * d
+        keys = jax.random.split(key, b)
+
+        def kernel(keys_l, slopes_r, valid_r):
+            return bootstrap_replicate_means(
+                slopes_r, valid_r, keys_l, block_length
+            )
+
+        shard = jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()),
+            out_specs=P(axis_name),
+        )
+        keys = jax.device_put(keys, NamedSharding(mesh, P(axis_name)))
+        means = shard(keys, slopes, slope_valid)  # (B, P), replicate-sharded
+
+    bf = jnp.asarray(b, dtype=slopes.dtype)
+    mean = jnp.mean(means, axis=0)
+    var = jnp.sum((means - mean[None, :]) ** 2, axis=0) / (bf - 1.0)
+    return BootstrapResult(jnp.sqrt(var), mean, b, block_length)

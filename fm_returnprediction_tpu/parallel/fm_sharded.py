@@ -1,0 +1,166 @@
+"""Firm-sharded Fama-MacBeth — explicit-collective SPMD over the device mesh.
+
+The reference's hot loop (``src/regressions.py:43-72``; call stack SURVEY
+§3.4) is serial per month. The single-chip replacement batches it with
+``vmap`` (``ops.ols.monthly_cs_ols``); THIS module is the multi-chip path:
+the firm axis N of the dense ``(T, N, P)`` panel is sharded over the mesh's
+``"firms"`` axis with ``shard_map``, each device contracts its local firm
+slice into per-month Gram matrices ``Xᵀdiag(v)X`` and moments ``Xᵀdiag(v)y``
+(one MXU einsum each), and a single ``psum`` over ICI produces the global
+sufficient statistics. The tiny ``(P+1)²`` solves, R² reconstruction, and
+Newey-West aggregation then run replicated on every device — they are
+O(T·P²), negligible next to the O(T·N·P²) contraction.
+
+Communication cost per FM run: one psum of ``T·(P+1)² + T·(P+1) + 3T``
+floats — for the full Lewellen panel (T≈600, P=14) that is ~150 KB, i.e.
+the cross-section is embarrassingly parallel exactly as SURVEY §5 predicts.
+
+Numerics note: the distributed path necessarily uses the normal-equation
+route (sufficient statistics are what collectives can sum), which matches
+``ops.ols`` ``solver="normal"``. Months that are nearly singular can drift
+from the SVD path; the parity suite pins both against the numpy oracle on
+well-conditioned panels, and degenerate months remain gated by
+``month_valid`` (reference guard ``src/regressions.py:52``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fm_returnprediction_tpu.ops.fama_macbeth import (
+    FamaMacbethSummary,
+    fama_macbeth_summary,
+)
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult, row_validity
+from fm_returnprediction_tpu.parallel.mesh import make_mesh, shard_panel
+
+__all__ = ["monthly_cs_ols_sharded", "fama_macbeth_sharded"]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _local_sufficient_stats(y, x, mask):
+    """Per-device contraction of the local firm slice into month-wise
+    sufficient statistics. Shapes (local): y (T, Nl), x (T, Nl, P).
+
+    Returns (gram (T,Q,Q), moment (T,Q), n (T,), ysum (T,), yy (T,)) with
+    Q = P + 1 (intercept column first, as the reference builds
+    ``sm.add_constant``-style designs at ``src/regressions.py:49``).
+    """
+    valid = row_validity(y, x, mask)
+    v = valid.astype(x.dtype)
+    ones = jnp.ones_like(y)
+    x_aug = jnp.concatenate(
+        [ones[..., None], jnp.where(valid[..., None], x, 0.0)], axis=-1
+    )
+    x_aug = x_aug * v[..., None]
+    y_z = jnp.where(valid, y, 0.0)
+
+    gram = jnp.einsum("tnp,tnq->tpq", x_aug, x_aug, precision=_PRECISION)
+    moment = jnp.einsum("tnp,tn->tp", x_aug, y_z, precision=_PRECISION)
+    n = v.sum(axis=1)
+    ysum = y_z.sum(axis=1)
+    yy = jnp.sum(y_z * y_z, axis=1)
+    return gram, moment, n, ysum, yy
+
+
+def _solve_from_stats(gram, moment, n, ysum, yy) -> CSRegressionResult:
+    """Replicated month solves from globally-summed sufficient statistics.
+
+    Reproduces ``ops.ols._solve_month`` (solver="normal") semantics:
+    skipped months carry zero slopes/R² and ``month_valid=False``; R² is the
+    centered statsmodels ``rsquared`` (``src/regressions.py:60-66``),
+    reconstructed as 1 − SSE/SST with SSE = yᵀy − 2βᵀb + βᵀGβ.
+    """
+    q = gram.shape[-1]
+    month_valid = n >= q
+    eye = jnp.eye(q, dtype=gram.dtype)
+    safe_gram = jnp.where(month_valid[:, None, None], gram, eye)
+    with jax.default_matmul_precision("highest"):
+        beta = jnp.einsum(
+            "tpq,tq->tp", jnp.linalg.pinv(safe_gram), moment, precision=_PRECISION
+        )
+    beta = jnp.where(month_valid[:, None], beta, 0.0)
+
+    bg = jnp.einsum("tp,tpq,tq->t", beta, gram, beta, precision=_PRECISION)
+    bm = jnp.einsum("tp,tp->t", beta, moment, precision=_PRECISION)
+    sse = yy - 2.0 * bm + bg
+    nf = jnp.maximum(n, 1.0)
+    sst = yy - ysum * ysum / nf
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
+    r2 = jnp.where(month_valid, r2, 0.0)
+    return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, n, month_valid)
+
+
+def monthly_cs_ols_sharded(
+    y, x, mask, mesh: Mesh, axis_name: str = "firms"
+) -> CSRegressionResult:
+    """Cross-sectional OLS for every month, firm axis sharded over ``mesh``.
+
+    Inputs must already be firm-sharded/padded (see ``mesh.shard_panel``).
+    Result leaves are replicated across devices.
+    """
+
+    def kernel(y_l, x_l, mask_l):
+        stats = _local_sufficient_stats(y_l, x_l, mask_l)
+        stats = jax.lax.psum(stats, axis_name)  # one ICI collective
+        return _solve_from_stats(*stats)
+
+    shard = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name, None), P(None, axis_name)),
+        out_specs=CSRegressionResult(P(), P(), P(), P(), P()),
+    )
+    return shard(y, x, mask)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_fm(mesh: Mesh, nw_lags: int, min_months: int, weight: str, axis_name: str):
+    """One compiled sharded-FM program per (mesh, hyperparameter) combo.
+
+    ``jax.jit``'s cache is keyed on the function object, so defining the
+    closure inside ``fama_macbeth_sharded`` would retrace and recompile on
+    every call — 9× the 20-40 s XLA compile over a 3-model × 3-subset sweep.
+    ``Mesh`` is hashable, so it keys the lru_cache directly.
+    """
+
+    @jax.jit
+    def run(y, x, mask):
+        cs = monthly_cs_ols_sharded(y, x, mask, mesh, axis_name=axis_name)
+        summary = fama_macbeth_summary(
+            cs, nw_lags=nw_lags, min_months=min_months, weight=weight
+        )
+        return cs, summary
+
+    return run
+
+
+def fama_macbeth_sharded(
+    y,
+    x,
+    mask,
+    mesh: Optional[Mesh] = None,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+    axis_name: str = "firms",
+    place: bool = True,
+) -> tuple[CSRegressionResult, FamaMacbethSummary]:
+    """End-to-end multi-chip FM: shard the panel, contract + psum, aggregate.
+
+    ``place=True`` pads the firm axis and device_puts with a firm-sharded
+    ``NamedSharding`` first; pass ``place=False`` when the caller already
+    laid the arrays out (e.g. inside a larger pjit program).
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    if place:
+        y, x, mask = shard_panel(y, x, mask, mesh, axis_name=axis_name)
+    run = _jitted_fm(mesh, nw_lags, min_months, weight, axis_name)
+    return run(y, x, mask)

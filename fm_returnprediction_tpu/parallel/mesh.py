@@ -1,0 +1,108 @@
+"""Device mesh construction and panel sharding — the one place topology lives.
+
+The reference has no distributed layer at all (SURVEY §2.1 "Distributed
+communication backend: Absent"; the only process boundaries are WRDS TCP and
+jupyter/pdflatex subprocesses, ``src/pull_crsp.py:238``, ``dodo.py:178``).
+The TPU-native replacement is a named module owning the ``jax.sharding.Mesh``
+so every sharded computation (firm-axis FM, replicate-axis bootstrap) draws
+its topology from here and nowhere else.
+
+Axis conventions:
+
+- ``"firms"``  — the N axis of the dense ``(T, N, K)`` panel. Months are
+  independent in the cross-sectional stage and firms are independent in the
+  rolling stage, so the firm axis shards with zero communication except the
+  per-month Gram-matrix ``psum`` (SURVEY §5 "Long-context" note).
+- ``"boot"``   — the replicate axis of the block-bootstrap engine;
+  embarrassingly parallel, one ``psum`` at the end for the moment sums.
+
+A single 1-D mesh is used for both (the two stages run sequentially, so they
+can reuse the same devices under different axis names via ``Mesh`` re-wrap).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "pad_to_multiple",
+    "shard_panel",
+    "host_local_mesh",
+]
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = "firms",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 1-D mesh over ``n_devices`` (default: all local devices).
+
+    On a real v4-8 slice the 1-D layout keeps every collective on ICI; on the
+    CPU test backend (``xla_force_host_platform_device_count``) it produces
+    the virtual 8-device mesh used by the multi-chip tests (SURVEY §4d).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"Requested {n_devices} devices but only {len(devices)} available"
+        )
+    return Mesh(np.asarray(devices[:n_devices]), axis_names=(axis_name,))
+
+
+def host_local_mesh(axis_name: str = "firms") -> Mesh:
+    """All addressable devices of this host as a 1-D mesh (multi-host safe:
+    uses ``jax.local_devices()`` so DCN never carries panel shards)."""
+    return Mesh(np.asarray(jax.local_devices()), axis_names=(axis_name,))
+
+
+def pad_to_multiple(arr: jax.Array, axis: int, multiple: int, fill=0.0) -> jax.Array:
+    """Pad ``arr`` along ``axis`` up to the next multiple of ``multiple``.
+
+    Sharding a panel over D devices needs N % D == 0; padded firm slots carry
+    ``mask=False`` so they are exact no-ops in every masked kernel (the
+    ragged→dense discipline of SURVEY §7 hard part (a) extends to padding).
+    """
+    size = arr.shape[axis]
+    target = math.ceil(size / multiple) * multiple
+    if target == size:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - size)
+    import jax.numpy as jnp
+
+    return jnp.pad(arr, widths, constant_values=fill)
+
+
+def shard_panel(y, x, mask, mesh: Mesh, axis_name: str = "firms"):
+    """Pad the firm axis to the mesh size and place each array with a
+    firm-sharded ``NamedSharding``.
+
+    Returns ``(y, x, mask)`` device arrays sharded as
+    ``y: (T, N/D) per device``, ``x: (T, N/D, P)``, ``mask: (T, N/D)``.
+    Padded slots have ``mask=False`` and NaN values, so validity logic
+    (``ops.ols.row_validity``) drops them without special cases.
+    """
+    d = mesh.shape[axis_name]
+    import jax.numpy as jnp
+
+    y = pad_to_multiple(jnp.asarray(y), axis=1, multiple=d, fill=jnp.nan)
+    x = pad_to_multiple(jnp.asarray(x), axis=1, multiple=d, fill=jnp.nan)
+    mask = pad_to_multiple(jnp.asarray(mask), axis=1, multiple=d, fill=False)
+
+    s2 = NamedSharding(mesh, P(None, axis_name))
+    s3 = NamedSharding(mesh, P(None, axis_name, None))
+    return (
+        jax.device_put(y, s2),
+        jax.device_put(x, s3),
+        jax.device_put(mask, s2),
+    )

@@ -112,6 +112,16 @@ def run_pipeline(
         if synthetic:
             data = generate_synthetic_wrds(synthetic_config)
         else:
+            if raw_data_dir is None:
+                from fm_returnprediction_tpu.settings import config
+
+                raw_data_dir = config("RAW_DATA_DIR")
+            if not Path(raw_data_dir).is_dir():
+                raise FileNotFoundError(
+                    f"Raw data directory {raw_data_dir!r} does not exist. Pass "
+                    "--raw-data-dir pointing at the cached WRDS parquet files "
+                    f"({', '.join(RAW_FILE_NAMES.values())}), or use --synthetic."
+                )
             data = load_raw_data(raw_data_dir)
 
     with timer.stage("build_panel"):
@@ -156,10 +166,12 @@ def _main() -> None:
     parser.add_argument("--raw-data-dir", default=None)
     parser.add_argument("--output-dir", default=None)
     parser.add_argument("--synthetic", action="store_true")
-    parser.add_argument("--firms", type=int, default=100)
-    parser.add_argument("--months", type=int, default=120)
+    parser.add_argument("--firms", type=int, default=100, help="synthetic only")
+    parser.add_argument("--months", type=int, default=120, help="synthetic only")
     args = parser.parse_args()
 
+    if not args.synthetic and (args.firms != 100 or args.months != 120):
+        parser.error("--firms/--months only apply with --synthetic")
     cfg = SyntheticConfig(n_firms=args.firms, n_months=args.months)
     result = run_pipeline(
         raw_data_dir=args.raw_data_dir,

@@ -172,6 +172,11 @@ def write_cache_data(df: pd.DataFrame, filepath: Path) -> None:
         df.to_csv(filepath, index=False)
     elif fmt == "xlsx":
         df.to_excel(filepath, index=False)
+    elif fmt == "zip":
+        # One CSV member named after the archive stem — the layout the zip
+        # read path expects (and the common WRDS-export shape).
+        with zipfile.ZipFile(filepath, "w", zipfile.ZIP_DEFLATED) as archive:
+            archive.writestr(filepath.stem + ".csv", df.to_csv(index=False))
     else:
         raise ValueError(f"Unsupported file format: {fmt}")
 

@@ -1,0 +1,165 @@
+"""Multi-chip layer on the virtual 8-device CPU mesh (SURVEY §4d).
+
+Asserts the firm-sharded Gram-psum FM path reproduces the single-chip
+batched solver / numpy oracle, that padding slots are exact no-ops, and that
+the replicate-sharded bootstrap is key-deterministic and statistically sane.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+from fm_returnprediction_tpu.parallel import (
+    block_bootstrap_se,
+    bootstrap_replicate_means,
+    fama_macbeth_sharded,
+    make_mesh,
+    pad_to_multiple,
+    shard_panel,
+)
+from fm_returnprediction_tpu.panel.dense import long_to_dense
+
+from oracle import (
+    make_synthetic_long_panel,
+    oracle_fama_macbeth_summary,
+    oracle_monthly_cs_ols,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh(axis_name="firms")
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(31)
+    df, pred_cols = make_synthetic_long_panel(rng)
+    dense = long_to_dense(df, "mthcaldt", "permno", ["retx"] + pred_cols)
+    y = jnp.asarray(dense.var("retx"))
+    x = jnp.asarray(dense.select(pred_cols))
+    mask = jnp.asarray(dense.mask)
+    return df, pred_cols, dense, (y, x, mask)
+
+
+def test_pad_to_multiple_shapes():
+    a = jnp.ones((5, 13, 3))
+    p = pad_to_multiple(a, axis=1, multiple=8, fill=0.0)
+    assert p.shape == (5, 16, 3)
+    np.testing.assert_array_equal(np.asarray(p[:, 13:, :]), 0.0)
+    # already a multiple → unchanged object shape
+    assert pad_to_multiple(a, axis=0, multiple=5).shape == (5, 13, 3)
+
+
+def test_shard_panel_places_on_mesh(mesh, panel):
+    _, _, _, (y, x, mask) = panel
+    ys, xs, ms = shard_panel(y, x, mask, mesh)
+    assert ys.shape[1] % 8 == 0 and ys.shape[1] >= y.shape[1]
+    assert xs.shape[:2] == ys.shape and ms.shape == ys.shape
+    # padded slots are masked out
+    assert not np.asarray(ms)[:, y.shape[1]:].any()
+    assert ys.sharding.spec[1] == "firms"
+    assert xs.sharding.spec[1] == "firms"
+
+
+def test_sharded_fm_matches_single_chip(mesh, panel):
+    df, pred_cols, dense, (y, x, mask) = panel
+    cs_s, fm_s = fama_macbeth_sharded(y, x, mask, mesh=mesh)
+    cs_1, fm_1 = fama_macbeth(y, x, mask, solver="normal")
+
+    np.testing.assert_array_equal(
+        np.asarray(cs_s.month_valid), np.asarray(cs_1.month_valid)
+    )
+    valid = np.asarray(cs_1.month_valid)
+    np.testing.assert_allclose(
+        np.asarray(cs_s.slopes)[valid], np.asarray(cs_1.slopes)[valid],
+        rtol=1e-7, atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(cs_s.r2)[valid], np.asarray(cs_1.r2)[valid],
+        rtol=1e-7, atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_s.coef), np.asarray(fm_1.coef), rtol=1e-7, atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_s.tstat), np.asarray(fm_1.tstat), rtol=1e-6, atol=1e-9
+    )
+
+
+def test_sharded_fm_matches_oracle(mesh, panel):
+    df, pred_cols, dense, (y, x, mask) = panel
+    _, fm_s = fama_macbeth_sharded(y, x, mask, mesh=mesh)
+    oracle_cs = oracle_monthly_cs_ols(df, "retx", pred_cols)
+    want = oracle_fama_macbeth_summary(oracle_cs, pred_cols)
+    for i, col in enumerate(pred_cols):
+        np.testing.assert_allclose(
+            np.asarray(fm_s.coef)[i], want[f"{col}_coef"], rtol=1e-6, atol=1e-9
+        )
+        np.testing.assert_allclose(
+            np.asarray(fm_s.tstat)[i], want[f"{col}_tstat"], rtol=1e-5, atol=1e-8
+        )
+
+
+def test_sharded_fm_subset_mesh(panel):
+    """A 2-device sub-mesh gives identical answers (device-count invariance)."""
+    _, _, _, (y, x, mask) = panel
+    m8 = make_mesh(axis_name="firms")
+    m2 = make_mesh(n_devices=2, axis_name="firms")
+    _, fm8 = fama_macbeth_sharded(y, x, mask, mesh=m8)
+    _, fm2 = fama_macbeth_sharded(y, x, mask, mesh=m2)
+    np.testing.assert_allclose(
+        np.asarray(fm8.coef), np.asarray(fm2.coef), rtol=1e-9, atol=1e-12
+    )
+
+
+def _toy_slopes(rng, t=240, p=3, rho=0.3):
+    """AR(1) slope series with missing months, known mean."""
+    eps = rng.standard_normal((t, p))
+    s = np.zeros((t, p))
+    for i in range(1, t):
+        s[i] = rho * s[i - 1] + eps[i]
+    valid = rng.random((t, p)) > 0.1
+    return jnp.asarray(s), jnp.asarray(valid)
+
+
+def test_bootstrap_deterministic_and_sharded_matches_spec():
+    rng = np.random.default_rng(99)
+    slopes, valid = _toy_slopes(rng)
+    key = jax.random.key(0)
+    r1 = block_bootstrap_se(slopes, valid, key, n_replicates=512)
+    r2 = block_bootstrap_se(slopes, valid, key, n_replicates=512)
+    np.testing.assert_array_equal(np.asarray(r1.se), np.asarray(r2.se))
+
+    mesh = make_mesh(axis_name="boot")
+    rs = block_bootstrap_se(slopes, valid, key, n_replicates=512, mesh=mesh)
+    # Same keys, same replicate set → identical moments regardless of mesh.
+    np.testing.assert_allclose(
+        np.asarray(rs.se), np.asarray(r1.se), rtol=1e-8, atol=1e-12
+    )
+    assert rs.n_replicates == 512
+
+
+def test_bootstrap_se_tracks_nw_scale():
+    """Bootstrap SE should approximate the iid SE for white-noise slopes."""
+    rng = np.random.default_rng(3)
+    t = 600
+    s = rng.standard_normal((t, 2))
+    valid = jnp.ones((t, 2), dtype=bool)
+    res = block_bootstrap_se(
+        jnp.asarray(s), valid, jax.random.key(1), n_replicates=4000, block_length=5
+    )
+    iid_se = s.std(axis=0, ddof=1) / np.sqrt(t)
+    np.testing.assert_allclose(np.asarray(res.se), iid_se, rtol=0.15)
+
+
+def test_bootstrap_short_series_nan():
+    slopes = jnp.asarray(np.random.default_rng(0).standard_normal((50, 2)))
+    valid = jnp.zeros((50, 2), dtype=bool).at[0, 0].set(True)
+    res = block_bootstrap_se(slopes, valid, jax.random.key(0), n_replicates=64)
+    assert np.isnan(np.asarray(res.se)[0])  # 1 valid month → NaN
+    assert np.isnan(np.asarray(res.se)[1])  # 0 valid months → NaN
