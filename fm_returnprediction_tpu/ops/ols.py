@@ -28,7 +28,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["CSRegressionResult", "monthly_cs_ols", "row_validity"]
+__all__ = [
+    "CSRegressionResult",
+    "NormalStats",
+    "monthly_cs_ols",
+    "row_validity",
+    "sufficient_stats",
+    "solve_from_stats",
+]
 
 _PRECISION = jax.lax.Precision.HIGHEST
 
@@ -51,6 +58,72 @@ def row_validity(y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarr
     return mask & jnp.isfinite(y) & jnp.all(jnp.isfinite(x), axis=-1)
 
 
+class NormalStats(NamedTuple):
+    """Normal-equation sufficient statistics for a batch of cross-sections.
+
+    These are exactly the quantities that are ADDITIVE over disjoint firm
+    subsets, so the multi-chip path (``parallel.fm_sharded``) computes them
+    per device shard and combines with one ``psum``.
+    """
+
+    gram: jnp.ndarray    # (..., Q, Q) XᵀX with intercept column, Q = P+1
+    moment: jnp.ndarray  # (..., Q)    Xᵀy
+    n: jnp.ndarray       # (...)       valid rows
+    ysum: jnp.ndarray    # (...)       Σy over valid rows
+    yy: jnp.ndarray      # (...)       Σy² over valid rows
+
+
+def sufficient_stats(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray) -> NormalStats:
+    """Contract a masked cross-section batch into normal-equation stats.
+
+    Shapes: y (..., N), x (..., N, P), valid (..., N) bool; the intercept
+    column is prepended first, as the reference builds its design at
+    ``src/regressions.py:49``.
+    """
+    v = valid.astype(x.dtype)
+    ones = jnp.ones_like(y)
+    x_aug = jnp.concatenate(
+        [ones[..., None], jnp.where(valid[..., None], x, 0.0)], axis=-1
+    )
+    x_aug = x_aug * v[..., None]
+    y_z = jnp.where(valid, y, 0.0)
+    gram = jnp.einsum("...np,...nq->...pq", x_aug, x_aug, precision=_PRECISION)
+    moment = jnp.einsum("...np,...n->...p", x_aug, y_z, precision=_PRECISION)
+    return NormalStats(gram, moment, v.sum(-1), y_z.sum(-1), jnp.sum(y_z * y_z, -1))
+
+
+def solve_from_stats(stats: NormalStats):
+    """Per-month OLS from sufficient statistics (the "normal" solver).
+
+    Skipped months (n < Q, the reference guard ``src/regressions.py:52``)
+    carry zero slopes/R² with ``month_valid=False``. R² is the centered
+    statsmodels ``rsquared`` reconstructed as 1 − SSE/SST with
+    SSE = yᵀy − 2βᵀ(Xᵀy) + βᵀ(XᵀX)β.
+
+    Returns ``(slopes (..., P), intercept (...), r2 (...), n (...),
+    month_valid (...))`` — ``CSRegressionResult`` leaves with batch dims.
+    """
+    gram, moment, n, ysum, yy = stats
+    q = gram.shape[-1]
+    month_valid = n >= q
+    eye = jnp.eye(q, dtype=gram.dtype)
+    safe_gram = jnp.where(month_valid[..., None, None], gram, eye)
+    with jax.default_matmul_precision("highest"):
+        beta = jnp.einsum(
+            "...pq,...q->...p", jnp.linalg.pinv(safe_gram), moment,
+            precision=_PRECISION,
+        )
+    beta = jnp.where(month_valid[..., None], beta, 0.0)
+
+    bg = jnp.einsum("...p,...pq,...q->...", beta, gram, beta, precision=_PRECISION)
+    bm = jnp.einsum("...p,...p->...", beta, moment, precision=_PRECISION)
+    sse = yy - 2.0 * bm + bg
+    sst = yy - ysum * ysum / jnp.maximum(n, 1.0)
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
+    r2 = jnp.where(month_valid, r2, 0.0)
+    return beta[..., 1:], beta[..., 0], r2, n, month_valid
+
+
 def _solve_month(y, x, valid, solver="lstsq"):
     """One month's masked OLS. Shapes: y (N,), x (N, P), valid (N,) bool.
 
@@ -65,10 +138,17 @@ def _solve_month(y, x, valid, solver="lstsq"):
     singular values/V untouched, so the padded solve equals the subset solve
     exactly.
 
-    ``solver="normal"``: Gram pseudo-inverse (X⁺ = (XᵀX)⁺Xᵀ). One big MXU
-    einsum + tiny (P+1)² pinv — much faster, but squares the condition
-    number, so ill-conditioned months can drift from the reference.
+    ``solver="normal"``: Gram pseudo-inverse (X⁺ = (XᵀX)⁺Xᵀ) via the shared
+    ``sufficient_stats``/``solve_from_stats`` route (the same code the
+    multi-chip path psums). One big MXU einsum + tiny (P+1)² pinv — much
+    faster, but squares the condition number, so ill-conditioned months can
+    drift from the reference.
     """
+    if solver == "normal":
+        return solve_from_stats(sufficient_stats(y, x, valid))
+    if solver != "lstsq":
+        raise ValueError(f"Unknown solver: {solver}")
+
     n = valid.sum()
     p_aug = x.shape[-1] + 1
 
@@ -82,17 +162,7 @@ def _solve_month(y, x, valid, solver="lstsq"):
     # default_matmul_precision keeps the lstsq SVD and the residual matmuls
     # below off the bf16 MXU path on TPU f32 runs (1e-4 parity budget).
     with jax.default_matmul_precision("highest"):
-        if solver == "lstsq":
-            beta, _, _, _ = jnp.linalg.lstsq(x_aug, y_z)
-        elif solver == "normal":
-            gram = jnp.einsum("np,nq->pq", x_aug, x_aug, precision=_PRECISION)
-            moment = jnp.einsum("np,n->p", x_aug, y_z, precision=_PRECISION)
-            safe_gram = jnp.where(month_valid, gram, jnp.eye(p_aug, dtype=gram.dtype))
-            beta = jnp.einsum(
-                "pq,q->p", jnp.linalg.pinv(safe_gram), moment, precision=_PRECISION
-            )
-        else:
-            raise ValueError(f"Unknown solver: {solver}")
+        beta, _, _, _ = jnp.linalg.lstsq(x_aug, y_z)
     # Skipped months carry zeros; a non-finite solve on a month that RAN is
     # left as NaN — the reference's statsmodels would also emit NaN slopes
     # and a NaN R² there, and the FM layer drops them per-column (.dropna()

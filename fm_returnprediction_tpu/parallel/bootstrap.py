@@ -18,8 +18,10 @@ Design (matching the FM layer's validity semantics):
   ``j % L`` from a uniformly drawn start in ``[0, n_p − L]``; the replicate
   statistic is the mean of the first ``n_p`` positions. With static shapes
   this is a gather — no dynamic control flow, jit/TPU friendly.
-- Bootstrap SE per predictor = std (ddof=1) of replicate means; also
-  returned are the replicate-mean means for bias diagnostics.
+- Bootstrap SE per predictor = std (ddof=1) of replicate means. On a mesh,
+  each device reduces its local replicate means to first/second moment sums
+  and ONE psum of 2·P floats combines them — communication is O(P)
+  regardless of replicate count.
 
 Block length defaults to ``nw_lags + 1 = 5`` months, the standard choice for
 matching a lag-L Newey-West horizon.
@@ -35,7 +37,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fm_returnprediction_tpu.ops.newey_west import compact_front
-from fm_returnprediction_tpu.parallel.mesh import make_mesh
 
 __all__ = ["BootstrapResult", "block_bootstrap_se", "bootstrap_replicate_means"]
 
@@ -52,7 +53,7 @@ def _replicate_means_one_predictor(series, n_valid, keys, block_length):
 
     series : (T,) compacted values (valid entries first, tail zeroed)
     n_valid: () number of valid entries
-    keys   : (B, 2) PRNG keys, one per replicate
+    keys   : (B,) typed PRNG keys, one per replicate
     Returns (B,) replicate means. Predictors with n_valid < 2 yield NaN.
     """
     t_max = series.shape[0]
@@ -91,6 +92,40 @@ def bootstrap_replicate_means(
     )(series, counts)
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_bootstrap_moments(mesh: Optional[Mesh], block_length: int, axis_name: str):
+    """One compiled bootstrap program per (mesh, block length).
+
+    Like ``fm_sharded._jitted_fm``: a closure freshly defined per call would
+    defeat jit's function-identity cache and retrace/recompile the
+    10k-replicate program on every invocation of a 3×3 model sweep.
+
+    Returns a jitted ``(keys, slopes, slope_valid) -> (Σmeans, Σmeans²)``;
+    both outputs are (P,) — the moment sums the SE needs — so the mesh
+    version psums exactly 2·P floats and replicates the result.
+    """
+
+    def moments(keys, slopes, slope_valid):
+        means = bootstrap_replicate_means(slopes, slope_valid, keys, block_length)
+        return means.sum(axis=0), jnp.sum(means * means, axis=0)
+
+    if mesh is None:
+        return jax.jit(moments)
+
+    def kernel(keys_l, slopes_r, valid_r):
+        local = moments(keys_l, slopes_r, valid_r)
+        return jax.lax.psum(local, axis_name)  # 2·P floats over ICI
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()),
+            out_specs=(P(), P()),
+        )
+    )
+
+
 def block_bootstrap_se(
     slopes: jnp.ndarray,
     slope_valid: jnp.ndarray,
@@ -115,29 +150,19 @@ def block_bootstrap_se(
     slope_valid = jnp.asarray(slope_valid)
 
     if mesh is None:
-        keys = jax.random.split(key, n_replicates)
-        means = bootstrap_replicate_means(slopes, slope_valid, keys, block_length)
         b = n_replicates
+        keys = jax.random.split(key, b)
     else:
         d = mesh.shape[axis_name]
         b = -(-n_replicates // d) * d
-        keys = jax.random.split(key, b)
-
-        def kernel(keys_l, slopes_r, valid_r):
-            return bootstrap_replicate_means(
-                slopes_r, valid_r, keys_l, block_length
-            )
-
-        shard = jax.shard_map(
-            kernel,
-            mesh=mesh,
-            in_specs=(P(axis_name), P(), P()),
-            out_specs=P(axis_name),
+        keys = jax.device_put(
+            jax.random.split(key, b), NamedSharding(mesh, P(axis_name))
         )
-        keys = jax.device_put(keys, NamedSharding(mesh, P(axis_name)))
-        means = shard(keys, slopes, slope_valid)  # (B, P), replicate-sharded
+
+    run = _jitted_bootstrap_moments(mesh, block_length, axis_name)
+    s1, s2 = run(keys, slopes, slope_valid)
 
     bf = jnp.asarray(b, dtype=slopes.dtype)
-    mean = jnp.mean(means, axis=0)
-    var = jnp.sum((means - mean[None, :]) ** 2, axis=0) / (bf - 1.0)
-    return BootstrapResult(jnp.sqrt(var), mean, b, block_length)
+    mean = s1 / bf
+    var = (s2 - bf * mean * mean) / (bf - 1.0)
+    return BootstrapResult(jnp.sqrt(jnp.maximum(var, 0.0)), mean, b, block_length)

@@ -29,72 +29,21 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fm_returnprediction_tpu.ops.fama_macbeth import (
     FamaMacbethSummary,
     fama_macbeth_summary,
 )
-from fm_returnprediction_tpu.ops.ols import CSRegressionResult, row_validity
+from fm_returnprediction_tpu.ops.ols import (
+    CSRegressionResult,
+    row_validity,
+    solve_from_stats,
+    sufficient_stats,
+)
 from fm_returnprediction_tpu.parallel.mesh import make_mesh, shard_panel
 
 __all__ = ["monthly_cs_ols_sharded", "fama_macbeth_sharded"]
-
-_PRECISION = jax.lax.Precision.HIGHEST
-
-
-def _local_sufficient_stats(y, x, mask):
-    """Per-device contraction of the local firm slice into month-wise
-    sufficient statistics. Shapes (local): y (T, Nl), x (T, Nl, P).
-
-    Returns (gram (T,Q,Q), moment (T,Q), n (T,), ysum (T,), yy (T,)) with
-    Q = P + 1 (intercept column first, as the reference builds
-    ``sm.add_constant``-style designs at ``src/regressions.py:49``).
-    """
-    valid = row_validity(y, x, mask)
-    v = valid.astype(x.dtype)
-    ones = jnp.ones_like(y)
-    x_aug = jnp.concatenate(
-        [ones[..., None], jnp.where(valid[..., None], x, 0.0)], axis=-1
-    )
-    x_aug = x_aug * v[..., None]
-    y_z = jnp.where(valid, y, 0.0)
-
-    gram = jnp.einsum("tnp,tnq->tpq", x_aug, x_aug, precision=_PRECISION)
-    moment = jnp.einsum("tnp,tn->tp", x_aug, y_z, precision=_PRECISION)
-    n = v.sum(axis=1)
-    ysum = y_z.sum(axis=1)
-    yy = jnp.sum(y_z * y_z, axis=1)
-    return gram, moment, n, ysum, yy
-
-
-def _solve_from_stats(gram, moment, n, ysum, yy) -> CSRegressionResult:
-    """Replicated month solves from globally-summed sufficient statistics.
-
-    Reproduces ``ops.ols._solve_month`` (solver="normal") semantics:
-    skipped months carry zero slopes/R² and ``month_valid=False``; R² is the
-    centered statsmodels ``rsquared`` (``src/regressions.py:60-66``),
-    reconstructed as 1 − SSE/SST with SSE = yᵀy − 2βᵀb + βᵀGβ.
-    """
-    q = gram.shape[-1]
-    month_valid = n >= q
-    eye = jnp.eye(q, dtype=gram.dtype)
-    safe_gram = jnp.where(month_valid[:, None, None], gram, eye)
-    with jax.default_matmul_precision("highest"):
-        beta = jnp.einsum(
-            "tpq,tq->tp", jnp.linalg.pinv(safe_gram), moment, precision=_PRECISION
-        )
-    beta = jnp.where(month_valid[:, None], beta, 0.0)
-
-    bg = jnp.einsum("tp,tpq,tq->t", beta, gram, beta, precision=_PRECISION)
-    bm = jnp.einsum("tp,tp->t", beta, moment, precision=_PRECISION)
-    sse = yy - 2.0 * bm + bg
-    nf = jnp.maximum(n, 1.0)
-    sst = yy - ysum * ysum / nf
-    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
-    r2 = jnp.where(month_valid, r2, 0.0)
-    return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, n, month_valid)
 
 
 def monthly_cs_ols_sharded(
@@ -107,9 +56,11 @@ def monthly_cs_ols_sharded(
     """
 
     def kernel(y_l, x_l, mask_l):
-        stats = _local_sufficient_stats(y_l, x_l, mask_l)
+        # Sufficient stats are additive over firm shards (ops.ols docstring),
+        # so the local contraction + one psum == the global contraction.
+        stats = sufficient_stats(y_l, x_l, row_validity(y_l, x_l, mask_l))
         stats = jax.lax.psum(stats, axis_name)  # one ICI collective
-        return _solve_from_stats(*stats)
+        return CSRegressionResult(*solve_from_stats(stats))
 
     shard = jax.shard_map(
         kernel,

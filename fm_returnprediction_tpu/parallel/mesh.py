@@ -26,6 +26,7 @@ import math
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -78,8 +79,6 @@ def pad_to_multiple(arr: jax.Array, axis: int, multiple: int, fill=0.0) -> jax.A
         return arr
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, target - size)
-    import jax.numpy as jnp
-
     return jnp.pad(arr, widths, constant_values=fill)
 
 
@@ -93,8 +92,6 @@ def shard_panel(y, x, mask, mesh: Mesh, axis_name: str = "firms"):
     (``ops.ols.row_validity``) drops them without special cases.
     """
     d = mesh.shape[axis_name]
-    import jax.numpy as jnp
-
     y = pad_to_multiple(jnp.asarray(y), axis=1, multiple=d, fill=jnp.nan)
     x = pad_to_multiple(jnp.asarray(x), axis=1, multiple=d, fill=jnp.nan)
     mask = pad_to_multiple(jnp.asarray(mask), axis=1, multiple=d, fill=False)

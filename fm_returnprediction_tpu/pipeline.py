@@ -166,13 +166,20 @@ def _main() -> None:
     parser.add_argument("--raw-data-dir", default=None)
     parser.add_argument("--output-dir", default=None)
     parser.add_argument("--synthetic", action="store_true")
-    parser.add_argument("--firms", type=int, default=100, help="synthetic only")
-    parser.add_argument("--months", type=int, default=120, help="synthetic only")
+    parser.add_argument(
+        "--firms", type=int, default=None, help="synthetic only (default 100)"
+    )
+    parser.add_argument(
+        "--months", type=int, default=None, help="synthetic only (default 120)"
+    )
     args = parser.parse_args()
 
-    if not args.synthetic and (args.firms != 100 or args.months != 120):
+    if not args.synthetic and (args.firms is not None or args.months is not None):
         parser.error("--firms/--months only apply with --synthetic")
-    cfg = SyntheticConfig(n_firms=args.firms, n_months=args.months)
+    cfg = SyntheticConfig(
+        n_firms=args.firms if args.firms is not None else 100,
+        n_months=args.months if args.months is not None else 120,
+    )
     result = run_pipeline(
         raw_data_dir=args.raw_data_dir,
         output_dir=args.output_dir,
