@@ -82,6 +82,39 @@ class DensePanel:
         idx = [self.var_index(n) for n in names]
         return self.values[:, :, idx]
 
+    def save(self, path) -> None:
+        """Checkpoint the panel as one compressed npz.
+
+        The reference's checkpoint substrate stops at raw pulls — every run
+        recomputes all intermediates from raw parquet (SURVEY §5
+        "Checkpoint/resume": post-transform frames are NOT cached). The
+        dense panel is the expensive intermediate here, so it checkpoints
+        between the panel-build and FM-compute task-graph stages.
+        """
+        import pathlib
+
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        np.savez_compressed(
+            p,
+            values=self.values,
+            mask=self.mask,
+            months=self.months.astype("datetime64[ns]").astype(np.int64),
+            ids=np.asarray(self.ids),
+            var_names=np.asarray(self.var_names, dtype=object),
+        )
+
+    @classmethod
+    def load(cls, path) -> "DensePanel":
+        with np.load(path, allow_pickle=True) as z:
+            return cls(
+                values=z["values"],
+                mask=z["mask"],
+                months=z["months"].astype("datetime64[ns]"),
+                ids=z["ids"],
+                var_names=[str(v) for v in z["var_names"]],
+            )
+
 
 def long_to_dense(
     df: pd.DataFrame,

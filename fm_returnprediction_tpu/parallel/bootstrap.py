@@ -100,28 +100,40 @@ def _jitted_bootstrap_moments(mesh: Optional[Mesh], block_length: int, axis_name
     defeat jit's function-identity cache and retrace/recompile the
     10k-replicate program on every invocation of a 3×3 model sweep.
 
-    Returns a jitted ``(keys, slopes, slope_valid) -> (Σmeans, Σmeans²)``;
-    both outputs are (P,) — the moment sums the SE needs — so the mesh
-    version psums exactly 2·P floats and replicates the result.
+    Returns a jitted ``(keys, slopes, slope_valid) -> (Σd, Σd²)`` where
+    ``d = replicate mean − pilot mean`` and the pilot is the full-sample
+    mean slope (deterministic, identical on every device). Centering before
+    the moment reduction keeps f32 runs away from the E[x²]−μ² catastrophic
+    cancellation (replicate spread can be orders of magnitude below the
+    mean). Both outputs are (P,), so the mesh version psums exactly 2·P
+    floats and replicates the result.
     """
 
     def moments(keys, slopes, slope_valid):
+        v = slope_valid.astype(slopes.dtype)
+        pilot = jnp.sum(jnp.where(slope_valid, slopes, 0.0), axis=0) / jnp.maximum(
+            v.sum(axis=0), 1.0
+        )
         means = bootstrap_replicate_means(slopes, slope_valid, keys, block_length)
-        return means.sum(axis=0), jnp.sum(means * means, axis=0)
+        d = means - pilot[None, :]
+        return d.sum(axis=0), jnp.sum(d * d, axis=0), pilot
 
     if mesh is None:
         return jax.jit(moments)
 
     def kernel(keys_l, slopes_r, valid_r):
-        local = moments(keys_l, slopes_r, valid_r)
-        return jax.lax.psum(local, axis_name)  # 2·P floats over ICI
+        s1, s2, pilot = moments(keys_l, slopes_r, valid_r)
+        # pilot is a pure function of the replicated slopes — identical on
+        # every device, so it is NOT psummed.
+        s1, s2 = jax.lax.psum((s1, s2), axis_name)  # 2·P floats over ICI
+        return s1, s2, pilot
 
     return jax.jit(
         jax.shard_map(
             kernel,
             mesh=mesh,
             in_specs=(P(axis_name), P(), P()),
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P()),
         )
     )
 
@@ -158,11 +170,18 @@ def block_bootstrap_se(
         keys = jax.device_put(
             jax.random.split(key, b), NamedSharding(mesh, P(axis_name))
         )
+        # Replicate the (small) slope series across the mesh so the jitted
+        # shard_map sees consistent placements even when slopes arrived
+        # committed to a single device (e.g. as another jit's output).
+        slopes = jax.device_put(slopes, NamedSharding(mesh, P()))
+        slope_valid = jax.device_put(slope_valid, NamedSharding(mesh, P()))
 
     run = _jitted_bootstrap_moments(mesh, block_length, axis_name)
-    s1, s2 = run(keys, slopes, slope_valid)
+    s1, s2, pilot = run(keys, slopes, slope_valid)
 
+    # Moments are of deviations from the pilot mean: mean = pilot + Σd/B,
+    # var = (Σd² − (Σd)²/B)/(B−1) — numerically safe because d is small.
     bf = jnp.asarray(b, dtype=slopes.dtype)
-    mean = s1 / bf
-    var = (s2 - bf * mean * mean) / (bf - 1.0)
+    mean = pilot + s1 / bf
+    var = (s2 - s1 * s1 / bf) / (bf - 1.0)
     return BootstrapResult(jnp.sqrt(jnp.maximum(var, 0.0)), mean, b, block_length)

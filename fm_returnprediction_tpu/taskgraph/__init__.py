@@ -1,0 +1,22 @@
+"""Orchestration layer (L7): incremental task graph + the pipeline DAG.
+
+Replaces the reference's doit build system (``dodo.py``) with an in-package
+engine (sqlite state, content-hash deps, green/SLURM reporters) and the
+Lewellen pipeline expressed as five tasks with a dense-panel checkpoint.
+"""
+
+from fm_returnprediction_tpu.taskgraph.engine import (
+    GreenReporter,
+    PlainReporter,
+    Task,
+    TaskRunner,
+)
+from fm_returnprediction_tpu.taskgraph.tasks import build_tasks
+
+__all__ = [
+    "GreenReporter",
+    "PlainReporter",
+    "Task",
+    "TaskRunner",
+    "build_tasks",
+]

@@ -1,0 +1,51 @@
+"""Task-graph CLI — the ``doit`` command surface (``README.md:27-31``).
+
+    python -m fm_returnprediction_tpu.taskgraph                 # run all
+    python -m fm_returnprediction_tpu.taskgraph reports          # run up to a task
+    python -m fm_returnprediction_tpu.taskgraph --list           # show tasks
+    python -m fm_returnprediction_tpu.taskgraph --forget         # drop state
+    python -m fm_returnprediction_tpu.taskgraph --synthetic      # fake-WRDS backend
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from fm_returnprediction_tpu.settings import config
+from fm_returnprediction_tpu.taskgraph.engine import TaskRunner, write_timing_log
+from fm_returnprediction_tpu.taskgraph.tasks import build_tasks
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fm_returnprediction_tpu.taskgraph")
+    parser.add_argument("tasks", nargs="*", help="tasks to run (default: all)")
+    parser.add_argument("--list", action="store_true", help="list tasks and exit")
+    parser.add_argument("--forget", action="store_true", help="drop recorded state")
+    parser.add_argument("--force", action="store_true", help="ignore up-to-date state")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="use the synthetic fake-WRDS backend")
+    parser.add_argument("--db", default=None, help="state db path")
+    args = parser.parse_args(argv)
+
+    tasks = build_tasks(synthetic=args.synthetic)
+    db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"
+
+    with TaskRunner(tasks, db_path=db) as runner:
+        if args.list:
+            for t in tasks:
+                state = "up-to-date" if runner.is_up_to_date(t) else "stale"
+                print(f"{t.name:<14} [{state}] {t.doc}")
+            return 0
+        if args.forget:
+            runner.forget(args.tasks or None)
+            print("state forgotten")
+            return 0
+        ok = runner.run(args.tasks or None, force=args.force)
+        write_timing_log(runner, Path(config("OUTPUT_DIR")) / "task_timings.json")
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""The Lewellen pipeline as a task graph.
+
+Re-provides the reference's doit DAG (``dodo.py:115-206``: config →
+convert/run notebooks → artifacts) with explicit data-stage tasks instead of
+notebook subprocesses, and adds the dense-panel checkpoint between the
+panel-build and report stages (SURVEY §5: the reference recomputes every
+intermediate from raw parquet each run; the panel npz makes the FM/report
+stage resumable on its own).
+
+Stages (task name → targets):
+
+- ``config``      → the `_data`/`_output` directory tree
+  (reference ``task_config`` → ``settings.create_dirs``,
+  ``dodo.py:115-122``, ``src/settings.py:96-105``)
+- ``pull_data``   → the five raw parquet files (WRDS when credentials are
+  configured, synthetic otherwise — the hermetic fake-WRDS backend)
+- ``build_panel`` → ``lewellen_panel.npz`` + ``factors_dict.json`` in
+  PROCESSED_DATA_DIR (the checkpoint)
+- ``reports``     → Table 1/2 pickles + ``.tex`` + ``figure_1.pdf`` +
+  ``data_saved.marker`` in OUTPUT_DIR (contract of ``save_data``,
+  ``src/calc_Lewellen_2014.py:959-1005``)
+- ``latex``       → compiled report PDF (``pdflatex`` run twice,
+  continue-on-error, ``src/calc_Lewellen_2014.py:1197-1209``)
+
+Run: ``python -m fm_returnprediction_tpu.taskgraph [task ...]``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from fm_returnprediction_tpu.settings import config, create_dirs
+from fm_returnprediction_tpu.taskgraph.engine import Task
+
+__all__ = ["build_tasks", "PANEL_FILE", "FACTORS_FILE"]
+
+PANEL_FILE = "lewellen_panel.npz"
+FACTORS_FILE = "factors_dict.json"
+
+
+def _raw_paths(raw_dir: Path) -> List[Path]:
+    from fm_returnprediction_tpu.pipeline import RAW_FILE_NAMES
+
+    return [raw_dir / name for name in RAW_FILE_NAMES.values()]
+
+
+BACKEND_MARKER = "_data_backend.txt"
+
+
+def _backend_name(synthetic: bool) -> str:
+    return "synthetic" if synthetic else "wrds"
+
+
+def _backend_matches(raw_dir: Path, synthetic: bool) -> bool:
+    """Uptodate check: the cached raw data must come from the requested
+    backend — without this, toggling --synthetic would silently reuse the
+    other backend's parquet (targets exist, hashes unchanged)."""
+    marker = raw_dir / BACKEND_MARKER
+    return marker.exists() and marker.read_text().strip() == _backend_name(synthetic)
+
+
+def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
+    from fm_returnprediction_tpu.utils.cache import save_cache_data
+
+    raw_dir.mkdir(parents=True, exist_ok=True)
+    (raw_dir / BACKEND_MARKER).write_text(_backend_name(synthetic))
+    if synthetic:
+        from fm_returnprediction_tpu.data.synthetic import generate_synthetic_wrds
+        from fm_returnprediction_tpu.pipeline import RAW_FILE_NAMES
+
+        data = generate_synthetic_wrds(synthetic_config)
+        for key, name in RAW_FILE_NAMES.items():
+            save_cache_data(data[key], raw_dir, file_name=name)
+        return
+
+    from fm_returnprediction_tpu.data.wrds_pull import (
+        pull_Compustat,
+        pull_CRSP_Comp_link_table,
+        pull_CRSP_index,
+        pull_CRSP_stock,
+    )
+
+    user = config("WRDS_USERNAME")
+    start, end = config("START_DATE"), config("END_DATE")
+    pull_CRSP_stock(freq="D", start_date=start, end_date=end, wrds_username=user,
+                    data_dir=raw_dir, file_name="CRSP_stock_d.parquet")
+    pull_CRSP_stock(freq="M", start_date=start, end_date=end, wrds_username=user,
+                    data_dir=raw_dir, file_name="CRSP_stock_m.parquet")
+    pull_Compustat(start_date=start, end_date=end, wrds_username=user,
+                   data_dir=raw_dir, file_name="Compustat_fund.parquet")
+    pull_CRSP_Comp_link_table(wrds_username=user, data_dir=raw_dir,
+                              file_name="CRSP_Comp_Link_Table.parquet")
+    pull_CRSP_index(freq="D", start_date=start, end_date=end, wrds_username=user,
+                    data_dir=raw_dir, file_name="CRSP_index_d.parquet")
+
+
+def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
+    from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
+
+    panel, factors_dict = build_panel(load_raw_data(raw_dir))
+    panel.save(processed_dir / PANEL_FILE)
+    with open(processed_dir / FACTORS_FILE, "w") as f:
+        json.dump(factors_dict, f, indent=2)
+
+
+def _reports(processed_dir: Path, output_dir: Path) -> None:
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.reporting.figure1 import create_figure_1
+    from fm_returnprediction_tpu.reporting.latex import save_data
+    from fm_returnprediction_tpu.reporting.table1 import build_table_1
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+
+    panel = DensePanel.load(processed_dir / PANEL_FILE)
+    with open(processed_dir / FACTORS_FILE) as f:
+        factors_dict = json.load(f)
+    masks = compute_subset_masks(panel)
+    table_1 = build_table_1(panel, masks, factors_dict)
+    table_2 = build_table_2(panel, masks, factors_dict)
+    figure_1 = create_figure_1(panel, masks)
+    save_data(table_1, table_2, figure_1, output_dir)
+
+
+def _latex(output_dir: Path) -> None:
+    from fm_returnprediction_tpu.reporting.latex import (
+        compile_latex_document,
+        create_latex_document,
+    )
+
+    tex = create_latex_document(output_dir)
+    if tex is not None:
+        compile_latex_document(tex)
+
+
+def build_tasks(
+    synthetic: bool = False,
+    synthetic_config=None,
+    raw_dir: Optional[Path] = None,
+    processed_dir: Optional[Path] = None,
+    output_dir: Optional[Path] = None,
+) -> List[Task]:
+    """Assemble the DAG against the configured directory tree."""
+    raw_dir = Path(raw_dir or config("RAW_DATA_DIR"))
+    processed_dir = Path(processed_dir or config("PROCESSED_DATA_DIR"))
+    output_dir = Path(output_dir or config("OUTPUT_DIR"))
+    raw = _raw_paths(raw_dir)
+
+    return [
+        Task(
+            name="config",
+            actions=[create_dirs],
+            targets=[raw_dir, processed_dir, output_dir],
+            doc="Create the _data/_output directory tree",
+        ),
+        Task(
+            name="pull_data",
+            actions=[lambda: _pull_data(raw_dir, synthetic, synthetic_config)],
+            targets=raw,
+            task_dep=["config"],
+            uptodate=[lambda: _backend_matches(raw_dir, synthetic)],
+            doc="Pull WRDS data (or generate the synthetic universe)",
+        ),
+        Task(
+            name="build_panel",
+            actions=[lambda: _build_panel(raw_dir, processed_dir)],
+            file_dep=raw,
+            targets=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
+            task_dep=["pull_data"],
+            doc="Raw parquet → dense characteristic panel checkpoint",
+        ),
+        Task(
+            name="reports",
+            actions=[lambda: _reports(processed_dir, output_dir)],
+            file_dep=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
+            targets=[
+                output_dir / "table_1.pkl",
+                output_dir / "table_2.pkl",
+                output_dir / "figure_1.pdf",
+                output_dir / "data_saved.marker",
+            ],
+            task_dep=["build_panel"],
+            doc="Panel checkpoint → Table 1/2, Figure 1, artifacts",
+        ),
+        Task(
+            name="latex",
+            actions=[lambda: _latex(output_dir)],
+            file_dep=[output_dir / "table_1.pkl", output_dir / "table_2.pkl"],
+            task_dep=["reports"],
+            doc="Generate + compile the LaTeX report",
+        ),
+    ]

@@ -163,3 +163,19 @@ def test_bootstrap_short_series_nan():
     res = block_bootstrap_se(slopes, valid, jax.random.key(0), n_replicates=64)
     assert np.isnan(np.asarray(res.se)[0])  # 1 valid month → NaN
     assert np.isnan(np.asarray(res.se)[1])  # 0 valid months → NaN
+
+
+def test_bootstrap_f32_tiny_spread_not_zero():
+    """f32 + near-constant slope series: the centered moment reduction must
+    not cancel to SE=0 (the naive E[x2]-mean^2 form does)."""
+    rng = np.random.default_rng(11)
+    t = 400
+    s = (0.05 + 1e-6 * rng.standard_normal((t, 1))).astype(np.float32)
+    valid = jnp.ones((t, 1), dtype=bool)
+    res = block_bootstrap_se(
+        jnp.asarray(s), valid, jax.random.key(2), n_replicates=1000, block_length=5
+    )
+    se = float(np.asarray(res.se)[0])
+    expect = float(s.std(ddof=1) / np.sqrt(t))  # iid scale for white noise
+    assert se > 0.0
+    assert 0.2 * expect < se < 5 * expect
