@@ -1,0 +1,121 @@
+"""L1 DataFrame helpers + the published-oracle module."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.reporting.published import (
+    PUBLISHED_TABLE_1,
+    compare_table_1,
+    published_table_1,
+)
+from fm_returnprediction_tpu.utils.frames import (
+    filter_columns_and_indexes,
+    fix_dates_index,
+    time_series_to_df,
+)
+
+
+# -- frames ---------------------------------------------------------------
+
+def test_time_series_to_df_variants():
+    s1 = pd.Series([1, 2], index=[0, 1], name="a")
+    s2 = pd.Series([3.0, 4.0], index=[1, 2], name="b")
+    df = time_series_to_df([s1, s2])
+    assert list(df.columns) == ["a", "b"]
+    assert len(df) == 3 and np.isnan(df.loc[0, "b"])
+    assert time_series_to_df(s1).shape == (2, 1)
+    pd.testing.assert_frame_equal(time_series_to_df(df), df)
+    with pytest.raises(TypeError):
+        time_series_to_df([s1, "not-a-series"])
+    with pytest.raises(TypeError):
+        time_series_to_df(42)
+
+
+def test_fix_dates_index_promotes_date_column():
+    df = pd.DataFrame({"Date": ["2020-01-31", "2020-02-29"], "x": ["1", "2"]})
+    out = fix_dates_index(df)
+    assert out.index.name == "date"
+    assert isinstance(out.index, pd.DatetimeIndex)
+    assert out["x"].dtype == float
+
+
+def test_fix_dates_index_existing_datetime_index():
+    idx = pd.to_datetime(["2020-01-31", "2020-02-29"])
+    df = pd.DataFrame({"x": [1, 2]}, index=idx)
+    out = fix_dates_index(df)
+    assert out.index.name == "date"
+
+
+def test_filter_columns_and_indexes():
+    df = pd.DataFrame(
+        np.arange(12).reshape(3, 4),
+        columns=["alpha", "beta", "gamma", "Beta2"],
+        index=["row_a", "row_b", "other"],
+    )
+    kept = filter_columns_and_indexes(df, keep_columns=["beta"])
+    assert list(kept.columns) == ["beta", "Beta2"]  # case-insensitive substring
+    dropped = filter_columns_and_indexes(df, drop_columns=["beta"])
+    assert list(dropped.columns) == ["alpha", "gamma"]
+    kept_rows = filter_columns_and_indexes(df, keep_indexes=["row"])
+    assert list(kept_rows.index) == ["row_a", "row_b"]
+    # the reference's drop_indexes branch is broken (src/utils.py:462-464);
+    # ours must actually drop
+    dropped_rows = filter_columns_and_indexes(df, drop_indexes=["row"])
+    assert list(dropped_rows.index) == ["other"]
+    assert filter_columns_and_indexes("not a frame") == "not a frame"
+
+
+# -- published oracle -----------------------------------------------------
+
+def test_published_layout_matches_reference_contract():
+    """16 rows × 9 cols, publication row order, (Subset, Statistic) columns
+    (``src/test_calc_Lewellen_2014.py:20-66``)."""
+    t = published_table_1()
+    assert t.shape == (16, 9)
+    assert list(t.index[:4]) == [
+        "Return (%)", "LogSize_{-1}", "LogB/M_{-1}", "Return_{-2,-12}",
+    ]
+    assert t.columns.names == ["Subset", "Statistic"]
+    assert float(t.loc["Return (%)", ("All stocks", "Avg")]) == 1.27
+    assert float(t.loc["Sales/Price_{yr-1}", ("Large stocks", "N")]) == 865
+
+
+def test_published_computed_scope_excludes_turnover():
+    t = published_table_1(computed_only=True)
+    assert t.shape == (15, 9)
+    assert "Turnover_{-1,-12}" not in t.index
+    assert not PUBLISHED_TABLE_1["Turnover_{-1,-12}"][0]
+
+
+def test_compare_table_1_detects_mismatch():
+    oracle = published_table_1(computed_only=True)
+    diff = compare_table_1(oracle)          # oracle vs itself → all ok
+    assert len(diff) == 15 * 9 and diff["ok"].all()
+
+    perturbed = oracle.copy()
+    perturbed.loc["ROA_{yr-1}", ("All stocks", "Avg")] += 1.0
+    diff = compare_table_1(perturbed)
+    bad = diff[~diff["ok"]]
+    assert len(bad) == 1
+    assert bad.iloc[0]["variable"] == "ROA_{yr-1}" and bad.iloc[0]["stat"] == "Avg"
+
+
+def test_compare_table_1_label_map_and_missing_rows():
+    oracle = published_table_1(computed_only=True)
+    renamed = oracle.rename(index={"ROA_{yr-1}": "ROA (-1)"})
+    diff = compare_table_1(renamed, label_map={"ROA (-1)": "ROA_{yr-1}"})
+    assert set(diff["variable"]) == set(oracle.index)
+    # rows absent from the produced table are skipped, not errors
+    partial = oracle.iloc[:3]
+    diff = compare_table_1(partial)
+    assert set(diff["variable"]) == set(oracle.index[:3])
+
+
+def test_filter_series_input():
+    s = pd.Series([1, 2, 3], index=["alpha", "beta", "gamma"])
+    # column filters are no-ops on a Series; index filters apply
+    out = filter_columns_and_indexes(s, drop_columns=["alp"])
+    pd.testing.assert_series_equal(out, s)
+    out = filter_columns_and_indexes(s, drop_indexes=["alp"])
+    assert list(out.index) == ["beta", "gamma"]
