@@ -82,12 +82,17 @@ def rolling_vol_252_monthly(
     n_months: int,
     window: int = 252,
     min_periods: int = 100,
+    use_pallas: bool = None,
 ) -> jnp.ndarray:
     """Annualized 252-row rolling std of daily returns, sampled at each
-    firm-month's last observed day. Returns (n_months, N)."""
+    firm-month's last observed day. Returns (n_months, N).
+
+    ``use_pallas`` forwards to ``rolling_std``; callers tracing this inside
+    an SPMD-partitioned program (``parallel.daily_sharded``) must pass
+    ``False`` — GSPMD cannot partition the pallas custom-call."""
     plan = make_compaction(mask_d)
     comp_ret = jnp.where(plan.valid, compact(ret_d, plan), jnp.nan)
-    vol = rolling_std(comp_ret, window, min_periods) * jnp.sqrt(
+    vol = rolling_std(comp_ret, window, min_periods, use_pallas=use_pallas) * jnp.sqrt(
         jnp.asarray(float(window), dtype=ret_d.dtype)
     )
     vol_cal = scatter_back(vol, plan)

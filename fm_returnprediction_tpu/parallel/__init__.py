@@ -12,6 +12,9 @@ from fm_returnprediction_tpu.parallel.bootstrap import (
     block_bootstrap_se,
     bootstrap_replicate_means,
 )
+from fm_returnprediction_tpu.parallel.daily_sharded import (
+    daily_characteristics_sharded,
+)
 from fm_returnprediction_tpu.parallel.fm_sharded import (
     fama_macbeth_sharded,
     monthly_cs_ols_sharded,
@@ -27,6 +30,7 @@ __all__ = [
     "BootstrapResult",
     "block_bootstrap_se",
     "bootstrap_replicate_means",
+    "daily_characteristics_sharded",
     "fama_macbeth_sharded",
     "monthly_cs_ols_sharded",
     "host_local_mesh",

@@ -1,0 +1,97 @@
+"""Firm-sharded daily kernels: parity with the single-device path and the
+zero-communication guarantee (no collectives in the compiled program)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.ops.daily_kernels import (
+    rolling_vol_252_monthly,
+    weekly_rolling_beta_monthly,
+)
+from fm_returnprediction_tpu.parallel import make_mesh
+from fm_returnprediction_tpu.parallel.daily_sharded import (
+    _jitted_daily,
+    daily_characteristics_sharded,
+)
+
+
+@pytest.fixture(scope="module")
+def daily_inputs():
+    rng = np.random.default_rng(17)
+    n_days, n_firms, n_months = 400, 52, 19
+    n_weeks = 60
+    ret = 0.02 * rng.standard_normal((n_days, n_firms))
+    mask = rng.random((n_days, n_firms)) > 0.15
+    ret = np.where(rng.random((n_days, n_firms)) > 0.02, ret, np.nan)
+    mkt = 0.01 * rng.standard_normal(n_days)
+    mkt[rng.random(n_days) < 0.03] = np.nan
+    month_id = np.minimum(np.arange(n_days) // 21, n_months - 1)
+    week_id = np.minimum(np.arange(n_days) // 7, n_weeks - 1)
+    week_month_id = np.minimum(np.arange(n_weeks) * 7 // 21, n_months - 1)
+    return dict(
+        ret_d=ret, mask_d=mask, mkt_d=mkt,
+        month_id=month_id, week_id=week_id, week_month_id=week_month_id,
+        n_months=n_months, n_weeks=n_weeks,
+    )
+
+
+def test_sharded_daily_matches_single_device(daily_inputs):
+    d = daily_inputs
+    mesh = make_mesh(axis_name="firms")
+    vol_s, beta_s = daily_characteristics_sharded(mesh=mesh, **d)
+    n = d["ret_d"].shape[1]
+    vol_s = np.asarray(vol_s)[:, :n]
+    beta_s = np.asarray(beta_s)[:, :n]
+
+    vol_1 = np.asarray(rolling_vol_252_monthly(
+        jnp.asarray(d["ret_d"]), jnp.asarray(d["mask_d"]),
+        jnp.asarray(d["month_id"]), d["n_months"],
+    ))
+    beta_1 = np.asarray(weekly_rolling_beta_monthly(
+        jnp.asarray(d["ret_d"]), jnp.asarray(d["mask_d"]),
+        jnp.asarray(d["mkt_d"]), jnp.asarray(d["week_id"]), d["n_weeks"],
+        jnp.asarray(d["week_month_id"]), d["n_months"],
+    ))
+    np.testing.assert_allclose(vol_s, vol_1, rtol=1e-12, atol=0, equal_nan=True)
+    np.testing.assert_allclose(beta_s, beta_1, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_sharded_daily_outputs_stay_firm_sharded(daily_inputs):
+    d = daily_inputs
+    mesh = make_mesh(axis_name="firms")
+    vol_s, beta_s = daily_characteristics_sharded(mesh=mesh, **d)
+    assert vol_s.sharding.spec[1] == "firms"
+    assert beta_s.sharding.spec[1] == "firms"
+
+
+def test_sharded_daily_compiles_without_collectives(daily_inputs):
+    """Firms are independent: the partitioned program must contain no
+    cross-device communication at all."""
+    d = daily_inputs
+    mesh = make_mesh(axis_name="firms")
+    run = _jitted_daily(mesh, "firms", d["n_months"], d["n_weeks"], 252, 100, 156)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    strip = NamedSharding(mesh, P(None, "firms"))
+    rep = NamedSharding(mesh, P())
+    n_firms = d["ret_d"].shape[1]
+    pad = (-n_firms) % 8
+    ret = jnp.pad(jnp.asarray(d["ret_d"]), ((0, 0), (0, pad)),
+                  constant_values=jnp.nan)
+    mask = jnp.pad(jnp.asarray(d["mask_d"]), ((0, 0), (0, pad)))
+    args = (
+        jax.device_put(ret, strip),
+        jax.device_put(mask, strip),
+        jax.device_put(jnp.asarray(d["mkt_d"]), rep),
+        jax.device_put(jnp.isfinite(jnp.asarray(d["mkt_d"])), rep),
+        jax.device_put(jnp.asarray(d["month_id"]), rep),
+        jax.device_put(jnp.asarray(d["week_id"]), rep),
+        jax.device_put(jnp.asarray(d["week_month_id"]), rep),
+    )
+    hlo = run.lower(*args).compile().as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute", "all-to-all",
+               "reduce-scatter"):
+        assert op not in hlo, f"unexpected collective {op} in daily program"
