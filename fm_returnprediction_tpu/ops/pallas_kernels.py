@@ -1,0 +1,140 @@
+"""Pallas TPU kernels for the hot rolling-reduction family.
+
+The largest data volume in the pipeline is the daily (D, N) panel
+(D≈12,600 trading days × N≈10⁴ firms — the reference's polars beta kernel
+and 252-day rolling std, SURVEY §3.5). The rolling ops are memory-bound:
+the XLA path materializes separate full-size intermediates for the masked
+values, their squares, and the finite counts, then runs three cumulative
+sums — ~6 full HBM round-trips of the (D, N) array. The fused kernel here
+reads ``x`` ONCE and emits all three inclusive cumulative moments
+(Σx, Σx², Σ1{finite}) in a single pass, with the block-local cumulative sum
+computed as a lower-triangular matmul on the MXU and a (1, block) carry row
+propagated across the sequential time-grid dimension.
+
+Windowed reductions (rolling std/mean/sum) then follow from cumulative-sum
+differences exactly as in ``ops.rolling`` — same numerics, one HBM read.
+
+The kernel is TPU-only by construction; ``interpret=True`` runs it on CPU
+for the parity test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["masked_cumulative_moments", "rolling_std_fused"]
+
+
+def _moments_kernel(x_ref, csum_ref, csumsq_ref, ccnt_ref, carry_ref):
+    """One (BT, BN) tile: fused mask + three block cumsums + carry update.
+
+    Grid is (N-strips, T-blocks) with the T axis sequential (minormost), so
+    ``carry_ref`` — the running total at the end of the previous T block for
+    this firm strip — persists across T steps and resets at t-block 0.
+    """
+    it = pl.program_id(1)
+
+    @pl.when(it == 0)
+    def _reset():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+
+    x = x_ref[...]
+    bt, bn = x.shape
+    finite = jnp.isfinite(x)
+    xz = jnp.where(finite, x, 0.0)
+
+    # stacked (BT, 3·BN): [values | squares | counts] → ONE triangular
+    # matmul on the MXU produces all three inclusive block-cumsums.
+    stacked = jnp.concatenate([xz, xz * xz, finite.astype(x.dtype)], axis=1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (bt, bt), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (bt, bt), 1)
+    tri = (col <= row).astype(x.dtype)
+    cs = jax.lax.dot(tri, stacked, precision=jax.lax.Precision.HIGHEST)
+
+    cs = cs + carry_ref[0:1, :]
+    carry_ref[0:1, :] = cs[bt - 1 : bt, :]
+
+    csum_ref[...] = cs[:, 0:bn]
+    csumsq_ref[...] = cs[:, bn : 2 * bn]
+    ccnt_ref[...] = cs[:, 2 * bn : 3 * bn]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_t", "block_n", "interpret")
+)
+def masked_cumulative_moments(
+    x: jnp.ndarray,
+    block_t: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+):
+    """Inclusive cumulative (Σx, Σx², count) over axis 0, NaN-masked.
+
+    x : (T, N). Non-finite entries contribute zero to sums and squares and
+    zero to the count — exactly the masking ``ops.rolling`` applies before
+    its cumulative sums. Returns three (T, N) arrays.
+    """
+    t, n = x.shape
+    pt, pn = (-t) % block_t, (-n) % block_n
+    xp = jnp.pad(x, ((0, pt), (0, pn)), constant_values=jnp.nan)
+    tp, np_ = t + pt, n + pn
+    grid = (np_ // block_n, tp // block_t)
+
+    spec = pl.BlockSpec((block_t, block_n), lambda i_n, i_t: (i_t, i_n))
+    out_shape = jax.ShapeDtypeStruct((tp, np_), x.dtype)
+    csum, csumsq, ccnt = pl.pallas_call(
+        _moments_kernel,
+        grid=grid,
+        in_specs=[spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[out_shape, out_shape, out_shape],
+        scratch_shapes=[pltpu.VMEM((1, 3 * block_n), x.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(xp)
+    return csum[:t, :n], csumsq[:t, :n], ccnt[:t, :n]
+
+
+def rolling_std_fused(
+    x: jnp.ndarray,
+    window: int,
+    min_periods: int,
+    block_t: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Trailing-window sample std via the fused moments kernel.
+
+    Pandas ``rolling(window, min_periods).std()`` semantics, matching
+    ``ops.rolling.rolling_std`` (ddof=1; NaN until ``min_periods`` finite
+    entries in the window; NaN entries occupy window rows but are excluded
+    from the reduction — ``src/calc_Lewellen_2014.py:448-453``).
+    """
+    csum, csumsq, ccnt = masked_cumulative_moments(
+        x, block_t=block_t, block_n=block_n, interpret=interpret
+    )
+
+    def windowed(c):
+        if c.shape[0] <= window:
+            return c  # every trailing window is truncated at the start
+        lag = jnp.concatenate(
+            [jnp.zeros((window, c.shape[1]), c.dtype), c[:-window]], axis=0
+        )
+        return c - lag
+
+    s = windowed(csum)
+    s2 = windowed(csumsq)
+    cnt = windowed(ccnt)
+
+    cnt_safe = jnp.maximum(cnt, 2.0)
+    mean = s / jnp.maximum(cnt, 1.0)
+    var = (s2 - cnt * mean * mean) / (cnt_safe - 1.0)
+    std = jnp.sqrt(jnp.maximum(var, 0.0))
+    return jnp.where(cnt >= max(min_periods, 2), std, jnp.nan)

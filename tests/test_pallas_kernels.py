@@ -1,0 +1,108 @@
+"""Fused pallas rolling kernels vs the XLA path and the pandas oracle.
+
+Runs in interpreter mode on the CPU test backend; the TPU compile path is
+exercised by bench.py / the driver's compile check on real hardware.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.ops.pallas_kernels import (
+    masked_cumulative_moments,
+    rolling_std_fused,
+)
+from fm_returnprediction_tpu.ops.rolling import rolling_std
+
+
+@pytest.fixture(scope="module")
+def noisy_panel():
+    rng = np.random.default_rng(23)
+    x = 0.02 * rng.standard_normal((700, 40))
+    x[rng.random(x.shape) < 0.07] = np.nan
+    return x
+
+
+def test_moments_match_numpy(noisy_panel):
+    x = noisy_panel
+    csum, csumsq, ccnt = masked_cumulative_moments(
+        jnp.asarray(x), block_t=128, block_n=128, interpret=True
+    )
+    finite = np.isfinite(x)
+    xz = np.where(finite, x, 0.0)
+    np.testing.assert_allclose(np.asarray(csum), np.cumsum(xz, 0),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(csumsq), np.cumsum(xz * xz, 0),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(ccnt), np.cumsum(finite, 0))
+
+
+def test_moments_padding_and_carry(noisy_panel):
+    """T and N not multiples of the block sizes → padding is dropped and the
+    carry crosses T-block boundaries correctly."""
+    x = noisy_panel[:391, :37]
+    csum, _, ccnt = masked_cumulative_moments(
+        jnp.asarray(x), block_t=64, block_n=128, interpret=True
+    )
+    assert csum.shape == x.shape
+    xz = np.where(np.isfinite(x), x, 0.0)
+    np.testing.assert_allclose(np.asarray(csum), np.cumsum(xz, 0),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(ccnt)[-1], np.isfinite(x).sum(0))
+
+
+def test_rolling_std_fused_matches_xla_and_pandas(noisy_panel):
+    x = noisy_panel
+    window, min_periods = 252, 100
+    fused = np.asarray(rolling_std_fused(
+        jnp.asarray(x), window, min_periods,
+        block_t=128, block_n=128, interpret=True,
+    ))
+    xla = np.asarray(rolling_std(jnp.asarray(x), window, min_periods))
+    np.testing.assert_allclose(fused, xla, rtol=1e-7, atol=1e-10, equal_nan=True)
+
+    want = (
+        pd.DataFrame(x).rolling(window, min_periods=min_periods).std().to_numpy()
+    )
+    np.testing.assert_allclose(fused, want, rtol=1e-6, atol=1e-9, equal_nan=True)
+
+
+def test_rolling_std_fused_short_series():
+    x = np.full((10, 3), np.nan)
+    x[2:, 1] = 1.0
+    out = np.asarray(rolling_std_fused(
+        jnp.asarray(x), window=5, min_periods=2,
+        block_t=8, block_n=128, interpret=True,
+    ))
+    want = pd.DataFrame(x).rolling(5, min_periods=2).std().to_numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_rolling_std_dispatch_override(noisy_panel, monkeypatch):
+    """FMRP_PALLAS=0 forces the XLA path even off-CPU; explicit
+    use_pallas=False always wins; both paths agree."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(noisy_panel[:100, :10])
+    monkeypatch.setenv("FMRP_PALLAS", "0")
+    from fm_returnprediction_tpu.ops.rolling import _pallas_default
+
+    assert not _pallas_default()
+    monkeypatch.setenv("FMRP_PALLAS", "1")
+    assert _pallas_default()
+    a = rolling_std(x, 20, 5, use_pallas=False)
+    monkeypatch.delenv("FMRP_PALLAS")
+    b = rolling_std(x, 20, 5)  # CPU default → XLA path
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+def test_pallas_flag_disable_spellings(monkeypatch):
+    from fm_returnprediction_tpu.ops.rolling import _pallas_default
+
+    for off in ("0", "off", "no", "FALSE", ""):
+        monkeypatch.setenv("FMRP_PALLAS", off)
+        assert not _pallas_default(), off
+    for on in ("1", "true", "YES", "on"):
+        monkeypatch.setenv("FMRP_PALLAS", on)
+        assert _pallas_default(), on
