@@ -101,12 +101,15 @@ class DensePanel:
             mask=self.mask,
             months=self.months.astype("datetime64[ns]").astype(np.int64),
             ids=np.asarray(self.ids),
-            var_names=np.asarray(self.var_names, dtype=object),
+            # fixed-width unicode, NOT object dtype: keeps the checkpoint
+            # loadable with allow_pickle off (no pickle deserialization
+            # surface in a shared artifact).
+            var_names=np.asarray(self.var_names, dtype=np.str_),
         )
 
     @classmethod
     def load(cls, path) -> "DensePanel":
-        with np.load(path, allow_pickle=True) as z:
+        with np.load(path, allow_pickle=False) as z:
             return cls(
                 values=z["values"],
                 mask=z["mask"],

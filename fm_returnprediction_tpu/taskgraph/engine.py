@@ -229,7 +229,10 @@ class TaskRunner:
         self._db.commit()
 
     def timings(self) -> Dict[str, float]:
-        rows = self._db.execute("SELECT task, seconds FROM run_state").fetchall()
+        """Last SUCCESSFUL wall-clock seconds per task."""
+        rows = self._db.execute(
+            "SELECT task, seconds FROM run_state WHERE seconds IS NOT NULL"
+        ).fetchall()
         return dict(rows)
 
     # -- execution --------------------------------------------------------
@@ -274,9 +277,12 @@ class TaskRunner:
                         action()
             except Exception as err:  # noqa: BLE001 — report and halt
                 self.reporter.fail(task, err)
+                # Mark stale but PRESERVE the last successful timing — the
+                # timing log is the wall-clock record, not the failure log.
                 self._db.execute(
-                    "INSERT OR REPLACE INTO run_state VALUES (?,?,?,?)",
-                    (task.name, 0, 0.0, time.time()),
+                    "INSERT INTO run_state VALUES (?,0,NULL,?)"
+                    " ON CONFLICT(task) DO UPDATE SET ok=0, ts=excluded.ts",
+                    (task.name, time.time()),
                 )
                 self._db.commit()
                 return False

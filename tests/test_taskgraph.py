@@ -214,3 +214,24 @@ def test_backend_toggle_invalidates_pull(tmp_path):
         assert r.is_up_to_date(tasks_for(True))
         # same targets on disk, but requested backend differs → stale
         assert not r.is_up_to_date(tasks_for(False))
+
+
+def test_failure_preserves_last_success_timing(tmp_path):
+    src = tmp_path / "in.txt"
+    src.write_text("a")
+    state = {"fail": False}
+
+    def action():
+        if state["fail"]:
+            raise RuntimeError("boom")
+
+    task = Task("t", [action], file_dep=[src])
+    with TaskRunner([task], db_path=tmp_path / "db.sqlite",
+                    reporter=PlainReporter()) as r:
+        assert r.run()
+        first = r.timings()["t"]
+        state["fail"] = True
+        src.write_text("b")
+        assert not r.run()
+        assert r.timings()["t"] == first          # success timing survives
+        assert not r.is_up_to_date(task)          # but task is stale
