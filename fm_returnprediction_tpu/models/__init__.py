@@ -1,0 +1,25 @@
+"""Model layer: the Lewellen predictor sets and out-of-sample forecasting."""
+
+from fm_returnprediction_tpu.models.forecast import (
+    DecileSortResult,
+    ForecastResult,
+    decile_sorts,
+    rolling_er_forecast,
+)
+from fm_returnprediction_tpu.models.lewellen import (
+    FIGURE1_VARS,
+    MODELS,
+    ModelSpec,
+    model_by_name,
+)
+
+__all__ = [
+    "DecileSortResult",
+    "ForecastResult",
+    "decile_sorts",
+    "rolling_er_forecast",
+    "FIGURE1_VARS",
+    "MODELS",
+    "ModelSpec",
+    "model_by_name",
+]

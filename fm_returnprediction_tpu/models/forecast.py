@@ -1,0 +1,148 @@
+"""Rolling out-of-sample expected-return forecasts and decile sorts.
+
+North-star config (BASELINE.json configs[3]): "Rolling 10-yr window E[r]
+forecast + decile portfolio sorts". This is the out-of-sample half of
+Lewellen (2015): at month t, average the previous ``window`` months of
+Fama-MacBeth slopes (minimum ``min_periods``; STRICTLY past months — the
+rolling mean is lagged one result row), project
+``Ê[r]_{i,t} = ā + b̄' X_{i,t}`` for every firm with complete predictors,
+sort the cross-section into deciles on the forecast, and track each
+decile's realized equal-weighted return, plus the 10−1 spread with its
+Newey-West t-statistic.
+
+Everything after the panel is one jittable program: batched monthly OLS →
+compacted rolling slope means (``lax`` windowed sums) → masked decile
+breakpoints (batched sort) → one-hot decile averages (MXU einsum).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se
+from fm_returnprediction_tpu.ops.ols import monthly_cs_ols, row_validity
+from fm_returnprediction_tpu.ops.quantiles import masked_quantile
+
+__all__ = ["ForecastResult", "DecileSortResult", "rolling_er_forecast", "decile_sorts"]
+
+
+class ForecastResult(NamedTuple):
+    er: jnp.ndarray            # (T, N) out-of-sample E[r]; NaN where unavailable
+    er_valid: jnp.ndarray      # (T, N) bool
+    slopes_bar: jnp.ndarray    # (T, P) lagged rolling mean slopes (NaN-gated)
+    intercept_bar: jnp.ndarray # (T,)
+
+
+class DecileSortResult(NamedTuple):
+    decile_returns: jnp.ndarray  # (T, D) equal-weighted realized return per decile
+    decile_counts: jnp.ndarray   # (T, D)
+    month_valid: jnp.ndarray     # (T,) months with a usable forecast cross-section
+    mean_returns: jnp.ndarray    # (D,) time-series mean per decile
+    spread: jnp.ndarray          # () mean top-minus-bottom decile return
+    spread_tstat: jnp.ndarray    # () spread / NW SE
+    n_months: jnp.ndarray        # ()
+
+
+def rolling_er_forecast(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    window: int = 120,
+    min_periods: int = 60,
+    solver: str = "lstsq",
+    cs=None,
+) -> ForecastResult:
+    """Strictly out-of-sample Ê[r] from lagged rolling FM coefficients.
+
+    y, x, mask: the dense panel as in ``ops.fama_macbeth`` (x already holds
+    LAGGED characteristics, so coefficients from months ≤ t−1 applied to
+    x_t use only information available at t). Pass a precomputed ``cs``
+    (``CSRegressionResult`` for exactly these inputs) to reuse the figure
+    path's batched OLS instead of re-running it.
+    """
+    if cs is None:
+        cs = monthly_cs_ols(y, x, mask, solver=solver)
+
+    # Rolling mean over CONSECUTIVE surviving months (row-based, the
+    # reference's Figure-1 convention, src/calc_Lewellen_2014.py:926),
+    # shifted one row so month t sees only strictly-prior estimates.
+    coefs = jnp.concatenate([cs.intercept[:, None], cs.slopes], axis=1)  # (T, P+1)
+    bar = rolling_over_valid_rows(
+        coefs, cs.month_valid, window, min_periods, row_lag=1
+    )
+
+    intercept_bar = bar[:, 0]
+    slopes_bar = bar[:, 1:]
+
+    rows = row_validity(y, x, mask)  # forecast needs complete predictors
+    have_coef = jnp.isfinite(intercept_bar) & jnp.all(
+        jnp.isfinite(slopes_bar), axis=1
+    )
+    er = intercept_bar[:, None] + jnp.einsum(
+        "tnp,tp->tn", jnp.where(rows[..., None], x, 0.0), slopes_bar
+    )
+    er_valid = rows & have_coef[:, None]
+    er = jnp.where(er_valid, er, jnp.nan)
+    return ForecastResult(er, er_valid, slopes_bar, intercept_bar)
+
+
+def decile_sorts(
+    er: jnp.ndarray,
+    er_valid: jnp.ndarray,
+    realized: jnp.ndarray,
+    n_deciles: int = 10,
+    min_obs: int = 50,
+    nw_lags: int = 4,
+    weight: str = "reference",
+) -> DecileSortResult:
+    """Monthly decile portfolios on the forecast, realized-return averages.
+
+    er, er_valid, realized : (T, N). A month participates when it has at
+    least ``min_obs`` firms with forecast AND realized return. Breakpoints
+    are the masked 10th..90th percentiles (pandas-linear, matching the
+    pipeline's other quantiles); decile d spans (q_d, q_{d+1}].
+    """
+    ok = er_valid & jnp.isfinite(realized)
+    n = ok.sum(axis=1)
+    month_valid = n >= min_obs
+
+    qs = jnp.arange(1, n_deciles) / n_deciles
+    breaks = masked_quantile(er, ok, qs)                      # (T, D-1)
+    # decile index = number of interior breakpoints strictly below er
+    er_z = jnp.where(ok, er, 0.0)
+    dec = (er_z[:, :, None] > breaks[:, None, :]).sum(axis=-1)  # (T, N) in [0, D-1]
+
+    onehot = jax.nn.one_hot(dec, n_deciles, dtype=er.dtype)   # (T, N, D)
+    onehot = onehot * ok[:, :, None].astype(er.dtype)
+    counts = onehot.sum(axis=1)                                # (T, D)
+    ret_z = jnp.where(ok, realized, 0.0)
+    sums = jnp.einsum("tnd,tn->td", onehot, ret_z)
+    dec_ret = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), jnp.nan)
+    dec_ret = jnp.where(month_valid[:, None], dec_ret, jnp.nan)
+
+    # Summary statistics use months where EVERY decile is populated, so the
+    # 10−1 spread and per-decile means cover the same months.
+    usable = month_valid & jnp.all(counts > 0, axis=1)
+    mean_ret = jnp.where(
+        usable.sum() > 0,
+        jnp.where(usable[:, None], jnp.nan_to_num(dec_ret), 0.0).sum(axis=0)
+        / jnp.maximum(usable.sum(), 1).astype(er.dtype),
+        jnp.nan,
+    )
+    spread_series = dec_ret[:, -1] - dec_ret[:, 0]
+    spread_valid = usable & jnp.isfinite(spread_series)
+    spread = jnp.where(
+        spread_valid.sum() > 0,
+        jnp.where(spread_valid, spread_series, 0.0).sum()
+        / jnp.maximum(spread_valid.sum(), 1).astype(er.dtype),
+        jnp.nan,
+    )
+    se = nw_mean_se(spread_series, spread_valid, lags=nw_lags, weight=weight)
+    return DecileSortResult(
+        dec_ret, counts, month_valid, mean_ret, spread, spread / se,
+        spread_valid.sum(),
+    )

@@ -18,7 +18,14 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-__all__ = ["Compaction", "make_compaction", "compact", "scatter_back", "lag"]
+__all__ = [
+    "Compaction",
+    "make_compaction",
+    "compact",
+    "scatter_back",
+    "lag",
+    "rolling_over_valid_rows",
+]
 
 
 class Compaction(NamedTuple):
@@ -63,3 +70,34 @@ def lag(comp_values: jnp.ndarray, k: int, fill=jnp.nan) -> jnp.ndarray:
         return comp_values
     pad = jnp.full((k,) + comp_values.shape[1:], fill, dtype=comp_values.dtype)
     return jnp.concatenate([pad, comp_values[:-k]], axis=0)[: comp_values.shape[0]]
+
+
+def rolling_over_valid_rows(
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+    window: int,
+    min_periods: int,
+    row_lag: int = 0,
+) -> jnp.ndarray:
+    """Rolling mean over the SURVIVING rows of a (T, K) series, scattered
+    back to calendar slots.
+
+    The idiom shared by Figure 1's 120-month slope means
+    (``src/calc_Lewellen_2014.py:926`` rolls over the slope FRAME's rows,
+    i.e. consecutive surviving months, not calendar months) and the
+    out-of-sample forecast's lagged coefficient means: stably compact rows
+    where ``valid`` (T,) holds to the front, roll over the compacted axis,
+    optionally shift by ``row_lag`` rows (strictly-prior information), and
+    scatter back — invalid calendar slots give NaN.
+    """
+    from fm_returnprediction_tpu.ops.rolling import rolling_mean
+
+    order = jnp.argsort(~valid, stable=True)
+    inv_order = jnp.argsort(order, stable=True)
+    in_range = (jnp.arange(valid.shape[0]) < valid.sum())[:, None]
+    comp = jnp.where(in_range, values[order], jnp.nan)
+    rolled = rolling_mean(comp, window, min_periods)
+    if row_lag:
+        pad = jnp.full((row_lag, rolled.shape[1]), jnp.nan, rolled.dtype)
+        rolled = jnp.concatenate([pad, rolled[:-row_lag]], axis=0)
+    return jnp.where(valid[:, None], rolled[inv_order], jnp.nan)

@@ -33,6 +33,7 @@ from fm_returnprediction_tpu.panel.transform_compustat import (
 )
 from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
 from fm_returnprediction_tpu.data.wrds_pull import subset_to_common_stock_and_exchanges
+from fm_returnprediction_tpu.reporting.deciles import build_decile_table, save_decile_table
 from fm_returnprediction_tpu.reporting.figure1 import create_figure_1
 from fm_returnprediction_tpu.reporting.latex import (
     compile_latex_document,
@@ -64,6 +65,7 @@ class PipelineResult:
     table_2: pd.DataFrame
     figure_1: Optional[tuple]
     timer: StageTimer
+    decile_table: Optional[pd.DataFrame] = None
 
 
 def load_raw_data(raw_data_dir) -> Dict[str, pd.DataFrame]:
@@ -104,6 +106,7 @@ def run_pipeline(
     dtype=np.float64,
     make_figure: bool = True,
     compile_pdf: bool = True,
+    make_deciles: bool = True,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts."""
     timer = StageTimer()
@@ -136,14 +139,35 @@ def run_pipeline(
     with timer.stage("table_2"):
         table_2 = build_table_2(panel, subset_masks, factors_dict)
 
+    # The figure and decile paths share the same per-subset batched OLS on
+    # the figure's 5-variable set — compute each subset's result once.
+    cs_cache = {}
+    if make_figure or make_deciles:
+        from fm_returnprediction_tpu.reporting.figure1 import figure_cs
+
+        with timer.stage("figure_cs"):
+            needed = set(subset_masks) if make_deciles else {
+                "All stocks", "Large stocks"
+            }
+            for name in needed:
+                if name in subset_masks:
+                    cs_cache[name] = figure_cs(panel, subset_masks[name])
+
     figure_1 = None
     if make_figure:
         with timer.stage("figure_1"):
-            figure_1 = create_figure_1(panel, subset_masks)
+            figure_1 = create_figure_1(panel, subset_masks, cs_cache=cs_cache)
+
+    decile_table = None
+    if make_deciles:
+        with timer.stage("decile_table"):
+            decile_table = build_decile_table(panel, subset_masks, cs_cache=cs_cache)
 
     if output_dir is not None:
         with timer.stage("save_artifacts"):
             save_data(table_1, table_2, figure_1, output_dir)
+            if decile_table is not None:
+                save_decile_table(decile_table, output_dir)
             tex = create_latex_document(output_dir)
             if tex is not None and compile_pdf:
                 compile_latex_document(tex)
@@ -156,6 +180,7 @@ def run_pipeline(
         table_2=table_2,
         figure_1=figure_1,
         timer=timer,
+        decile_table=decile_table,
     )
 
 

@@ -19,11 +19,20 @@ import numpy as np
 import pandas as pd
 
 from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
+from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
 from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
-from fm_returnprediction_tpu.ops.rolling import rolling_mean
 from fm_returnprediction_tpu.panel.dense import DensePanel
 
-__all__ = ["rolling_slopes", "create_figure_1"]
+__all__ = ["figure_cs", "rolling_slopes", "create_figure_1"]
+
+
+def figure_cs(panel: DensePanel, subset_mask, return_col: str = "retx"):
+    """Batched monthly OLS on the figure's 5-variable set for one subset —
+    shared between the figure and the decile-sort forecast paths."""
+    xvars = list(FIGURE1_VARS.keys())
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(xvars))
+    return monthly_cs_ols(y, x, jnp.asarray(subset_mask))
 
 
 def rolling_slopes(
@@ -32,28 +41,25 @@ def rolling_slopes(
     window: int = 120,
     min_periods: int = 60,
     return_col: str = "retx",
+    cs=None,
 ) -> pd.DataFrame:
     """120-month rolling mean of monthly Model-2(figure) slopes for one subset.
 
     Returns a DataFrame indexed by month with one column per figure variable.
+    ``cs`` optionally reuses a precomputed ``figure_cs`` result.
     """
     xvars = list(FIGURE1_VARS.keys())
-    y = jnp.asarray(panel.var(return_col))
-    x = jnp.asarray(panel.select(xvars))
-    cs = monthly_cs_ols(y, x, jnp.asarray(subset_mask))
+    if cs is None:
+        cs = figure_cs(panel, subset_mask, return_col)
 
-    # Compact the surviving months to the front (chronological), roll over
-    # consecutive result rows, then label by the surviving months' dates.
-    valid = cs.month_valid
-    order = jnp.argsort(~valid, stable=True)
-    in_range = (jnp.arange(valid.shape[0]) < valid.sum())[:, None]
-    comp_slopes = jnp.where(in_range, cs.slopes[order], jnp.nan)
-    rolled = rolling_mean(comp_slopes, window, min_periods)
-
-    n_valid = int(valid.sum())
-    months = pd.DatetimeIndex(panel.months)[np.asarray(valid)]
+    # Roll over consecutive surviving result rows (the reference rolls the
+    # slope FRAME, src/calc_Lewellen_2014.py:926), label by their dates.
+    rolled_cal = rolling_over_valid_rows(cs.slopes, cs.month_valid,
+                                         window, min_periods)
+    valid = np.asarray(cs.month_valid)
+    months = pd.DatetimeIndex(panel.months)[valid]
     frame = pd.DataFrame(
-        np.asarray(rolled)[:n_valid], index=months, columns=xvars
+        np.asarray(rolled_cal)[valid], index=months, columns=xvars
     )
     frame.index.name = "mthcaldt"
     return frame
@@ -64,6 +70,7 @@ def create_figure_1(
     subset_masks: Dict[str, jnp.ndarray],
     save_plot: bool = False,
     output_dir=None,
+    cs_cache: Dict[str, object] = None,
 ) -> Tuple[object, object]:
     """Two stacked panels (All / Large stocks) of 10-year rolling slopes."""
     import matplotlib
@@ -75,7 +82,8 @@ def create_figure_1(
     for subset_name in ["All stocks", "Large stocks"]:
         if subset_name in subset_masks:
             slopes_dict[subset_name] = rolling_slopes(
-                panel, subset_masks[subset_name]
+                panel, subset_masks[subset_name],
+                cs=(cs_cache or {}).get(subset_name),
             )
 
     fig, axes = plt.subplots(nrows=2, ncols=1, figsize=(14, 10), sharex=True)

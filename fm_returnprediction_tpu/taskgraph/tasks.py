@@ -108,7 +108,11 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
 def _reports(processed_dir: Path, output_dir: Path) -> None:
     from fm_returnprediction_tpu.panel.dense import DensePanel
     from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
-    from fm_returnprediction_tpu.reporting.figure1 import create_figure_1
+    from fm_returnprediction_tpu.reporting.deciles import (
+        build_decile_table,
+        save_decile_table,
+    )
+    from fm_returnprediction_tpu.reporting.figure1 import create_figure_1, figure_cs
     from fm_returnprediction_tpu.reporting.latex import save_data
     from fm_returnprediction_tpu.reporting.table1 import build_table_1
     from fm_returnprediction_tpu.reporting.table2 import build_table_2
@@ -119,8 +123,12 @@ def _reports(processed_dir: Path, output_dir: Path) -> None:
     masks = compute_subset_masks(panel)
     table_1 = build_table_1(panel, masks, factors_dict)
     table_2 = build_table_2(panel, masks, factors_dict)
-    figure_1 = create_figure_1(panel, masks)
+    cs_cache = {name: figure_cs(panel, m) for name, m in masks.items()}
+    figure_1 = create_figure_1(panel, masks, cs_cache=cs_cache)
     save_data(table_1, table_2, figure_1, output_dir)
+    save_decile_table(
+        build_decile_table(panel, masks, cs_cache=cs_cache), output_dir
+    )
 
 
 def _latex(output_dir: Path) -> None:
@@ -178,6 +186,7 @@ def build_tasks(
                 output_dir / "table_1.pkl",
                 output_dir / "table_2.pkl",
                 output_dir / "figure_1.pdf",
+                output_dir / "decile_sorts.pkl",
                 output_dir / "data_saved.marker",
             ],
             task_dep=["build_panel"],
