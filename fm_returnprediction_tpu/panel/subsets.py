@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from fm_returnprediction_tpu.ops.quantiles import masked_quantile
 from fm_returnprediction_tpu.panel.dense import DensePanel
 
-__all__ = ["SUBSET_ORDER", "compute_subset_masks"]
+__all__ = ["SUBSET_ORDER", "compute_subset_masks", "flag_firms_missing_variables"]
 
 SUBSET_ORDER = ["All stocks", "All-but-tiny stocks", "Large stocks"]
 
@@ -43,3 +43,25 @@ def compute_subset_masks(panel: DensePanel) -> Dict[str, jnp.ndarray]:
         "All-but-tiny stocks": mask & (me >= me_20),
         "Large stocks": mask & (me >= me_50),
     }
+
+
+def flag_firms_missing_variables(
+    panel, needed_vars=("retx", "log_size", "log_bm", "return_12_2")
+) -> set:
+    """Firms with at least one required variable entirely missing.
+
+    Capability parity with the reference's ``filter_companies_table1``
+    (``src/calc_Lewellen_2014.py:468-502`` — dead code on its main path,
+    kept for API parity): a firm is flagged when, over its OBSERVED rows,
+    any needed variable is missing everywhere. Dense form: one reduction
+    over the time axis instead of a pandas groupby-apply.
+    """
+    import numpy as np
+
+    vals = panel.select(list(needed_vars))           # (T, N, V)
+    present = panel.mask[:, :, None]                 # (T, N, 1)
+    has_value = np.isfinite(vals) & present          # observed & non-missing
+    any_value = has_value.any(axis=0)                # (N, V)
+    observed = panel.mask.any(axis=0)                # (N,)
+    flagged = observed & (~any_value).any(axis=1)
+    return set(np.asarray(panel.ids)[flagged].tolist())

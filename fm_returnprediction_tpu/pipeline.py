@@ -191,6 +191,8 @@ def _main() -> None:
     parser.add_argument("--raw-data-dir", default=None)
     parser.add_argument("--output-dir", default=None)
     parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--backend", choices=["cpu", "tpu"], default=None,
+                        help="override the BACKEND setting")
     parser.add_argument(
         "--firms", type=int, default=None, help="synthetic only (default 100)"
     )
@@ -199,6 +201,9 @@ def _main() -> None:
     )
     args = parser.parse_args()
 
+    from fm_returnprediction_tpu.settings import apply_backend
+
+    apply_backend(args.backend)
     if not args.synthetic and (args.firms is not None or args.months is not None):
         parser.error("--firms/--months only apply with --synthetic")
     cfg = SyntheticConfig(

@@ -29,7 +29,7 @@ from platform import system
 
 import pandas as pd
 
-__all__ = ["config", "create_dirs", "get_os", "if_relative_make_abs", "read_env_file"]
+__all__ = ["apply_backend", "config", "create_dirs", "get_os", "if_relative_make_abs", "read_env_file"]
 
 
 def get_os() -> str:
@@ -115,6 +115,44 @@ def config(*args, **kwargs):
     if var is None:
         raise KeyError(f"{key} not found in settings, environment, or .env file.")
     return var
+
+
+def apply_backend(backend: str | None = None) -> str:
+    """Select the JAX platform per the ``BACKEND`` flag.
+
+    The north-star requirement puts backend selection at this exact layer
+    (``BACKEND=tpu`` in settings, surfaced through the task graph). Called
+    by the CLI entry points before any device computation:
+
+    - ``cpu``  → force the CPU platform (works after ``import jax`` as long
+      as the backend has not initialized yet);
+    - ``tpu``  → leave JAX's platform resolution alone (TPU plugins register
+      themselves; falling back to CPU is then JAX's own behavior).
+    """
+    import os
+    import sys
+
+    backend = (backend or config("BACKEND")).lower()
+    if backend not in ("cpu", "tpu"):
+        raise ValueError(f"BACKEND must be 'cpu' or 'tpu', got {backend!r}")
+    if backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "jax" in sys.modules:
+            jax = sys.modules["jax"]
+            # config.update silently has no effect once the backend has
+            # initialized — surface that instead of dropping the request.
+            import jax._src.xla_bridge as xb
+
+            if xb.backends_are_initialized():
+                if jax.default_backend() != "cpu":
+                    raise RuntimeError(
+                        "BACKEND=cpu requested but the JAX backend is already "
+                        "initialized on another platform; call apply_backend() "
+                        "(or set JAX_PLATFORMS=cpu) before any JAX computation."
+                    )
+            else:
+                jax.config.update("jax_platforms", "cpu")
+    return backend
 
 
 def create_dirs() -> None:

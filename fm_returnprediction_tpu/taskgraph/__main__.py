@@ -13,7 +13,7 @@ import argparse
 import sys
 from pathlib import Path
 
-from fm_returnprediction_tpu.settings import config
+from fm_returnprediction_tpu.settings import apply_backend, config
 from fm_returnprediction_tpu.taskgraph.engine import TaskRunner, write_timing_log
 from fm_returnprediction_tpu.taskgraph.tasks import build_tasks
 
@@ -27,7 +27,11 @@ def main(argv=None) -> int:
     parser.add_argument("--synthetic", action="store_true",
                         help="use the synthetic fake-WRDS backend")
     parser.add_argument("--db", default=None, help="state db path")
+    parser.add_argument("--backend", choices=["cpu", "tpu"], default=None,
+                        help="override the BACKEND setting")
     args = parser.parse_args(argv)
+
+    apply_backend(args.backend)
 
     tasks = build_tasks(synthetic=args.synthetic)
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"

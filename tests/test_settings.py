@@ -55,3 +55,18 @@ def test_create_dirs(tmp_path, monkeypatch):
         monkeypatch.setitem(settings.d, key, tmp_path / key.lower())
     settings.create_dirs()
     assert (tmp_path / "raw_data_dir").is_dir()
+
+
+def test_apply_backend_cpu_and_validation(monkeypatch):
+    import os
+
+    from fm_returnprediction_tpu.settings import apply_backend
+
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    assert apply_backend("cpu") == "cpu"
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert apply_backend("tpu") == "tpu"  # leaves resolution to JAX
+    import pytest
+
+    with pytest.raises(ValueError, match="BACKEND"):
+        apply_backend("cuda")

@@ -134,3 +134,24 @@ def test_merge_link_window(wrds):
     assert (check["jdate"] <= check["linkenddt"]).all()
     # fundamentals and market data coexist on each row
     assert merged[["me", "be", "assets", "retx"]].notna().all(axis=None)
+
+
+def test_flag_firms_missing_variables():
+    import numpy as np
+
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+    from fm_returnprediction_tpu.panel.subsets import flag_firms_missing_variables
+
+    t, n = 6, 4
+    vals = np.random.default_rng(0).standard_normal((t, n, 4))
+    mask = np.ones((t, n), dtype=bool)
+    # firm 1: variable 2 entirely missing; firm 3: never observed at all
+    vals[:, 1, 2] = np.nan
+    mask[:, 3] = False
+    panel = DensePanel(
+        values=vals, mask=mask,
+        months=np.arange("2001-01", "2001-07", dtype="datetime64[M]").astype("datetime64[ns]"),
+        ids=np.array([10, 11, 12, 13]),
+        var_names=["retx", "log_size", "log_bm", "return_12_2"],
+    )
+    assert flag_firms_missing_variables(panel) == {11}
