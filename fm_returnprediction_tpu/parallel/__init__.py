@@ -20,6 +20,7 @@ from fm_returnprediction_tpu.parallel.fm_sharded import (
     monthly_cs_ols_sharded,
 )
 from fm_returnprediction_tpu.parallel.mesh import (
+    default_mesh,
     host_local_mesh,
     make_mesh,
     pad_to_multiple,
@@ -31,6 +32,7 @@ __all__ = [
     "block_bootstrap_se",
     "bootstrap_replicate_means",
     "daily_characteristics_sharded",
+    "default_mesh",
     "fama_macbeth_sharded",
     "monthly_cs_ols_sharded",
     "host_local_mesh",

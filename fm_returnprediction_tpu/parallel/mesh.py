@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
+    "default_mesh",
     "make_mesh",
     "pad_to_multiple",
     "shard_panel",
@@ -103,3 +104,22 @@ def shard_panel(y, x, mask, mesh: Mesh, axis_name: str = "firms"):
         jax.device_put(x, s3),
         jax.device_put(mask, s2),
     )
+
+
+def default_mesh(axis_name: str = "firms"):
+    """The configured compute mesh, or None for single-device execution.
+
+    Honors ``MESH_DEVICES``: 1 (the default) returns None — multi-chip is
+    OPT-IN, so default numerics use the SVD parity solver regardless of how
+    many devices the machine happens to expose; 0 = all available devices;
+    N = exactly min(N, available). Single-device results return None so
+    callers fall back to the plain batched kernels.
+    """
+    from fm_returnprediction_tpu.settings import config
+
+    want = int(config("MESH_DEVICES"))
+    have = len(jax.devices())
+    n = have if want == 0 else min(want, have)
+    if n <= 1:
+        return None
+    return make_mesh(n_devices=n, axis_name=axis_name)

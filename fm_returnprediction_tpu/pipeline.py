@@ -107,6 +107,7 @@ def run_pipeline(
     make_figure: bool = True,
     compile_pdf: bool = True,
     make_deciles: bool = True,
+    use_mesh: Optional[bool] = None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts."""
     timer = StageTimer()
@@ -136,8 +137,20 @@ def run_pipeline(
     with timer.stage("table_1"):
         table_1 = build_table_1(panel, subset_masks, factors_dict)
 
+    mesh = None
+    if use_mesh or use_mesh is None:
+        import jax
+
+        from fm_returnprediction_tpu.parallel import default_mesh, make_mesh
+
+        mesh = default_mesh()  # opt-in via MESH_DEVICES (None when 1)
+        if use_mesh and mesh is None:
+            if len(jax.devices()) <= 1:
+                raise RuntimeError("use_mesh=True but only one device is available")
+            mesh = make_mesh(axis_name="firms")
+
     with timer.stage("table_2"):
-        table_2 = build_table_2(panel, subset_masks, factors_dict)
+        table_2 = build_table_2(panel, subset_masks, factors_dict, mesh=mesh)
 
     # The figure and decile paths share the same per-subset batched OLS on
     # the figure's 5-variable set — compute each subset's result once.

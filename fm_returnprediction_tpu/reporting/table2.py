@@ -38,8 +38,13 @@ def run_model_fm(
     return_col: str = "retx",
     nw_lags: int = 4,
     solver: str = "lstsq",
+    mesh=None,
 ):
-    """One (model, subset) Fama-MacBeth run on the dense panel."""
+    """One (model, subset) Fama-MacBeth run on the dense panel.
+
+    With ``mesh`` the firm axis shards across devices (Gram-psum path,
+    ``parallel.fm_sharded``); otherwise the single-device batched solver
+    runs with the requested ``solver``."""
     xvars = []
     for label in model.predictors:
         if label not in variables_dict:
@@ -47,8 +52,12 @@ def run_model_fm(
         xvars.append(variables_dict[label])
     y = jnp.asarray(panel.var(return_col))
     x = jnp.asarray(panel.select(xvars))
-    cs, fm = fama_macbeth(y, x, jnp.asarray(subset_mask), nw_lags=nw_lags, solver=solver)
-    return cs, fm
+    mask = jnp.asarray(subset_mask)
+    if mesh is not None:
+        from fm_returnprediction_tpu.parallel import fama_macbeth_sharded
+
+        return fama_macbeth_sharded(y, x, mask, mesh=mesh, nw_lags=nw_lags)
+    return fama_macbeth(y, x, mask, nw_lags=nw_lags, solver=solver)
 
 
 def build_table_2(
@@ -56,13 +65,15 @@ def build_table_2(
     subset_masks: Dict[str, jnp.ndarray],
     variables_dict: Dict[str, str],
     models: Optional[list] = None,
+    mesh=None,
 ) -> pd.DataFrame:
-    """Assemble the formatted reference-layout Table 2."""
+    """Assemble the formatted reference-layout Table 2. ``mesh`` runs every
+    (model, subset) FM with the firm axis sharded across devices."""
     models = models if models is not None else MODELS
     rows = []
     for model in models:
         for subset_name, mask in subset_masks.items():
-            _, fm = run_model_fm(panel, mask, model, variables_dict)
+            _, fm = run_model_fm(panel, mask, model, variables_dict, mesh=mesh)
             coef = np.asarray(fm.coef)
             tstat = np.asarray(fm.tstat)
             mean_r2 = float(fm.mean_r2)

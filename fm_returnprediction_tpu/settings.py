@@ -8,8 +8,10 @@ TPU-framework keys:
 - ``BACKEND``      — ``"tpu"`` or ``"cpu"``; selects the JAX platform used by
   the compute core (north-star requirement: a ``BACKEND=tpu`` flag at this
   layer).
-- ``MESH_DEVICES`` — number of devices in the 1-D compute mesh (``0`` = all
-  available).
+- ``MESH_DEVICES`` — number of devices in the 1-D compute mesh: ``1``
+  (default) = single-device kernels, ``0`` = all available devices, ``N`` =
+  exactly N. Multi-chip is opt-in so default numerics (the SVD parity
+  solver) do not depend on the machine's device count.
 - ``DTYPE``        — ``"float32"`` or ``"float64"`` for the econometrics
   kernels.
 
@@ -88,7 +90,7 @@ d["OUTPUT_DIR"] = if_relative_make_abs(_env("OUTPUT_DIR", default="_output"))
 
 # TPU-framework keys (new in this framework).
 d["BACKEND"] = _env("BACKEND", default="tpu")
-d["MESH_DEVICES"] = int(_env("MESH_DEVICES", default="0"))
+d["MESH_DEVICES"] = int(_env("MESH_DEVICES", default="1"))
 d["DTYPE"] = _env("DTYPE", default="float32")
 
 

@@ -15,7 +15,7 @@ from pathlib import Path
 
 from fm_returnprediction_tpu.settings import apply_backend, config
 from fm_returnprediction_tpu.taskgraph.engine import TaskRunner, write_timing_log
-from fm_returnprediction_tpu.taskgraph.tasks import build_tasks
+from fm_returnprediction_tpu.taskgraph.tasks import build_notebook_tasks, build_tasks
 
 
 def main(argv=None) -> int:
@@ -26,6 +26,8 @@ def main(argv=None) -> int:
     parser.add_argument("--force", action="store_true", help="ignore up-to-date state")
     parser.add_argument("--synthetic", action="store_true",
                         help="use the synthetic fake-WRDS backend")
+    parser.add_argument("--notebooks", action="store_true",
+                        help="include the notebook conversion/execution tasks")
     parser.add_argument("--db", default=None, help="state db path")
     parser.add_argument("--backend", choices=["cpu", "tpu"], default=None,
                         help="override the BACKEND setting")
@@ -34,6 +36,8 @@ def main(argv=None) -> int:
     apply_backend(args.backend)
 
     tasks = build_tasks(synthetic=args.synthetic)
+    if args.notebooks:
+        tasks += build_notebook_tasks()
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"
 
     with TaskRunner(tasks, db_path=db) as runner:

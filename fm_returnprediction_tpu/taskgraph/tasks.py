@@ -34,7 +34,7 @@ from typing import Dict, List, Optional
 from fm_returnprediction_tpu.settings import config, create_dirs
 from fm_returnprediction_tpu.taskgraph.engine import Task
 
-__all__ = ["build_tasks", "PANEL_FILE", "FACTORS_FILE"]
+__all__ = ["build_tasks", "build_notebook_tasks", "PANEL_FILE", "FACTORS_FILE"]
 
 PANEL_FILE = "lewellen_panel.npz"
 FACTORS_FILE = "factors_dict.json"
@@ -122,7 +122,9 @@ def _reports(processed_dir: Path, output_dir: Path) -> None:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
     table_1 = build_table_1(panel, masks, factors_dict)
-    table_2 = build_table_2(panel, masks, factors_dict)
+    from fm_returnprediction_tpu.parallel import default_mesh
+
+    table_2 = build_table_2(panel, masks, factors_dict, mesh=default_mesh())
     cs_cache = {name: figure_cs(panel, m) for name, m in masks.items()}
     figure_1 = create_figure_1(panel, masks, cs_cache=cs_cache)
     save_data(table_1, table_2, figure_1, output_dir)
@@ -198,5 +200,81 @@ def build_tasks(
             file_dep=[output_dir / "table_1.pkl", output_dir / "table_2.pkl"],
             task_dep=["reports"],
             doc="Generate + compile the LaTeX report",
+        ),
+    ]
+
+
+def _notebook_paths(notebooks_dir: Path) -> List[Path]:
+    """Auto-discover driver notebooks (reference ``dodo.py:132-137``)."""
+    return sorted(Path(notebooks_dir).glob("*.ipynb"))
+
+
+def build_notebook_tasks(
+    notebooks_dir: Optional[Path] = None,
+    output_dir: Optional[Path] = None,
+    docs_dir: Optional[Path] = None,
+) -> List[Task]:
+    """Notebook conversion/execution tasks (reference ``dodo.py:140-206``,
+    docs copy ``:257-300``), gated on nbconvert being importable.
+
+    - ``convert_notebooks``: each notebook → a cleared ``.py`` script under
+      OUTPUT_DIR/notebooks (the reference's change-detection artifact);
+    - ``run_notebooks``: execute in place to OUTPUT_DIR and render HTML,
+      copied into ``docs/notebooks`` for a static site.
+    """
+    try:
+        import nbconvert  # noqa: F401
+    except ImportError:  # pragma: no cover - environment-dependent
+        return []
+
+    notebooks_dir = Path(notebooks_dir or config("BASE_DIR") / "notebooks")
+    output_dir = Path(output_dir or config("OUTPUT_DIR"))
+    docs_dir = Path(docs_dir or config("BASE_DIR") / "docs" / "notebooks")
+    notebooks = _notebook_paths(notebooks_dir)
+    if not notebooks:
+        return []
+
+    script_dir = output_dir / "notebooks"
+    scripts = [script_dir / f"{nb.stem}.py" for nb in notebooks]
+    html = [output_dir / f"{nb.stem}.html" for nb in notebooks]
+
+    import shlex
+
+    q = shlex.quote
+    convert_cmds = [
+        f"jupyter nbconvert --to script --output-dir {q(str(script_dir))} {q(str(nb))}"
+        for nb in notebooks
+    ]
+    run_cmds = [
+        f"jupyter nbconvert --execute --to html --output-dir {q(str(output_dir))} {q(str(nb))}"
+        for nb in notebooks
+    ]
+
+    def _copy_docs() -> None:
+        import shutil
+
+        docs_dir.mkdir(parents=True, exist_ok=True)
+        for page in html:
+            if page.exists():
+                shutil.copy2(page, docs_dir / page.name)
+
+    return [
+        Task(
+            name="convert_notebooks",
+            actions=convert_cmds,
+            file_dep=notebooks,
+            targets=scripts,
+            doc="Notebooks → cleared scripts (change detection)",
+        ),
+        Task(
+            name="run_notebooks",
+            # Depend on the CLEARED scripts, not the raw .ipynb: output and
+            # metadata churn in a notebook must not re-trigger execution
+            # (the reference's change-detection contract, dodo.py:191-193).
+            actions=run_cmds + [_copy_docs],
+            file_dep=scripts,
+            targets=html,
+            task_dep=["convert_notebooks"],
+            doc="Execute driver notebooks, render HTML into docs",
         ),
     ]

@@ -179,3 +179,43 @@ def test_bootstrap_f32_tiny_spread_not_zero():
     expect = float(s.std(ddof=1) / np.sqrt(t))  # iid scale for white noise
     assert se > 0.0
     assert 0.2 * expect < se < 5 * expect
+
+
+def test_table2_mesh_matches_single_device():
+    """build_table_2 with the mesh (Gram-psum FM) reproduces the
+    single-device table within the parity budget."""
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.pipeline import build_panel
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=50, n_months=80))
+    panel, factors = build_panel(data)
+    masks = compute_subset_masks(panel)
+    t_single = build_table_2(panel, masks, factors)
+    t_mesh = build_table_2(panel, masks, factors, mesh=make_mesh(axis_name="firms"))
+    # formatted strings: identical at the displayed precision except for
+    # rare last-digit rounding flips between the SVD and Gram routes
+    a = t_single.to_numpy().astype(str).ravel()
+    b = t_mesh.to_numpy().astype(str).ravel()
+    agree = (a == b).mean()
+    assert agree > 0.95, f"only {agree:.2%} of formatted cells agree"
+
+
+def test_default_mesh_honors_setting(monkeypatch):
+    from fm_returnprediction_tpu.parallel import default_mesh
+
+    monkeypatch.setenv("MESH_DEVICES", "0")
+    # settings snapshot MESH_DEVICES at import; patch the dict directly
+    from fm_returnprediction_tpu import settings
+
+    monkeypatch.setitem(settings.d, "MESH_DEVICES", 0)
+    m = default_mesh()
+    assert m is not None and m.size == len(jax.devices())
+    monkeypatch.setitem(settings.d, "MESH_DEVICES", 4)
+    assert default_mesh().size == 4
+    monkeypatch.setitem(settings.d, "MESH_DEVICES", 1)
+    assert default_mesh() is None
