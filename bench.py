@@ -41,6 +41,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from fm_returnprediction_tpu.settings import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from fm_returnprediction_tpu.models.lewellen import MODELS
     from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
     from fm_returnprediction_tpu.parallel import block_bootstrap_se, make_mesh

@@ -17,6 +17,7 @@ breakpoints (batched sort) → one-hot decile averages (MXU einsum).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -47,6 +48,9 @@ class DecileSortResult(NamedTuple):
     n_months: jnp.ndarray        # ()
 
 
+@functools.partial(
+    jax.jit, static_argnames=("window", "min_periods", "solver")
+)
 def rolling_er_forecast(
     y: jnp.ndarray,
     x: jnp.ndarray,
@@ -90,6 +94,9 @@ def rolling_er_forecast(
     return ForecastResult(er, er_valid, slopes_bar, intercept_bar)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_deciles", "min_obs", "nw_lags", "weight")
+)
 def decile_sorts(
     er: jnp.ndarray,
     er_valid: jnp.ndarray,

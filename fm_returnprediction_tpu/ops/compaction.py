@@ -14,8 +14,10 @@ independent, so the N axis shards cleanly).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
@@ -72,6 +74,9 @@ def lag(comp_values: jnp.ndarray, k: int, fill=jnp.nan) -> jnp.ndarray:
     return jnp.concatenate([pad, comp_values[:-k]], axis=0)[: comp_values.shape[0]]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("window", "min_periods", "row_lag")
+)
 def rolling_over_valid_rows(
     values: jnp.ndarray,
     valid: jnp.ndarray,

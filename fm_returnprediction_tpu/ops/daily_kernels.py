@@ -34,6 +34,8 @@ at the last observed day of each month.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -89,7 +91,24 @@ def rolling_vol_252_monthly(
 
     ``use_pallas`` forwards to ``rolling_std``; callers tracing this inside
     an SPMD-partitioned program (``parallel.daily_sharded``) must pass
-    ``False`` — GSPMD cannot partition the pallas custom-call."""
+    ``False`` — GSPMD cannot partition the pallas custom-call. The
+    None-default resolves the FMRP_PALLAS/platform dispatch HERE, outside
+    the jit cache, so flipping the env var mid-process takes effect."""
+    if use_pallas is None:
+        from fm_returnprediction_tpu.ops.rolling import _pallas_default
+
+        use_pallas = _pallas_default()
+    return _rolling_vol_252_monthly(
+        ret_d, mask_d, month_id, n_months, window, min_periods, use_pallas
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_months", "window", "min_periods", "use_pallas")
+)
+def _rolling_vol_252_monthly(
+    ret_d, mask_d, month_id, n_months, window, min_periods, use_pallas
+):
     plan = make_compaction(mask_d)
     comp_ret = jnp.where(plan.valid, compact(ret_d, plan), jnp.nan)
     vol = rolling_std(comp_ret, window, min_periods, use_pallas=use_pallas) * jnp.sqrt(
@@ -99,6 +118,9 @@ def rolling_vol_252_monthly(
     return last_obs_per_month(vol_cal, mask_d, month_id, n_months)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_weeks", "n_months", "window_weeks")
+)
 def weekly_rolling_beta_monthly(
     ret_d: jnp.ndarray,
     mask_d: jnp.ndarray,

@@ -17,6 +17,7 @@ Table 2 (call stack SURVEY §3.4) in two fused device computations.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -85,6 +86,9 @@ def fama_macbeth_summary(
     return FamaMacbethSummary(coef, tstat, se, mean_r2, mean_n, n_months)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("nw_lags", "min_months", "weight", "solver")
+)
 def fama_macbeth(
     y: jnp.ndarray,
     x: jnp.ndarray,

@@ -23,6 +23,7 @@ the 1e-4 parity budget.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -180,10 +181,13 @@ def _solve_month(y, x, valid, solver="lstsq"):
     return beta[1:], beta[0], r2, n, month_valid
 
 
+@functools.partial(jax.jit, static_argnames=("solver",))
 def monthly_cs_ols(
     y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray, solver: str = "lstsq"
 ) -> CSRegressionResult:
-    """Run every month's cross-sectional regression in one batched call.
+    """Run every month's cross-sectional regression in one batched call
+    (jitted: one compiled program, one dispatch — library calls stay off the
+    eager per-op path, which dominates wall-clock on remote TPU backends).
 
     Parameters
     ----------

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 
 from fm_returnprediction_tpu.ops.quantiles import masked_quantile
@@ -26,22 +27,28 @@ __all__ = ["SUBSET_ORDER", "compute_subset_masks", "flag_firms_missing_variables
 SUBSET_ORDER = ["All stocks", "All-but-tiny stocks", "Large stocks"]
 
 
+@jax.jit
+def _subset_masks(me, mask, is_nyse):
+    nyse = mask & (is_nyse > 0)
+    breakpoints = masked_quantile(me, nyse, jnp.asarray([0.2, 0.5]))  # (T, 2)
+    me_20, me_50 = breakpoints[:, 0][:, None], breakpoints[:, 1][:, None]
+    return mask, mask & (me >= me_20), mask & (me >= me_50)
+
+
 def compute_subset_masks(panel: DensePanel) -> Dict[str, jnp.ndarray]:
-    """(T, N) boolean masks for the three universes.
+    """(T, N) boolean masks for the three universes (one jitted dispatch).
 
     Needs panel variables ``me`` and ``is_nyse`` (1.0 for NYSE rows).
     """
-    me = jnp.asarray(panel.var("me"))
-    mask = jnp.asarray(panel.mask)
-    nyse = mask & (jnp.asarray(panel.var("is_nyse")) > 0)
-
-    breakpoints = masked_quantile(me, nyse, jnp.asarray([0.2, 0.5]))  # (T, 2)
-    me_20, me_50 = breakpoints[:, 0][:, None], breakpoints[:, 1][:, None]
-
+    all_, abt, large = _subset_masks(
+        jnp.asarray(panel.var("me")),
+        jnp.asarray(panel.mask),
+        jnp.asarray(panel.var("is_nyse")),
+    )
     return {
-        "All stocks": mask,
-        "All-but-tiny stocks": mask & (me >= me_20),
-        "Large stocks": mask & (me >= me_50),
+        "All stocks": all_,
+        "All-but-tiny stocks": abt,
+        "Large stocks": large,
     }
 
 

@@ -118,8 +118,9 @@ def default_mesh(axis_name: str = "firms"):
     from fm_returnprediction_tpu.settings import config
 
     want = int(config("MESH_DEVICES"))
-    have = len(jax.devices())
-    n = have if want == 0 else min(want, have)
+    n = len(jax.devices()) if want == 0 else want
     if n <= 1:
         return None
+    # make_mesh raises when N exceeds the available devices — "exactly N"
+    # is the contract, not a silent cap.
     return make_mesh(n_devices=n, axis_name=axis_name)

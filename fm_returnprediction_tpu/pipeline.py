@@ -214,9 +214,10 @@ def _main() -> None:
     )
     args = parser.parse_args()
 
-    from fm_returnprediction_tpu.settings import apply_backend
+    from fm_returnprediction_tpu.settings import apply_backend, enable_compilation_cache
 
     apply_backend(args.backend)
+    enable_compilation_cache()
     if not args.synthetic and (args.firms is not None or args.months is not None):
         parser.error("--firms/--months only apply with --synthetic")
     cfg = SyntheticConfig(

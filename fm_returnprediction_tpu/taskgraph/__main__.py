@@ -13,7 +13,7 @@ import argparse
 import sys
 from pathlib import Path
 
-from fm_returnprediction_tpu.settings import apply_backend, config
+from fm_returnprediction_tpu.settings import apply_backend, config, enable_compilation_cache
 from fm_returnprediction_tpu.taskgraph.engine import TaskRunner, write_timing_log
 from fm_returnprediction_tpu.taskgraph.tasks import build_notebook_tasks, build_tasks
 
@@ -34,6 +34,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     apply_backend(args.backend)
+    enable_compilation_cache()
 
     tasks = build_tasks(synthetic=args.synthetic)
     if args.notebooks:

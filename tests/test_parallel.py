@@ -208,7 +208,6 @@ def test_table2_mesh_matches_single_device():
 def test_default_mesh_honors_setting(monkeypatch):
     from fm_returnprediction_tpu.parallel import default_mesh
 
-    monkeypatch.setenv("MESH_DEVICES", "0")
     # settings snapshot MESH_DEVICES at import; patch the dict directly
     from fm_returnprediction_tpu import settings
 
