@@ -195,14 +195,38 @@ def _resolve_cache(
     return None, (path if path.exists() else None), file_name
 
 
-def _wrds_query(sql: str, wrds_username: str, date_cols: List[str]) -> pd.DataFrame:
+def _wrds_query(
+    sql: str,
+    wrds_username: str,
+    date_cols: List[str],
+    retries: int = 3,
+    backoff_s: float = 5.0,
+) -> pd.DataFrame:
+    """Run one WRDS query with retry/backoff.
+
+    The WRDS Postgres connection is the pipeline's only network boundary
+    (``src/pull_crsp.py:238``); the reference has no failure handling there
+    at all — a transient drop loses a multi-minute pull. Each attempt opens
+    a fresh connection; failures back off exponentially."""
+    import time
+
     import wrds  # deferred: optional dependency, needs network
 
-    db = wrds.Connection(wrds_username=wrds_username)
-    try:
-        return db.raw_sql(sql, date_cols=date_cols)
-    finally:
-        db.close()
+    last_err = None
+    for attempt in range(retries + 1):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+            print(f"WRDS retry {attempt}/{retries} after: {last_err}")
+        db = None
+        try:
+            db = wrds.Connection(wrds_username=wrds_username)
+            return db.raw_sql(sql, date_cols=date_cols)
+        except Exception as err:  # noqa: BLE001 — network layer, retry all
+            last_err = err
+        finally:
+            if db is not None:
+                db.close()
+    raise RuntimeError(f"WRDS query failed after {retries + 1} attempts") from last_err
 
 
 def pull_CRSP_stock(

@@ -103,13 +103,24 @@ def run_pipeline(
     output_dir=None,
     synthetic: bool = False,
     synthetic_config: Optional[SyntheticConfig] = None,
-    dtype=np.float64,
+    dtype=None,
     make_figure: bool = True,
     compile_pdf: bool = True,
     make_deciles: bool = True,
     use_mesh: Optional[bool] = None,
 ) -> PipelineResult:
-    """The full Lewellen pipeline: data → panel → tables/figure → artifacts."""
+    """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
+
+    ``dtype=None`` resolves the DTYPE setting (float32 on TPU by default;
+    float64 requires jax_enable_x64 and is the CPU parity configuration)."""
+    if dtype is None:
+        from fm_returnprediction_tpu.settings import config
+
+        dtype = np.dtype(config("DTYPE"))
+        import jax
+
+        if dtype == np.float64 and not jax.config.jax_enable_x64:
+            dtype = np.float32  # x64 disabled: stay in f32 end to end
     timer = StageTimer()
 
     with timer.stage("load_raw_data"):

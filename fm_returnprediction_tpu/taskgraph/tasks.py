@@ -97,9 +97,15 @@ def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
 
 
 def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
+    import jax
+    import numpy as np
+
     from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
 
-    panel, factors_dict = build_panel(load_raw_data(raw_dir))
+    dtype = np.dtype(config("DTYPE"))
+    if dtype == np.float64 and not jax.config.jax_enable_x64:
+        dtype = np.float32
+    panel, factors_dict = build_panel(load_raw_data(raw_dir), dtype=dtype)
     panel.save(processed_dir / PANEL_FILE)
     with open(processed_dir / FACTORS_FILE, "w") as f:
         json.dump(factors_dict, f, indent=2)

@@ -93,3 +93,42 @@ def test_pipeline_applies_universe_filter(wrds):
         wrds["crsp_m"].loc[wrds["crsp_m"]["usincflg"] != "Y", "permno"]
     )
     assert not bad_permnos.intersection(panel.ids)
+
+
+def test_wrds_query_retries_then_succeeds(monkeypatch):
+    """Transient connection failures retry with a fresh connection; a
+    persistent failure surfaces after the attempt budget."""
+    import sys
+    import types
+
+    from fm_returnprediction_tpu.data import wrds_pull
+
+    calls = {"n": 0}
+
+    class FakeConn:
+        def __init__(self, wrds_username=""):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError(f"drop #{calls['n']}")
+
+        def raw_sql(self, sql, date_cols=None):
+            return pd.DataFrame({"x": [1]})
+
+        def close(self):
+            pass
+
+    fake = types.ModuleType("wrds")
+    fake.Connection = FakeConn
+    monkeypatch.setitem(sys.modules, "wrds", fake)
+
+    out = wrds_pull._wrds_query("SELECT 1", "u", [], retries=3, backoff_s=0.0)
+    assert calls["n"] == 3 and len(out) == 1
+
+    calls["n"] = -100  # always fails within budget
+    class AlwaysFail:
+        def __init__(self, wrds_username=""):
+            raise ConnectionError("down")
+
+    fake.Connection = AlwaysFail
+    with pytest.raises(RuntimeError, match="after 3 attempts"):
+        wrds_pull._wrds_query("SELECT 1", "u", [], retries=2, backoff_s=0.0)
