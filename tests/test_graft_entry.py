@@ -1,0 +1,57 @@
+"""Driver-facing entry points must work with NO environment preparation.
+
+Round-1 VERDICT item 1: ``dryrun_multichip`` failed on the 1-chip host
+because nothing provisioned the virtual device mesh. These tests run the
+entry points in clean subprocesses (the driver's invocation style) so a
+regression shows up here before it shows up in MULTICHIP_r{N}.json.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("JAX_ENABLE_X64", None)
+    return env
+
+
+def test_dryrun_multichip_self_provisions():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)",
+        ],
+        cwd=REPO,
+        env=_clean_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+
+
+def test_entry_compiles_and_runs():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax\n"
+            "from __graft_entry__ import entry\n"
+            "fn, args = entry()\n"
+            "out = jax.jit(fn)(*args)\n"
+            "jax.block_until_ready(out)\n",
+        ],
+        cwd=REPO,
+        env=_clean_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
