@@ -86,8 +86,13 @@ def rolling_er_forecast(
     have_coef = jnp.isfinite(intercept_bar) & jnp.all(
         jnp.isfinite(slopes_bar), axis=1
     )
+    # HIGHEST precision: on TPU the bf16 MXU default can flip marginal
+    # decile assignments downstream vs the CPU parity run (ADVICE r1).
     er = intercept_bar[:, None] + jnp.einsum(
-        "tnp,tp->tn", jnp.where(rows[..., None], x, 0.0), slopes_bar
+        "tnp,tp->tn",
+        jnp.where(rows[..., None], x, 0.0),
+        slopes_bar,
+        precision=jax.lax.Precision.HIGHEST,
     )
     er_valid = rows & have_coef[:, None]
     er = jnp.where(er_valid, er, jnp.nan)
@@ -127,7 +132,9 @@ def decile_sorts(
     onehot = onehot * ok[:, :, None].astype(er.dtype)
     counts = onehot.sum(axis=1)                                # (T, D)
     ret_z = jnp.where(ok, realized, 0.0)
-    sums = jnp.einsum("tnd,tn->td", onehot, ret_z)
+    sums = jnp.einsum(
+        "tnd,tn->td", onehot, ret_z, precision=jax.lax.Precision.HIGHEST
+    )
     dec_ret = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), jnp.nan)
     dec_ret = jnp.where(month_valid[:, None], dec_ret, jnp.nan)
 

@@ -54,7 +54,9 @@ def _replicate_means_one_predictor(series, n_valid, keys, block_length):
     series : (T,) compacted values (valid entries first, tail zeroed)
     n_valid: () number of valid entries
     keys   : (B,) typed PRNG keys, one per replicate
-    Returns (B,) replicate means. Predictors with n_valid < 2 yield NaN.
+    Returns (B,) replicate means. Predictors with n_valid <= block_length
+    yield NaN: with at most one distinct block start every replicate is the
+    exact sample mean, which would report a spuriously ~0 SE (ADVICE r1).
     """
     t_max = series.shape[0]
     n = jnp.maximum(n_valid, 1)
@@ -74,7 +76,7 @@ def _replicate_means_one_predictor(series, n_valid, keys, block_length):
         return jnp.sum(pseudo * w) / jnp.maximum(n_valid, 1).astype(series.dtype)
 
     means = jax.vmap(one_rep)(keys)
-    return jnp.where(n_valid >= 2, means, jnp.nan)
+    return jnp.where(n_valid > block_length, means, jnp.nan)
 
 
 def bootstrap_replicate_means(
@@ -158,6 +160,10 @@ def block_bootstrap_se(
     mesh        : optional 1-D mesh; replicates shard over ``axis_name``.
                   None = single-device vmap.
     """
+    if n_replicates < 2:
+        raise ValueError(
+            f"n_replicates must be >= 2 for a ddof=1 variance, got {n_replicates}"
+        )
     slopes = jnp.asarray(slopes)
     slope_valid = jnp.asarray(slope_valid)
 

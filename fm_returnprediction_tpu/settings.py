@@ -143,17 +143,24 @@ def apply_backend(backend: str | None = None) -> str:
             jax = sys.modules["jax"]
             # config.update silently has no effect once the backend has
             # initialized — surface that instead of dropping the request.
-            import jax._src.xla_bridge as xb
+            # The initialization probe is a private API; degrade gracefully
+            # across JAX upgrades by assuming "not initialized yet" (the
+            # config.update branch) when the probe is missing, then verify
+            # the outcome with the public default_backend().
+            try:
+                import jax._src.xla_bridge as xb
 
-            if xb.backends_are_initialized():
-                if jax.default_backend() != "cpu":
-                    raise RuntimeError(
-                        "BACKEND=cpu requested but the JAX backend is already "
-                        "initialized on another platform; call apply_backend() "
-                        "(or set JAX_PLATFORMS=cpu) before any JAX computation."
-                    )
-            else:
+                initialized = bool(xb.backends_are_initialized())
+            except Exception:
+                initialized = False
+            if not initialized:
                 jax.config.update("jax_platforms", "cpu")
+            if initialized and jax.default_backend() != "cpu":
+                raise RuntimeError(
+                    "BACKEND=cpu requested but the JAX backend is already "
+                    "initialized on another platform; call apply_backend() "
+                    "(or set JAX_PLATFORMS=cpu) before any JAX computation."
+                )
     return backend
 
 

@@ -149,7 +149,14 @@ class TaskRunner:
             db_path = Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"
         self.db_path = Path(db_path)
         self.reporter = reporter or default_reporter()
-        self._db = sqlite3.connect(self.db_path)
+        # Generous busy timeout + WAL so two concurrent runners sharing the
+        # state DB queue behind each other instead of raising "database is
+        # locked" and recording a spurious task failure (ADVICE r1).
+        self._db = sqlite3.connect(self.db_path, timeout=60.0)
+        try:
+            self._db.execute("PRAGMA journal_mode=WAL")
+        except sqlite3.DatabaseError:
+            pass  # e.g. WAL unsupported on a network filesystem — fall back
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS dep_hash"
             " (task TEXT, path TEXT, hash TEXT, size INTEGER, mtime REAL,"

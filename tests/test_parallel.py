@@ -165,6 +165,27 @@ def test_bootstrap_short_series_nan():
     assert np.isnan(np.asarray(res.se)[1])  # 0 valid months → NaN
 
 
+def test_bootstrap_under_block_length_nan():
+    """n_valid <= block_length has at most one distinct block start, so every
+    replicate equals the sample mean — must report NaN, not SE~0 (ADVICE r1)."""
+    rng = np.random.default_rng(3)
+    slopes = jnp.asarray(rng.standard_normal((50, 2)))
+    valid = jnp.zeros((50, 2), dtype=bool)
+    valid = valid.at[:5, 0].set(True)   # n_valid == block_length (5)
+    valid = valid.at[:6, 1].set(True)   # n_valid == block_length + 1
+    res = block_bootstrap_se(slopes, valid, jax.random.key(0), n_replicates=64)
+    se = np.asarray(res.se)
+    assert np.isnan(se[0])
+    assert np.isfinite(se[1]) and se[1] > 0.0
+
+
+def test_bootstrap_rejects_degenerate_replicate_count():
+    slopes = jnp.asarray(np.random.default_rng(0).standard_normal((50, 1)))
+    valid = jnp.ones((50, 1), dtype=bool)
+    with pytest.raises(ValueError, match="n_replicates"):
+        block_bootstrap_se(slopes, valid, jax.random.key(0), n_replicates=1)
+
+
 def test_bootstrap_f32_tiny_spread_not_zero():
     """f32 + near-constant slope series: the centered moment reduction must
     not cancel to SE=0 (the naive E[x2]-mean^2 form does)."""
