@@ -1,0 +1,248 @@
+"""Firm-axis chunking for the daily kernels — full-CRSP scale on one chip.
+
+The reference streams O(10⁷-10⁸) daily rows through polars' out-of-core
+engine (``src/calc_Lewellen_2014.py:396-410``); the dense TPU design instead
+materializes a (D, N) daily panel, which at real 1964-2013 CRSP shape
+(D≈12,600 trading days × N≈25-30k permnos) is ~1.3 GB per f32 array — and
+the vol/beta kernels keep roughly a dozen (D, N)-sized intermediates live
+(compaction plan int arrays, cumsums, log-return products), several times a
+single chip's HBM at full scale.
+
+Firms are independent in every daily kernel (rolling windows and weekly
+segment-sums run along days WITHIN a firm column), so scale on one device is
+a host loop over fixed-width firm strips: slice (D, C) from host memory, run
+the jitted kernels (one compilation — every strip has the same static
+shape; the last strip is padded), pull back the small (n_months, C) results.
+Peak device memory is set by C, not N. This is the single-chip counterpart
+of ``parallel.daily_sharded`` (which splits the same axis across a mesh).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "auto_firm_chunk",
+    "daily_characteristics_chunked",
+    "daily_characteristics_compact_chunked",
+]
+
+# Peak live (D, C)-shaped arrays inside the vol+beta kernels, measured on the
+# compiled programs (compaction plan: order/inv_order int + valid/mask bool;
+# compacted returns, rolling cumsums, scatter-back; beta's masked logs and
+# products before the weekly segment reduction). Deliberately a little high —
+# the budget is a guardrail, not a high-water-mark tuning knob.
+_WORKSPACE_ARRAYS = 12
+
+
+def _default_budget_bytes() -> int:
+    """Device workspace budget: ~60% of the accelerator's memory limit when
+    the backend reports one, else a conservative 4 GiB."""
+    env = os.environ.get("FMRP_DAILY_BUDGET_BYTES")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit * 0.6)
+    except Exception:
+        pass
+    return 4 << 30
+
+
+def auto_firm_chunk(
+    n_days: int,
+    n_firms: int,
+    itemsize: int,
+    budget_bytes: Optional[int] = None,
+) -> Optional[int]:
+    """Firm-strip width that keeps the daily kernels' working set under the
+    device budget, or None when the whole panel already fits (no chunking —
+    small panels keep the exact single-call path)."""
+    if budget_bytes is None:
+        budget_bytes = _default_budget_bytes()
+    per_firm = n_days * itemsize * _WORKSPACE_ARRAYS
+    if per_firm * n_firms <= budget_bytes:
+        return None
+    chunk = int(budget_bytes // max(per_firm, 1))
+    chunk = max((chunk // 128) * 128, 128)
+    return min(chunk, n_firms)
+
+
+def daily_characteristics_chunked(
+    ret_d,
+    mask_d,
+    mkt_d,
+    month_id,
+    week_id,
+    week_month_id,
+    n_months: int,
+    n_weeks: int,
+    mkt_present=None,
+    window: int = 252,
+    min_periods: int = 100,
+    window_weeks: int = 156,
+    firm_chunk: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """vol-252 and weekly beta over firm strips; returns numpy (n_months, N) pairs.
+
+    Inputs stay host-side numpy; each strip transfers (D, C) to the device,
+    so the device never holds more than one strip's working set.
+    ``firm_chunk=None`` = auto budget heuristic (None result = single call).
+    Matches ``ops.daily_kernels`` outputs exactly — chunking is a pure
+    execution-schedule choice, verified by tests against the unchunked path.
+    """
+    from fm_returnprediction_tpu.ops.daily_kernels import (
+        rolling_vol_252_monthly,
+        weekly_rolling_beta_monthly,
+    )
+
+    ret_d = np.asarray(ret_d)
+    mask_d = np.asarray(mask_d)
+    mkt_d = np.asarray(mkt_d)
+    if mkt_present is None:
+        mkt_present = np.isfinite(mkt_d)
+    mkt_present = np.asarray(mkt_present)
+    d_days, n_firms = ret_d.shape
+
+    if firm_chunk is None:
+        firm_chunk = auto_firm_chunk(d_days, n_firms, ret_d.dtype.itemsize)
+
+    import jax.numpy as jnp
+
+    def run(ret_np, mask_np):
+        ret_j = jnp.asarray(ret_np)
+        mask_j = jnp.asarray(mask_np)
+        vol = rolling_vol_252_monthly(
+            ret_j, mask_j, month_j, n_months,
+            window=window, min_periods=min_periods, use_pallas=use_pallas,
+        )
+        beta = weekly_rolling_beta_monthly(
+            ret_j, mask_j, mkt_j, week_j, n_weeks, week_month_j, n_months,
+            window_weeks=window_weeks, mkt_present=mkt_present_j,
+        )
+        return np.asarray(vol), np.asarray(beta)
+
+    # Per-day vectors are shared by every strip — move them once.
+    month_j = jnp.asarray(np.asarray(month_id))
+    week_j = jnp.asarray(np.asarray(week_id))
+    week_month_j = jnp.asarray(np.asarray(week_month_id))
+    mkt_j = jnp.asarray(mkt_d)
+    mkt_present_j = jnp.asarray(mkt_present)
+
+    if firm_chunk is None or firm_chunk >= n_firms:
+        return run(ret_d, mask_d)
+
+    vol_out = np.empty((n_months, n_firms), dtype=ret_d.dtype)
+    beta_out = np.empty((n_months, n_firms), dtype=ret_d.dtype)
+    c = int(firm_chunk)
+    for start in range(0, n_firms, c):
+        stop = min(start + c, n_firms)
+        ret_s = ret_d[:, start:stop]
+        mask_s = mask_d[:, start:stop]
+        if stop - start < c:  # pad the last strip: one static shape = one compile
+            pad = c - (stop - start)
+            ret_s = np.pad(ret_s, ((0, 0), (0, pad)), constant_values=np.nan)
+            mask_s = np.pad(mask_s, ((0, 0), (0, pad)), constant_values=False)
+        vol_s, beta_s = run(ret_s, mask_s)
+        vol_out[:, start:stop] = vol_s[:, : stop - start]
+        beta_out[:, start:stop] = beta_s[:, : stop - start]
+    return vol_out, beta_out
+
+
+def daily_characteristics_compact_chunked(
+    row_values,
+    row_pos,
+    offsets,
+    mkt_d,
+    mkt_present,
+    day_month_id,
+    week_id,
+    week_month_id,
+    n_days: int,
+    n_weeks: int,
+    n_months: int,
+    window: int = 252,
+    min_periods: int = 100,
+    window_weeks: int = 156,
+    firm_chunk: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
+    height_bucket: int = 1024,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """vol-252 and weekly beta from the compacted (CSR) daily layout.
+
+    The transfer-lean single-chip driver (see ``ops.daily_compact``): firms
+    are ordered by row count DESCENDING and cut into fixed-width strips, so
+    each strip's rectangle is only as tall as its longest-lived firm —
+    total bytes moved tracks observed rows, not the dense (D, N) grid.
+    Strip heights round up to ``height_bucket`` multiples to bound the
+    number of distinct compiled shapes. Outputs return in the ORIGINAL firm
+    order, (n_months, N) numpy each.
+    """
+    from fm_returnprediction_tpu.ops.daily_compact import daily_compact_strip
+
+    row_values = np.asarray(row_values)
+    row_pos = np.asarray(row_pos)
+    offsets = np.asarray(offsets)
+    counts = np.diff(offsets)
+    n_firms = len(counts)
+    dtype = row_values.dtype
+
+    if use_pallas is None:
+        from fm_returnprediction_tpu.ops.rolling import _pallas_default
+
+        use_pallas = _pallas_default()
+
+    def bucket(h: int) -> int:
+        return max(-(-int(h) // height_bucket) * height_bucket, height_bucket)
+
+    if firm_chunk is None:
+        # Narrow strips, not memory-budget strips: with firms sorted by row
+        # count, a strip's rectangle is efficient only if its width is small
+        # enough that the strip's max height tracks its firms' counts — wide
+        # strips degenerate to the dense grid's transfer volume. Target
+        # ~2^25 slots per strip (~200 MB f32+int16 on the wire), well under
+        # any device budget, and cheap per-strip dispatch keeps the loop
+        # overhead negligible.
+        h_max = bucket(int(counts.max(initial=1)))
+        firm_chunk = max(((1 << 25) // h_max) // 128 * 128, 128)
+    c = min(int(firm_chunk), n_firms)
+
+    order = np.argsort(-counts, kind="stable")
+
+    import jax.numpy as jnp
+
+    mkt_j = jnp.asarray(np.asarray(mkt_d))
+    mkt_present_j = jnp.asarray(np.asarray(mkt_present))
+    month_j = jnp.asarray(np.asarray(day_month_id))
+    week_j = jnp.asarray(np.asarray(week_id))
+    week_month_j = jnp.asarray(np.asarray(week_month_id))
+
+    vol_out = np.empty((n_months, n_firms), dtype=dtype)
+    beta_out = np.empty((n_months, n_firms), dtype=dtype)
+    for start in range(0, n_firms, c):
+        firms = order[start : start + c]
+        h = bucket(int(counts[firms].max(initial=1)))
+        rect_vals = np.full((h, c), np.nan, dtype=dtype)
+        rect_pos = np.full((h, c), n_days, dtype=row_pos.dtype)
+        for k, f in enumerate(firms):
+            a, b = offsets[f], offsets[f + 1]
+            rect_vals[: b - a, k] = row_values[a:b]
+            rect_pos[: b - a, k] = row_pos[a:b]
+        vol_s, beta_s = daily_compact_strip(
+            jnp.asarray(rect_vals), jnp.asarray(rect_pos),
+            mkt_j, mkt_present_j, month_j, week_j, week_month_j,
+            n_days, n_weeks, n_months,
+            window=window, min_periods=min_periods,
+            window_weeks=window_weeks, use_pallas=use_pallas,
+        )
+        vol_out[:, firms] = np.asarray(vol_s)[:, : len(firms)]
+        beta_out[:, firms] = np.asarray(beta_s)[:, : len(firms)]
+    return vol_out, beta_out

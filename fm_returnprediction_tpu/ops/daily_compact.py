@@ -1,0 +1,107 @@
+"""Compacted-ingest daily kernels — the transfer-lean path to full scale.
+
+The dense (D, N) daily panel is mostly padding at real CRSP sparsity (~70-90M
+firm-day rows over a 12,608-day × ~25k-firm grid ≈ 20-25% fill), and on a
+single chip the daily stage is bound by host→device transfer, not compute
+(measured round 2: moving the dense panel takes tens of seconds; the compute
+is sub-second per strip). This module ingests each firm's rows ALREADY
+COMPACTED — ``values`` (H, N) with each firm's observed rows packed to the
+front in chronological order, plus ``pos`` (H, N) int day indices (int16 on
+the wire: D < 32,768) — cutting bytes moved to ~6 per observed row and
+eliminating the host argsort compaction plan entirely (the round-1 VERDICT's
+first memory target, ``ops/compaction.py:44-57``).
+
+On device, ONE fused strip program computes both daily characteristics:
+
+- vol-252 (reference ``calc_std_12``, ``src/calc_Lewellen_2014.py:438-465``):
+  the compacted rows ARE pandas' per-firm row windows, so ``rolling_std``
+  runs directly on the ingested layout — no compaction step at all.
+- The calendar-indexed steps (last-observation-per-month sampling, weekly
+  beta, ``src/calc_Lewellen_2014.py:344-434``) run on a dense (D, N) strip
+  reconstructed device-side by scatter, sharing the existing dense kernels
+  (``ops.daily_kernels``) — so compact vs dense is the same code, not a
+  parallel implementation. Measured on TPU v5e: 2D scatter ≈ 290 ms and
+  shared-id ``segment_sum`` ≈ 70 ms per (13312, 2432) strip, an order of
+  magnitude faster than per-column binary-search formulations (vmapped
+  ``searchsorted`` ≈ 1.7 s) that avoid reconstruction.
+
+Padding rows carry ``pos == n_days``; the scatter target has one trash row
+at index ``n_days`` that is sliced off, so padding vanishes without masks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.daily_kernels import (
+    last_obs_per_month,
+    weekly_rolling_beta_monthly,
+)
+from fm_returnprediction_tpu.ops.rolling import rolling_std
+
+__all__ = ["daily_compact_strip"]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_days", "n_weeks", "n_months",
+        "window", "min_periods", "window_weeks", "use_pallas",
+    ),
+)
+def daily_compact_strip(
+    comp_ret: jnp.ndarray,
+    pos: jnp.ndarray,
+    mkt_d: jnp.ndarray,
+    mkt_present: jnp.ndarray,
+    day_month_id: jnp.ndarray,
+    week_id: jnp.ndarray,
+    week_month_id: jnp.ndarray,
+    n_days: int,
+    n_weeks: int,
+    n_months: int,
+    window: int = 252,
+    min_periods: int = 100,
+    window_weeks: int = 156,
+    use_pallas: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """vol-252 and weekly beta for one compacted firm strip.
+
+    comp_ret : (H, C) firm rows packed to the front (chronological); padding
+               slots hold anything (gated by ``pos``).
+    pos      : (H, C) int day index of each row, sorted per column;
+               ``n_days`` marks padding.
+    Remaining args are the shared per-day/per-week vectors of the dense
+    kernels. Returns ``(vol, beta)``, each (n_months, C).
+    """
+    pos = pos.astype(jnp.int32)
+    row_present = pos < n_days
+    cols = jnp.broadcast_to(
+        jnp.arange(comp_ret.shape[1])[None, :], comp_ret.shape
+    )
+
+    def to_dense(x, fill):
+        out = jnp.full((n_days + 1, x.shape[1]) , fill, dtype=x.dtype)
+        return out.at[pos, cols].set(x)[:n_days]  # padding → trash row n_days
+
+    mask = to_dense(row_present, False)
+
+    # vol: rolling over the firm's observed rows — already the ingested layout
+    vol_rows = rolling_std(
+        jnp.where(row_present, comp_ret, jnp.nan), window, min_periods,
+        use_pallas=use_pallas,
+    ) * jnp.sqrt(jnp.asarray(float(window), dtype=comp_ret.dtype))
+    vol_cal = to_dense(jnp.where(row_present, vol_rows, jnp.nan), jnp.nan)
+    vol = last_obs_per_month(vol_cal, mask, day_month_id, n_months)
+
+    # beta: dense reconstruction feeds the exact dense weekly kernel
+    ret_cal = to_dense(jnp.where(row_present, comp_ret, jnp.nan), jnp.nan)
+    beta = weekly_rolling_beta_monthly(
+        ret_cal, mask, mkt_d, week_id, n_weeks, week_month_id, n_months,
+        window_weeks=window_weeks, mkt_present=mkt_present,
+    )
+    return vol, beta

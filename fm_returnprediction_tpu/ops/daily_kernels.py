@@ -42,7 +42,12 @@ import jax.numpy as jnp
 from fm_returnprediction_tpu.ops.compaction import compact, make_compaction, scatter_back
 from fm_returnprediction_tpu.ops.rolling import rolling_std, windowed_count, windowed_sum
 
-__all__ = ["last_obs_per_month", "rolling_vol_252_monthly", "weekly_rolling_beta_monthly"]
+__all__ = [
+    "last_obs_per_month",
+    "beta_from_weekly_sums",
+    "rolling_vol_252_monthly",
+    "weekly_rolling_beta_monthly",
+]
 
 
 def _forward_windowed_sum(x: jnp.ndarray, window: int) -> jnp.ndarray:
@@ -167,19 +172,46 @@ def weekly_rolling_beta_monthly(
     w_rirm = seg(jnp.where(ri_valid & rm_valid, log_ri * log_rm, 0.0))
     w_rm2 = seg(log_rm * log_rm)
     w_cnt = seg(present.astype(log_ri.dtype))        # pl.count(): all rows
+    w_rm_cnt = seg(rm_valid.astype(log_ri.dtype))    # rows with market data
 
+    return beta_from_weekly_sums(
+        w_ri, w_rm, w_rirm, w_rm2, w_cnt, w_rm_cnt,
+        week_month_id, n_months, window_weeks,
+    )
+
+
+def beta_from_weekly_sums(
+    w_ri, w_rm, w_rirm, w_rm2, w_cnt, w_rm_cnt, week_month_id, n_months,
+    window_weeks,
+):
+    """Weekly partial sums (n_weeks, N) → (n_months, N) betas.
+
+    The representation-independent half of the beta kernel, factored out so
+    every ingest layout reduces to the same windowing/validity/labeling
+    logic (``ops.daily_compact`` reconstructs a dense strip and calls
+    ``weekly_rolling_beta_monthly``, which lands here).
+    """
     s_ri = _forward_windowed_sum(w_ri, window_weeks)
     s_rm = _forward_windowed_sum(w_rm, window_weeks)
     s_rirm = _forward_windowed_sum(w_rirm, window_weeks)
     s_rm2 = _forward_windowed_sum(w_rm2, window_weeks)
     n = _forward_windowed_sum(w_cnt, window_weeks)
+    n_rm = _forward_windowed_sum(w_rm_cnt, window_weeks)
 
     n_safe = jnp.maximum(n, 1.0)
     cov = s_rirm - s_ri * s_rm / n_safe
     var = s_rm2 - s_rm * s_rm / n_safe
-    beta = cov / var  # var == 0 (e.g. single obs) -> ±inf/NaN flows, as in polars
+    # Degenerate windows where cov and var are EXACTLY zero in real
+    # arithmetic (n <= 1, or no row in the window carries a market return)
+    # give 0/0 = null in polars — gate them explicitly, because the
+    # cumulative-sum-difference windowed sums leave tiny nonzero residuals
+    # where real arithmetic gives exact zeros, which would otherwise turn
+    # 0/0 into an arbitrary finite beta. For non-degenerate windows,
+    # var == 0 still flows to ±inf/NaN exactly as in polars.
+    beta = jnp.where((n >= 2.0) & (n_rm >= 1.0), cov / var, jnp.nan)
 
     # Window starts are emitted per firm from its first to its last obs week.
+    n_weeks = w_cnt.shape[0]
     week_pos = jnp.arange(n_weeks)[:, None]
     has = w_cnt > 0
     first = jnp.min(jnp.where(has, week_pos, n_weeks), axis=0)

@@ -41,13 +41,12 @@ import numpy as np
 import pandas as pd
 
 from fm_returnprediction_tpu.ops.compaction import compact, lag, make_compaction, scatter_back
-from fm_returnprediction_tpu.ops.daily_kernels import (
-    rolling_vol_252_monthly,
-    weekly_rolling_beta_monthly,
+from fm_returnprediction_tpu.ops.daily_chunked import (
+    daily_characteristics_compact_chunked,
 )
 from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
 from fm_returnprediction_tpu.ops.rolling import rolling_prod, rolling_sum
-from fm_returnprediction_tpu.panel.daily import build_daily_panel
+from fm_returnprediction_tpu.panel.daily import build_compact_daily, build_daily_panel
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
 
 __all__ = ["FACTORS_DICT", "BASE_COLUMNS", "compute_monthly_characteristics", "get_factors"]
@@ -164,6 +163,8 @@ def get_factors(
     crsp_d: pd.DataFrame,
     crsp_index_d: pd.DataFrame,
     dtype=np.float64,
+    mesh=None,
+    firm_chunk=None,
 ) -> Tuple[DensePanel, Dict[str, str]]:
     """Dense-panel equivalent of the reference's ``get_factors``
     (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
@@ -171,7 +172,15 @@ def get_factors(
 
     ``crsp_comp`` is the merged monthly panel (needs BASE_COLUMNS sources +
     permno/jdate/primaryexch); ``crsp_d``/``crsp_index_d`` the daily data.
+    The daily stage (the data-volume hot spot) runs firm-sharded over
+    ``mesh`` when one is given, else firm-chunked on the single device
+    (``firm_chunk=None`` = auto budget; see ``ops.daily_chunked``).
     """
+    if mesh is not None and firm_chunk is not None:
+        raise ValueError(
+            "firm_chunk applies only to the single-device compact path; "
+            "the mesh path shards the full firm axis (pass one or the other)"
+        )
     df = crsp_comp.copy()
     df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
     panel = long_to_dense(df, "jdate", "permno", BASE_COLUMNS, dtype=dtype)
@@ -181,30 +190,36 @@ def get_factors(
         jnp.asarray(panel.values), jnp.asarray(panel.mask), var_index
     )
 
-    daily = build_daily_panel(crsp_d, crsp_index_d, panel.months, dtype=dtype)
-    vol = rolling_vol_252_monthly(
-        jnp.asarray(daily.ret),
-        jnp.asarray(daily.mask),
-        jnp.asarray(daily.day_month_id),
-        daily.n_months,
-    )
-    beta = weekly_rolling_beta_monthly(
-        jnp.asarray(daily.ret),
-        jnp.asarray(daily.mask),
-        jnp.asarray(daily.mkt),
-        jnp.asarray(daily.week_id),
-        daily.n_weeks,
-        jnp.asarray(daily.week_month_id),
-        daily.n_months,
-        mkt_present=jnp.asarray(daily.mkt_present),
-    )
+    if mesh is not None:
+        from fm_returnprediction_tpu.parallel.daily_sharded import (
+            daily_characteristics_sharded,
+        )
+
+        daily = build_daily_panel(crsp_d, crsp_index_d, panel.months, dtype=dtype)
+        vol, beta = daily_characteristics_sharded(
+            daily.ret, daily.mask, daily.mkt, daily.day_month_id,
+            daily.week_id, daily.week_month_id, daily.n_months, daily.n_weeks,
+            mesh=mesh, mkt_present=daily.mkt_present,
+        )
+        daily_ids = daily.ids
+        vol_np = np.asarray(vol)[:, : len(daily_ids)]   # drop mesh padding
+        beta_np = np.asarray(beta)[:, : len(daily_ids)]
+    else:
+        # Compacted ingest: never materializes the dense (D, N) daily grid,
+        # on host or device — the full-CRSP single-chip path.
+        cd = build_compact_daily(crsp_d, crsp_index_d, panel.months, dtype=dtype)
+        vol_np, beta_np = daily_characteristics_compact_chunked(
+            cd.row_values, cd.row_pos, cd.offsets, cd.mkt, cd.mkt_present,
+            cd.day_month_id, cd.week_id, cd.week_month_id,
+            cd.n_days, cd.n_weeks, cd.n_months, firm_chunk=firm_chunk,
+        )
+        daily_ids = cd.ids
 
     # Align daily-firm columns onto the monthly panel's permno vocabulary
     # (left-merge semantics: monthly firms absent from daily data get NaN).
-    vol_np, beta_np = np.asarray(vol), np.asarray(beta)
-    pos = np.searchsorted(daily.ids, panel.ids)
-    pos_c = np.clip(pos, 0, len(daily.ids) - 1)
-    hit = daily.ids[pos_c] == panel.ids          # (N,) daily data exists
+    pos = np.searchsorted(daily_ids, panel.ids)
+    pos_c = np.clip(pos, 0, len(daily_ids) - 1)
+    hit = daily_ids[pos_c] == panel.ids          # (N,) daily data exists
     keep = hit[None, :] & panel.mask             # left-merge: panel rows only
     vol_m = np.where(keep, vol_np[:, pos_c], np.nan)
     beta_m = np.where(keep, beta_np[:, pos_c], np.nan)

@@ -16,7 +16,13 @@ from pandas.tseries.offsets import MonthEnd
 
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
 
-__all__ = ["DailyPanel", "build_daily_panel", "month_index_of"]
+__all__ = [
+    "DailyPanel",
+    "CompactDaily",
+    "build_daily_panel",
+    "build_compact_daily",
+    "month_index_of",
+]
 
 
 def month_index_of(dates: pd.DatetimeIndex, months: np.ndarray) -> np.ndarray:
@@ -37,7 +43,6 @@ class DailyPanel:
     """Dense daily data aligned to a monthly panel's vocabularies."""
 
     ret: np.ndarray            # (D, N) daily retx
-    prc: np.ndarray            # (D, N) daily price
     mask: np.ndarray           # (D, N) firm-day present
     mkt: np.ndarray            # (D,) market return (vwretx), NaN if absent/null
     mkt_present: np.ndarray    # (D,) bool, index table has a row for the day
@@ -48,6 +53,107 @@ class DailyPanel:
     n_weeks: int
     week_month_id: np.ndarray  # (n_weeks,) month index of each week's Monday
     n_months: int
+
+
+@dataclasses.dataclass
+class CompactDaily:
+    """Daily data in per-firm compacted (CSR-like) layout.
+
+    The transfer-lean single-chip representation (see ``ops.daily_compact``):
+    each firm's observed rows in chronological order, flattened firm-major,
+    with int day positions into the shared trading-day vocabulary. At real
+    CRSP sparsity this is ~4x smaller than the dense (D, N) grid and is the
+    payload the chunked driver slices into strips.
+    """
+
+    row_values: np.ndarray     # (R,) retx rows, firm-major chronological
+    row_pos: np.ndarray        # (R,) day index; int16 when n_days < 32768
+    offsets: np.ndarray        # (N+1,) int64 firm row ranges
+    ids: np.ndarray            # (N,) permnos (sorted, same vocab as dense)
+    mkt: np.ndarray            # (D,) market return (vwretx)
+    mkt_present: np.ndarray    # (D,) bool, index table has the day
+    days: np.ndarray           # (D,) datetime64 trading-day vocabulary
+    day_month_id: np.ndarray   # (D,) month index (trash=n_months)
+    week_id: np.ndarray        # (D,) Monday-lattice week index
+    n_weeks: int
+    week_month_id: np.ndarray  # (n_weeks,) month index of each week's Monday
+    n_months: int
+
+    @property
+    def n_days(self) -> int:
+        return len(self.days)
+
+    @property
+    def counts(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+
+def _daily_calendar(crsp_index_d: pd.DataFrame, days: pd.DatetimeIndex,
+                    months: np.ndarray, dtype):
+    """Shared per-day vectors: market series aligned to the trading-day
+    vocabulary, month ids, and the Monday week lattice."""
+    idx = crsp_index_d.drop_duplicates(subset=["caldt"], keep="last").set_index("caldt")
+    mkt = idx["vwretx"].reindex(days).to_numpy(dtype=dtype)
+    mkt_present = np.asarray(days.isin(idx.index))
+
+    day_month_id = month_index_of(days + MonthEnd(0), months)
+
+    # Monday lattice: numpy day-of-epoch arithmetic (1970-01-01 was a Thursday,
+    # so epoch day 4 was the first Monday; (d + 3) // 7 indexes Monday weeks).
+    epoch_days = np.asarray(days, dtype="datetime64[D]").astype(np.int64)
+    monday_week = (epoch_days + 3) // 7
+    week0 = monday_week.min()
+    week_id = (monday_week - week0).astype(np.int32)
+    n_weeks = int(week_id.max()) + 1
+
+    week_mondays = pd.to_datetime((np.arange(n_weeks) + week0) * 7 - 3, unit="D")
+    week_month_id = month_index_of(week_mondays + MonthEnd(0), months)
+    return mkt, mkt_present, day_month_id, week_id, n_weeks, week_month_id
+
+
+def build_compact_daily(
+    crsp_d: pd.DataFrame,
+    crsp_index_d: pd.DataFrame,
+    months: np.ndarray,
+    dtype=np.float64,
+) -> CompactDaily:
+    """Pack daily CRSP rows into the compacted per-firm layout WITHOUT ever
+    materializing the dense (D, N) grid — O(R) host memory for R observed
+    rows (the reference's daily volume note, SURVEY §3.5)."""
+    df = crsp_d[["permno", "dlycaldt", "retx"]].sort_values(["permno", "dlycaldt"])
+    # keep-last dedup, matching long_to_dense's documented semantics so the
+    # compact and dense/mesh paths agree on duplicated (permno, day) rows
+    df = df.drop_duplicates(subset=["permno", "dlycaldt"], keep="last")
+    ids, firm_idx = np.unique(df["permno"].to_numpy(), return_inverse=True)
+    days_idx = pd.DatetimeIndex(np.unique(df["dlycaldt"].to_numpy()))
+    n_days = len(days_idx)
+    pos = np.searchsorted(
+        np.asarray(days_idx, dtype="datetime64[s]").astype(np.int64),
+        np.asarray(pd.DatetimeIndex(df["dlycaldt"]), dtype="datetime64[s]").astype(np.int64),
+    )
+    pos_dtype = np.int16 if n_days < np.iinfo(np.int16).max else np.int32
+
+    counts = np.bincount(firm_idx, minlength=len(ids))
+    offsets = np.zeros(len(ids) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+
+    mkt, mkt_present, day_month_id, week_id, n_weeks, week_month_id = _daily_calendar(
+        crsp_index_d, days_idx, months, dtype
+    )
+    return CompactDaily(
+        row_values=df["retx"].to_numpy(dtype=dtype),
+        row_pos=pos.astype(pos_dtype),
+        offsets=offsets,
+        ids=ids,
+        mkt=mkt,
+        mkt_present=mkt_present,
+        days=np.asarray(days_idx),
+        day_month_id=day_month_id,
+        week_id=week_id,
+        n_weeks=n_weeks,
+        week_month_id=week_month_id,
+        n_months=len(months),
+    )
 
 
 def build_daily_panel(
@@ -64,30 +170,15 @@ def build_daily_panel(
     beta, reproducing the reference's inner join at
     ``src/calc_Lewellen_2014.py:380``).
     """
-    dense = long_to_dense(crsp_d, "dlycaldt", "permno", ["retx", "prc"], dtype=dtype)
+    dense = long_to_dense(crsp_d, "dlycaldt", "permno", ["retx"], dtype=dtype)
     days = pd.DatetimeIndex(dense.months)
 
-    idx = crsp_index_d.drop_duplicates(subset=["caldt"], keep="last").set_index("caldt")
-    mkt = idx["vwretx"].reindex(days).to_numpy(dtype=dtype)
-    mkt_present = np.asarray(days.isin(idx.index))
-
-    day_month = days + MonthEnd(0)
-    day_month_id = month_index_of(day_month, months)
-
-    # Monday lattice: numpy day-of-epoch arithmetic (1970-01-01 was a Thursday,
-    # so epoch day 4 was the first Monday; (d + 3) // 7 indexes Monday weeks).
-    epoch_days = np.asarray(days, dtype="datetime64[D]").astype(np.int64)
-    monday_week = (epoch_days + 3) // 7
-    week0 = monday_week.min()
-    week_id = (monday_week - week0).astype(np.int32)
-    n_weeks = int(week_id.max()) + 1
-
-    week_mondays = pd.to_datetime((np.arange(n_weeks) + week0) * 7 - 3, unit="D")
-    week_month_id = month_index_of(week_mondays + MonthEnd(0), months)
+    mkt, mkt_present, day_month_id, week_id, n_weeks, week_month_id = _daily_calendar(
+        crsp_index_d, days, months, dtype
+    )
 
     return DailyPanel(
         ret=dense.var("retx"),
-        prc=dense.var("prc"),
         mask=dense.mask,
         mkt=mkt,
         mkt_present=mkt_present,

@@ -77,7 +77,7 @@ def load_raw_data(raw_data_dir) -> Dict[str, pd.DataFrame]:
 
 
 def build_panel(
-    data: Dict[str, pd.DataFrame], dtype=np.float64
+    data: Dict[str, pd.DataFrame], dtype=np.float64, mesh=None
 ) -> tuple[DensePanel, Dict[str, str]]:
     """Raw frames → merged monthly panel → dense characteristic panel.
 
@@ -95,7 +95,9 @@ def build_panel(
     merged = merge_CRSP_and_Compustat(crsp, comp, data["ccm"])
     if "mthcaldt" not in merged.columns:
         merged["mthcaldt"] = merged["jdate"]
-    return get_factors(merged, data["crsp_d"], data["crsp_index_d"], dtype=dtype)
+    return get_factors(
+        merged, data["crsp_d"], data["crsp_index_d"], dtype=dtype, mesh=mesh
+    )
 
 
 def run_pipeline(
@@ -139,15 +141,6 @@ def run_pipeline(
                 )
             data = load_raw_data(raw_data_dir)
 
-    with timer.stage("build_panel"):
-        panel, factors_dict = build_panel(data, dtype=dtype)
-
-    with timer.stage("subset_masks"):
-        subset_masks = compute_subset_masks(panel)
-
-    with timer.stage("table_1"):
-        table_1 = build_table_1(panel, subset_masks, factors_dict)
-
     mesh = None
     if use_mesh or use_mesh is None:
         import jax
@@ -159,6 +152,15 @@ def run_pipeline(
             if len(jax.devices()) <= 1:
                 raise RuntimeError("use_mesh=True but only one device is available")
             mesh = make_mesh(axis_name="firms")
+
+    with timer.stage("build_panel"):
+        panel, factors_dict = build_panel(data, dtype=dtype, mesh=mesh)
+
+    with timer.stage("subset_masks"):
+        subset_masks = compute_subset_masks(panel)
+
+    with timer.stage("table_1"):
+        table_1 = build_table_1(panel, subset_masks, factors_dict)
 
     with timer.stage("table_2"):
         table_2 = build_table_2(panel, subset_masks, factors_dict, mesh=mesh)

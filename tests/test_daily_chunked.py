@@ -1,0 +1,223 @@
+"""Firm-axis chunking must be a pure execution-schedule choice: identical
+results to the single-call daily kernels for every chunk width, including
+non-divisible widths (padded last strip) and the auto heuristic."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.ops.daily_chunked import (
+    auto_firm_chunk,
+    daily_characteristics_chunked,
+)
+from fm_returnprediction_tpu.ops.daily_kernels import (
+    rolling_vol_252_monthly,
+    weekly_rolling_beta_monthly,
+)
+
+
+def _daily_fixture(rng, d=240, n=37, n_months=12):
+    ret = rng.standard_normal((d, n)) * 0.02
+    mask = rng.random((d, n)) > 0.25
+    ret = np.where(rng.random((d, n)) > 0.05, ret, np.nan)  # nulls inside rows
+    mkt = rng.standard_normal(d) * 0.01
+    mkt_present = rng.random(d) > 0.03
+    mkt = np.where(mkt_present, mkt, np.nan)
+    month_id = np.repeat(np.arange(n_months), d // n_months).astype(np.int32)
+    week_id = (np.arange(d) // 5).astype(np.int32)
+    n_weeks = int(week_id.max()) + 1
+    week_month_id = np.clip(np.arange(n_weeks) // 4, 0, n_months - 1).astype(np.int32)
+    return dict(
+        ret_d=ret, mask_d=mask, mkt_d=mkt, month_id=month_id,
+        week_id=week_id, week_month_id=week_month_id,
+        n_months=n_months, n_weeks=n_weeks, mkt_present=mkt_present,
+    )
+
+
+def _unchunked(d, window=60, min_periods=20, window_weeks=26):
+    vol = rolling_vol_252_monthly(
+        jnp.asarray(d["ret_d"]), jnp.asarray(d["mask_d"]),
+        jnp.asarray(d["month_id"]), d["n_months"],
+        window=window, min_periods=min_periods,
+    )
+    beta = weekly_rolling_beta_monthly(
+        jnp.asarray(d["ret_d"]), jnp.asarray(d["mask_d"]),
+        jnp.asarray(d["mkt_d"]), jnp.asarray(d["week_id"]), d["n_weeks"],
+        jnp.asarray(d["week_month_id"]), d["n_months"],
+        window_weeks=window_weeks, mkt_present=jnp.asarray(d["mkt_present"]),
+    )
+    return np.asarray(vol), np.asarray(beta)
+
+
+@pytest.mark.parametrize("chunk", [1, 7, 16, 37, 64])
+def test_chunked_matches_single_call(rng, chunk):
+    d = _daily_fixture(rng)
+    vol0, beta0 = _unchunked(d)
+    vol, beta = daily_characteristics_chunked(
+        **d, window=60, min_periods=20, window_weeks=26, firm_chunk=chunk
+    )
+    np.testing.assert_array_equal(vol, vol0)
+    np.testing.assert_array_equal(beta, beta0)
+
+
+def test_auto_chunk_heuristic():
+    # whole panel fits → no chunking
+    assert auto_firm_chunk(240, 37, 8, budget_bytes=1 << 30) is None
+    # full CRSP scale in f32 on a 16 GiB chip → a few-thousand-firm strip
+    c = auto_firm_chunk(12608, 25000, 4, budget_bytes=int(9.6e9))
+    assert c is not None and 128 <= c < 25000 and c % 128 == 0
+    # tiny budget still returns the floor width, never 0
+    assert auto_firm_chunk(12608, 25000, 8, budget_bytes=1) == 128
+
+
+def _to_csr(d):
+    """Dense fixture → compacted CSR layout (firm-major chronological rows)."""
+    mask = d["mask_d"]
+    n_days, n_firms = mask.shape
+    row_values, row_pos, offsets = [], [], [0]
+    for f in range(n_firms):
+        rows = np.nonzero(mask[:, f])[0]
+        row_values.append(d["ret_d"][rows, f])
+        row_pos.append(rows)
+        offsets.append(offsets[-1] + len(rows))
+    return dict(
+        row_values=np.concatenate(row_values),
+        row_pos=np.concatenate(row_pos).astype(np.int16),
+        offsets=np.asarray(offsets, dtype=np.int64),
+        mkt_d=d["mkt_d"],
+        mkt_present=d["mkt_present"],
+        day_month_id=d["month_id"],
+        week_id=d["week_id"],
+        week_month_id=d["week_month_id"],
+        n_days=n_days,
+        n_weeks=d["n_weeks"],
+        n_months=d["n_months"],
+    )
+
+
+@pytest.mark.parametrize("chunk,bucket", [(37, 64), (10, 32), (8, 256)])
+def test_compact_chunked_matches_dense(rng, chunk, bucket):
+    """The compacted-ingest path matches the dense kernels for every strip
+    width and height bucket — including firms reordered by row count and the
+    padded final strip."""
+    from fm_returnprediction_tpu.ops.daily_chunked import (
+        daily_characteristics_compact_chunked,
+    )
+
+    d = _daily_fixture(rng)
+    vol0, beta0 = _unchunked(d)
+    csr = _to_csr(d)
+    vol, beta = daily_characteristics_compact_chunked(
+        **csr, window=60, min_periods=20, window_weeks=26,
+        firm_chunk=chunk, height_bucket=bucket,
+    )
+    # bit-exact: the strip kernel reconstructs the dense grid on device and
+    # runs the SAME dense kernels, so chunking + compact ingest is purely an
+    # execution-schedule choice
+    np.testing.assert_array_equal(vol, vol0)
+    np.testing.assert_array_equal(beta, beta0)
+
+
+def test_build_compact_daily_matches_dense_panel(rng):
+    """Host CSR builder agrees with the dense builder on the synthetic
+    universe: same ids/day vocabulary, and rows land at the same positions."""
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.panel.daily import (
+        build_compact_daily,
+        build_daily_panel,
+    )
+
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=25, n_months=30))
+    months = np.sort(data["crsp_m"]["jdate"].unique())
+    dense = build_daily_panel(data["crsp_d"], data["crsp_index_d"], months)
+    cd = build_compact_daily(data["crsp_d"], data["crsp_index_d"], months)
+
+    np.testing.assert_array_equal(cd.ids, dense.ids)
+    np.testing.assert_array_equal(cd.days, dense.days)
+    np.testing.assert_array_equal(cd.day_month_id, dense.day_month_id)
+    np.testing.assert_array_equal(cd.week_id, dense.week_id)
+    np.testing.assert_array_equal(cd.week_month_id, dense.week_month_id)
+    assert cd.n_weeks == dense.n_weeks and cd.n_months == dense.n_months
+    # CSR rows reproduce the dense grid exactly
+    rebuilt = np.full_like(dense.ret, np.nan)
+    mask = np.zeros_like(dense.mask)
+    for f in range(len(cd.ids)):
+        a, b = cd.offsets[f], cd.offsets[f + 1]
+        rebuilt[cd.row_pos[a:b].astype(np.int64), f] = cd.row_values[a:b]
+        mask[cd.row_pos[a:b].astype(np.int64), f] = True
+    np.testing.assert_array_equal(mask, dense.mask)
+    np.testing.assert_array_equal(
+        np.where(mask, rebuilt, np.nan), np.where(dense.mask, dense.ret, np.nan)
+    )
+
+
+def test_compact_builder_dedups_keep_last(rng):
+    """Duplicate (permno, day) rows must dedup keep-last, matching
+    long_to_dense, so the compact and dense/mesh paths agree."""
+    import pandas as pd
+
+    from fm_returnprediction_tpu.panel.daily import build_compact_daily
+
+    crsp_d = pd.DataFrame(
+        {
+            "permno": [1, 1, 1, 2],
+            "dlycaldt": pd.to_datetime(
+                ["2000-01-03", "2000-01-03", "2000-01-04", "2000-01-03"]
+            ),
+            "retx": [0.10, 0.20, 0.30, 0.40],
+        }
+    )
+    idx = pd.DataFrame(
+        {"caldt": pd.to_datetime(["2000-01-03", "2000-01-04"]), "vwretx": [0.0, 0.0]}
+    )
+    months = np.asarray(pd.to_datetime(["2000-01-31"]))
+    cd = build_compact_daily(crsp_d, idx, months)
+    assert list(cd.counts) == [2, 1]
+    a, b = cd.offsets[0], cd.offsets[1]
+    assert cd.row_values[a] == 0.20  # keep-last won
+
+
+def test_beta_all_null_market_window_nan(rng):
+    """A window whose rows all lack market returns has cov = var = 0 exactly
+    (polars: 0/0 = null); the cumsum-difference residuals must not turn it
+    into an arbitrary finite beta."""
+    d_days, n_firms = 120, 3
+    ret = rng.standard_normal((d_days, n_firms)) * 0.02
+    mask = np.ones((d_days, n_firms), bool)
+    # market: present every day, but returns null for the first 60 days
+    mkt = rng.standard_normal(d_days) * 0.01
+    mkt[:60] = np.nan
+    mkt_present = np.ones(d_days, bool)
+    # firm 2 only exists in the null-market regime
+    mask[60:, 2] = False
+    month_id = np.repeat(np.arange(6), 20).astype(np.int32)
+    week_id = (np.arange(d_days) // 5).astype(np.int32)
+    n_weeks = int(week_id.max()) + 1
+    week_month_id = np.clip(np.arange(n_weeks) // 4, 0, 5).astype(np.int32)
+
+    beta = weekly_rolling_beta_monthly(
+        jnp.asarray(ret), jnp.asarray(mask), jnp.asarray(mkt),
+        jnp.asarray(week_id), n_weeks, jnp.asarray(week_month_id), 6,
+        window_weeks=6, mkt_present=jnp.asarray(mkt_present),
+    )
+    b = np.asarray(beta)
+    # firm 2's windows never contain a market return → NaN everywhere
+    assert np.isnan(b[:, 2]).all()
+    # firms 0/1 have data-bearing windows late in the sample → some finite
+    assert np.isfinite(b[:, :2]).any()
+
+
+def test_chunked_auto_path_runs(rng, monkeypatch):
+    """Auto heuristic with a tiny budget must force multi-strip execution and
+    still match the single call."""
+    monkeypatch.setenv("FMRP_DAILY_BUDGET_BYTES", "200000")
+    d = _daily_fixture(rng)
+    vol0, beta0 = _unchunked(d)
+    vol, beta = daily_characteristics_chunked(
+        **d, window=60, min_periods=20, window_weeks=26
+    )
+    np.testing.assert_array_equal(vol, vol0)
+    np.testing.assert_array_equal(beta, beta0)

@@ -226,6 +226,25 @@ def test_table2_mesh_matches_single_device():
     assert agree > 0.95, f"only {agree:.2%} of formatted cells agree"
 
 
+def test_build_panel_mesh_daily_stage_matches_single_device():
+    """get_factors routes the daily stage through the firm-sharded kernels
+    when a mesh is passed; vol/beta columns must match the single-device
+    (chunked) path exactly — the sharded program is collective-free."""
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.pipeline import build_panel
+
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=30, n_months=40))
+    p_single, _ = build_panel(data)
+    p_mesh, _ = build_panel(data, mesh=make_mesh(axis_name="firms"))
+    for col in ("rolling_std_252", "beta"):
+        a = p_single.var(col)
+        b = p_mesh.var(col)
+        np.testing.assert_array_equal(a, b, err_msg=col)
+
+
 def test_default_mesh_honors_setting(monkeypatch):
     from fm_returnprediction_tpu.parallel import default_mesh
 
