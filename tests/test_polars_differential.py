@@ -1,0 +1,116 @@
+"""Differential test of the weekly beta against REAL polars.
+
+Round-1 VERDICT item 3: the beta kernel and its loop oracle
+(``tests/oracle.py::oracle_weekly_beta``) both encode the same author's
+reading of the reference's polars call
+(``group_by_dynamic(every="1w", period="156w", by="permno")``,
+``src/calc_Lewellen_2014.py:396-410``) — a shared misreading would pass
+every in-repo test. This test runs the reference's ACTUAL polars pipeline
+(transcribed call-for-call from ``src/calc_Lewellen_2014.py:368-430``) on
+synthetic daily data and asserts the kernel reproduces it: lattice
+anchoring, window direction, label/month stamping, null semantics.
+
+polars is not installed in the build image (zero egress — wheel cannot be
+vendored), so the test gates on importability and SKIPS there; it runs
+wherever polars 1.x is present (the reference pins polars==1.22.0).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+pl = pytest.importorskip("polars")
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.ops.daily_kernels import weekly_rolling_beta_monthly
+from fm_returnprediction_tpu.panel.daily import build_daily_panel
+
+
+def _reference_polars_beta(crsp_d: pd.DataFrame, crsp_index_d: pd.DataFrame) -> pd.DataFrame:
+    """The reference's beta computation, verbatim semantics
+    (src/calc_Lewellen_2014.py:368-430): inner join on date, log1p,
+    group_by_dynamic 1w/156w by permno, closed-form beta from partial sums,
+    month-end label stamp, keep-last dedup per (permno, month)."""
+    df = crsp_d[["permno", "dlycaldt", "retx"]].rename(
+        columns={"retx": "Ri", "dlycaldt": "date"}
+    )
+    mkt = crsp_index_d[["caldt", "vwretx"]].rename(
+        columns={"vwretx": "Rm", "caldt": "date"}
+    )
+    df_joined = pl.DataFrame(df).join(pl.DataFrame(mkt), on="date")
+    df_joined = df_joined.with_columns(
+        [
+            (pl.col("Ri") + 1).log().alias("log_Ri"),
+            (pl.col("Rm") + 1).log().alias("log_Rm"),
+        ]
+    ).sort(["permno", "date"])
+    out = (
+        df_joined.lazy()
+        .group_by_dynamic(index_column="date", every="1w", period="156w", by="permno")
+        .agg(
+            [
+                pl.col("log_Ri").sum().alias("sum_Ri"),
+                pl.col("log_Rm").sum().alias("sum_Rm"),
+                (pl.col("log_Ri") * pl.col("log_Rm")).sum().alias("sum_RiRm"),
+                (pl.col("log_Rm") ** 2).sum().alias("sum_Rm2"),
+                pl.count().alias("count_obs"),
+            ]
+        )
+        .with_columns(
+            [
+                (
+                    (pl.col("sum_RiRm") - pl.col("sum_Ri") * pl.col("sum_Rm") / pl.col("count_obs"))
+                    / (pl.col("sum_Rm2") - pl.col("sum_Rm") ** 2 / pl.col("count_obs"))
+                ).alias("beta")
+            ]
+        )
+        .collect()
+        .to_pandas()
+    )
+    out["jdate"] = pd.to_datetime(out["date"]).dt.to_period("M").dt.to_timestamp("M")
+    out = out.drop_duplicates(subset=["permno", "jdate"], keep="last")
+    return out[["permno", "jdate", "beta"]]
+
+
+def test_weekly_beta_matches_real_polars():
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=40, n_months=50))
+    crsp_d, crsp_index_d = data["crsp_d"], data["crsp_index_d"]
+    # exercise null semantics: null some returns, drop some index days
+    crsp_d = crsp_d.copy()
+    rng = np.random.default_rng(0)
+    null_rows = rng.random(len(crsp_d)) < 0.02
+    crsp_d.loc[null_rows, "retx"] = np.nan
+    crsp_index_d = crsp_index_d[rng.random(len(crsp_index_d)) > 0.01]
+
+    months = np.sort(data["crsp_m"]["jdate"].unique())
+    expected = _reference_polars_beta(crsp_d, crsp_index_d)
+
+    daily = build_daily_panel(crsp_d, crsp_index_d, months)
+    beta = np.asarray(
+        weekly_rolling_beta_monthly(
+            jnp.asarray(daily.ret), jnp.asarray(daily.mask), jnp.asarray(daily.mkt),
+            jnp.asarray(daily.week_id), daily.n_weeks,
+            jnp.asarray(daily.week_month_id), daily.n_months,
+            mkt_present=jnp.asarray(daily.mkt_present),
+        )
+    )
+
+    month_pos = {pd.Timestamp(m): i for i, m in enumerate(months)}
+    id_pos = {p: i for i, p in enumerate(daily.ids)}
+    checked = 0
+    for _, row in expected.iterrows():
+        m = month_pos.get(pd.Timestamp(row["jdate"]))
+        f = id_pos.get(row["permno"])
+        if m is None or f is None:
+            continue  # label outside the monthly panel window
+        got = beta[m, f]
+        want = row["beta"]
+        if pd.isna(want):
+            assert np.isnan(got), (row["permno"], row["jdate"], got)
+        else:
+            assert np.isfinite(got), (row["permno"], row["jdate"], want)
+            np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+        checked += 1
+    assert checked > 200, f"only {checked} (permno, month) cells compared"
