@@ -1,17 +1,36 @@
-"""North-star benchmark: full-panel Fama-MacBeth + 10k block bootstrap.
+"""North-star benchmark — honest end-to-end + kernel + scale metrics.
 
-Workload (BASELINE.json): a full-scale synthetic Lewellen panel — 720 months
-(1964-2023) × 6,000 firm slots × 14 predictors — run through all three
-Lewellen models over three size universes (9 FM sweeps, the reference's
-~5,400 serial statsmodels fits, SURVEY §3.4) plus a 10,000-replicate
-moving-block bootstrap of the Model-3 slope series. The reference publishes
-no wall-clock numbers (BASELINE.md), so ``vs_baseline`` is measured against
-the driver-set 60 s north-star budget: value >1 means faster than target.
+Headline metric (the ``value`` field): WARM wall-clock of the full synthetic
+pipeline — relational transforms, dense panel build, daily vol/beta stage,
+all three Lewellen models over three size universes (9 FM sweeps), Table 1,
+Table 2, Figure 1 cross-sections, and decile sorts — the workload the
+north-star budget describes ("full panel … < 60 s", BASELINE.json).
+``vs_baseline`` is the 60 s budget over that number (>1 = faster than
+target; the reference publishes no wall-clock numbers, BASELINE.md).
 
-Prints ONE JSON line:
-    {"metric": "...", "value": <seconds>, "unit": "s", "vs_baseline": <60/s>}
+The ``extra`` dict carries the supporting evidence the headline used to
+over-claim without (round-1 VERDICT "What's weak" #1-2):
 
-Env knobs (for CPU smoke runs): FMRP_BENCH_MONTHS / _FIRMS / _REPLICATES.
+- ``pipeline_cold_s``        — same pipeline including jit compiles.
+- ``kernel_fm_boot_warm_s``  — the 9-sweep FM + 10k-replicate block
+  bootstrap alone on a prebuilt device panel (the round-1 headline).
+- ``daily_fullscale_*``      — the daily stage at REAL 1964-2013 CRSP shape
+  (~12.6k trading days × 25k permnos, ~85M firm-day rows at realistic
+  lifetimes) through the compact-ingest chunked driver: the "runs on real
+  CRSP scale on one chip" demonstration.
+- ``rolling_std_pallas_ms`` / ``rolling_std_xla_ms`` — the fused pallas
+  kernel vs the XLA cumsum path on a (12608, 4096) strip, recording the
+  speedup claimed at ``ops/rolling.py`` (TPU only; null on CPU).
+
+All timings synchronize by pulling a result to the host (``np.asarray``),
+not ``block_until_ready`` alone — on the tunneled axon backend the latter
+has been observed to return before execution completes, which is exactly
+the over-claim this bench exists to avoid.
+
+Prints ONE JSON line. Env knobs: FMRP_BENCH_FAST=1 shrinks every shape for
+CPU smoke runs; FMRP_BENCH_MONTHS/_FIRMS/_REPLICATES (kernel),
+FMRP_BENCH_PIPE_MONTHS/_FIRMS (pipeline), FMRP_BENCH_DAILY=0 (skip the
+full-scale daily stage).
 """
 
 from __future__ import annotations
@@ -37,33 +56,27 @@ def _make_panel(t, n, p, dtype=np.float32, seed=2014):
     return y, x, subsets
 
 
-def main() -> None:
+def _bench_kernel(fast: bool):
+    """9-sweep FM + block bootstrap on a prebuilt device panel (cold+warm)."""
     import jax
     import jax.numpy as jnp
-
-    from fm_returnprediction_tpu.settings import enable_compilation_cache
-
-    enable_compilation_cache()
 
     from fm_returnprediction_tpu.models.lewellen import MODELS
     from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
     from fm_returnprediction_tpu.parallel import block_bootstrap_se, make_mesh
 
-    t = int(os.environ.get("FMRP_BENCH_MONTHS", 720))
-    n = int(os.environ.get("FMRP_BENCH_FIRMS", 6000))
-    b = int(os.environ.get("FMRP_BENCH_REPLICATES", 10_000))
+    t = int(os.environ.get("FMRP_BENCH_MONTHS", 120 if fast else 720))
+    n = int(os.environ.get("FMRP_BENCH_FIRMS", 500 if fast else 6000))
+    b = int(os.environ.get("FMRP_BENCH_REPLICATES", 200 if fast else 10_000))
     p = 14
 
     y, x, subsets = _make_panel(t, n, p)
     y = jnp.asarray(y)
     x = jnp.asarray(x)
     subsets = [jnp.asarray(s) for s in subsets]
-    n_models = len(MODELS)
     model_sizes = [len(m.predictors) for m in MODELS]  # 3, 7, 14
 
-    n_dev = len(jax.devices())
-    mesh = make_mesh(axis_name="boot") if n_dev > 1 else None
-
+    mesh = make_mesh(axis_name="boot") if len(jax.devices()) > 1 else None
     fm_jit = jax.jit(fama_macbeth, static_argnames=("solver",))
 
     def sweep():
@@ -71,33 +84,178 @@ def main() -> None:
         for k in model_sizes:
             for sub in subsets:
                 cs, summary = fm_jit(y, x[..., :k], sub, solver="normal")
-                results.append((cs, summary))
-        cs3 = results[-1][0]  # Model 3, Large — bootstrap target
+                results.append(summary)
+        cs3, _ = fm_jit(y, x, subsets[-1], solver="normal")
         slope_valid = cs3.month_valid[:, None] & jnp.isfinite(cs3.slopes)
         boot = block_bootstrap_se(
             cs3.slopes, slope_valid, jax.random.key(0), n_replicates=b, mesh=mesh
         )
-        return results, boot
+        # host pull = true execution barrier
+        return np.asarray(boot.se), [np.asarray(s.coef) for s in results]
 
-    # Warm-up: compile everything once (first TPU compile is ~20-40 s and is
-    # not part of the steady-state metric; the reference re-runs its pipeline
-    # on cached data the same way).
-    results, boot = sweep()
-    jax.block_until_ready(boot.se)
+    t0 = time.perf_counter()
+    sweep()
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sweep()
+    warm = time.perf_counter() - t0
+    return {"kernel_fm_boot_cold_s": round(cold, 4),
+            "kernel_fm_boot_warm_s": round(warm, 4),
+            "kernel_shape": f"T{t}_N{n}_B{b}"}
 
-    start = time.perf_counter()
-    results, boot = sweep()
-    jax.block_until_ready([boot.se] + [s.coef for _, s in results])
-    elapsed = time.perf_counter() - start
+
+def _bench_pipeline(fast: bool):
+    """Full pipeline from cached parquet, cold (compiles) and warm.
+
+    Synthetic data generation is NOT in the timed region: it is written to a
+    parquet cache first and the pipeline loads it like the reference loads
+    its WRDS cache (``src/calc_Lewellen_2014.py:1236-1240``) — the
+    north-star workload is "cached raw data → tables", not fixture
+    generation."""
+    import tempfile
+
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        write_synthetic_cache,
+    )
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    t = int(os.environ.get("FMRP_BENCH_PIPE_MONTHS", 120 if fast else 600))
+    n = int(os.environ.get("FMRP_BENCH_PIPE_FIRMS", 100 if fast else 800))
+
+    with tempfile.TemporaryDirectory() as raw_dir:
+        write_synthetic_cache(raw_dir, SyntheticConfig(n_firms=n, n_months=t))
+
+        def once():
+            run_pipeline(
+                raw_data_dir=raw_dir, make_figure=True,
+                make_deciles=True, compile_pdf=False, output_dir=None,
+            )
+
+        t0 = time.perf_counter()
+        once()
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        once()
+        warm = time.perf_counter() - t0
+    return {"pipeline_cold_s": round(cold, 4),
+            "pipeline_warm_s": round(warm, 4),
+            "pipeline_shape": f"T{t}_N{n}"}
+
+
+def _bench_daily_fullscale(fast: bool):
+    """Daily vol+beta at real 1964-2013 CRSP shape via compact ingest."""
+    from fm_returnprediction_tpu.ops.daily_chunked import (
+        daily_characteristics_compact_chunked,
+    )
+
+    d_days = 1024 if fast else 12608
+    n_firms = 2000 if fast else 25000
+    m = 60 if fast else 600
+    rng = np.random.default_rng(0)
+    counts = np.clip(rng.geometric(1 / max(d_days // 4, 1), n_firms), 60, d_days)
+    r = int(counts.sum())
+    starts = rng.integers(0, d_days - counts + 1)
+    offsets = np.zeros(n_firms + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    row_pos = np.empty(r, dtype=np.int16)
+    for f in range(n_firms):
+        row_pos[offsets[f]:offsets[f + 1]] = np.arange(
+            starts[f], starts[f] + counts[f], dtype=np.int16
+        )
+    args = dict(
+        row_values=(rng.standard_normal(r) * 0.02).astype(np.float32),
+        row_pos=row_pos,
+        offsets=offsets,
+        mkt_d=(rng.standard_normal(d_days) * 0.01).astype(np.float32),
+        mkt_present=np.ones(d_days, bool),
+        day_month_id=np.minimum(np.arange(d_days) // 21, m - 1).astype(np.int32),
+        week_id=(np.arange(d_days) // 5).astype(np.int32),
+        week_month_id=None,
+        n_days=d_days,
+        n_weeks=int(d_days // 5) + 1,
+        n_months=m,
+    )
+    args["week_month_id"] = np.minimum(
+        np.arange(args["n_weeks"]) // 4, m - 1
+    ).astype(np.int32)
+
+    t0 = time.perf_counter()
+    daily_characteristics_compact_chunked(**args)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    daily_characteristics_compact_chunked(**args)
+    warm = time.perf_counter() - t0
+    return {
+        "daily_fullscale_cold_s": round(cold, 4),
+        "daily_fullscale_warm_s": round(warm, 4),
+        "daily_fullscale_rows": r,
+        "daily_fullscale_rows_per_s": int(r / warm),
+        "daily_shape": f"D{d_days}_N{n_firms}",
+    }
+
+
+def _bench_pallas(fast: bool):
+    """Fused pallas rolling-moments kernel vs the XLA cumsum path (TPU only)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        return {"rolling_std_pallas_ms": None, "rolling_std_xla_ms": None}
+
+    from fm_returnprediction_tpu.ops.rolling import rolling_std
+
+    d, n = (1024, 512) if fast else (12608, 4096)
+    x = jnp.asarray(
+        (np.random.default_rng(0).standard_normal((d, n)) * 0.02).astype(np.float32)
+    )
+
+    def run(use_pallas):
+        f = jax.jit(lambda v: rolling_std(v, 252, 100, use_pallas=use_pallas))
+        np.asarray(f(x))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = f(x)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / 5 * 1000
+
+    xla_ms = run(False)
+    pallas_ms = run(True)
+    return {
+        "rolling_std_pallas_ms": round(pallas_ms, 3),
+        "rolling_std_xla_ms": round(xla_ms, 3),
+        "rolling_std_pallas_speedup": round(xla_ms / pallas_ms, 2),
+    }
+
+
+def main() -> None:
+    import jax
+
+    from fm_returnprediction_tpu.settings import enable_compilation_cache
+
+    enable_compilation_cache()
+    fast = os.environ.get("FMRP_BENCH_FAST", "0") == "1"
+
+    extra = {
+        "device": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+    }
+    extra.update(_bench_pipeline(fast))
+    extra.update(_bench_kernel(fast))
+    if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
+        extra.update(_bench_daily_fullscale(fast))
+    extra.update(_bench_pallas(fast))
 
     budget = 60.0
+    warm = extra["pipeline_warm_s"]
     print(
         json.dumps(
             {
-                "metric": f"fm_{n_models}models_3subsets_{b}boot_T{t}_N{n}_wall_s",
-                "value": round(elapsed, 4),
+                "metric": f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s",
+                "value": warm,
                 "unit": "s",
-                "vs_baseline": round(budget / elapsed, 2),
+                "vs_baseline": round(budget / warm, 2),
+                "extra": extra,
             }
         )
     )
