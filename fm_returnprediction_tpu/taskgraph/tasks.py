@@ -139,6 +139,23 @@ def _reports(processed_dir: Path, output_dir: Path) -> None:
     )
 
 
+def _parity(raw_dir: Path, output_dir: Path) -> None:
+    """Real-cache Table 1 vs the published Lewellen oracle; records the full
+    diff, then raises on any out-of-tolerance cell."""
+    from fm_returnprediction_tpu.reporting.published import run_parity_check
+
+    output_dir.mkdir(parents=True, exist_ok=True)
+    diff = run_parity_check(raw_dir, strict=False)
+    diff.to_csv(output_dir / "parity_report.csv", index=False)
+    bad = diff[~diff["ok"]]
+    if len(bad):
+        raise AssertionError(
+            f"Table 1 parity failed on {len(bad)} of {len(diff)} cells "
+            f"(see {output_dir / 'parity_report.csv'}):\n"
+            + bad.to_string(index=False)
+        )
+
+
 def _latex(output_dir: Path) -> None:
     from fm_returnprediction_tpu.reporting.latex import (
         compile_latex_document,
@@ -207,7 +224,18 @@ def build_tasks(
             task_dep=["reports"],
             doc="Generate + compile the LaTeX report",
         ),
-    ]
+    ] + (
+        [] if synthetic else [
+            Task(
+                name="parity",
+                actions=[lambda: _parity(raw_dir, output_dir)],
+                file_dep=raw,
+                targets=[output_dir / "parity_report.csv"],
+                task_dep=["pull_data"],
+                doc="Assert Table 1 parity against the published Lewellen oracle",
+            ),
+        ]
+    )
 
 
 def _notebook_paths(notebooks_dir: Path) -> List[Path]:
