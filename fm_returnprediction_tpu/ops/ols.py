@@ -34,8 +34,10 @@ __all__ = [
     "NormalStats",
     "monthly_cs_ols",
     "row_validity",
+    "augment_design",
     "sufficient_stats",
     "solve_from_stats",
+    "gram_pinv",
 ]
 
 _PRECISION = jax.lax.Precision.HIGHEST
@@ -74,13 +76,10 @@ class NormalStats(NamedTuple):
     yy: jnp.ndarray      # (...)       Σy² over valid rows
 
 
-def sufficient_stats(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray) -> NormalStats:
-    """Contract a masked cross-section batch into normal-equation stats.
-
-    Shapes: y (..., N), x (..., N, P), valid (..., N) bool; the intercept
-    column is prepended first, as the reference builds its design at
-    ``src/regressions.py:49``.
-    """
+def augment_design(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray):
+    """Masked design with intercept column: ``(x_aug, y_z, v)`` where invalid
+    rows are exact zeros. The intercept column is prepended first, as the
+    reference builds its design at ``src/regressions.py:49``."""
     v = valid.astype(x.dtype)
     ones = jnp.ones_like(y)
     x_aug = jnp.concatenate(
@@ -88,9 +87,34 @@ def sufficient_stats(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray) -> Norm
     )
     x_aug = x_aug * v[..., None]
     y_z = jnp.where(valid, y, 0.0)
+    return x_aug, y_z, v
+
+
+def sufficient_stats(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray) -> NormalStats:
+    """Contract a masked cross-section batch into normal-equation stats.
+
+    Shapes: y (..., N), x (..., N, P), valid (..., N) bool.
+    """
+    x_aug, y_z, v = augment_design(y, x, valid)
     gram = jnp.einsum("...np,...nq->...pq", x_aug, x_aug, precision=_PRECISION)
     moment = jnp.einsum("...np,...n->...p", x_aug, y_z, precision=_PRECISION)
     return NormalStats(gram, moment, v.sum(-1), y_z.sum(-1), jnp.sum(y_z * y_z, -1))
+
+
+def gram_pinv(stats: NormalStats):
+    """Pseudo-inverse of the (safe) Gram matrices plus the month gate.
+
+    Shared by the one-shot normal solve and the sharded path's iterative
+    refinement (``parallel.fm_sharded``), which reuses the factor as a
+    preconditioner for residual-correction steps."""
+    gram, _, n, _, _ = stats
+    q = gram.shape[-1]
+    month_valid = n >= q
+    eye = jnp.eye(q, dtype=gram.dtype)
+    safe_gram = jnp.where(month_valid[..., None, None], gram, eye)
+    with jax.default_matmul_precision("highest"):
+        pinv = jnp.linalg.pinv(safe_gram)
+    return pinv, month_valid
 
 
 def solve_from_stats(stats: NormalStats):
@@ -105,15 +129,8 @@ def solve_from_stats(stats: NormalStats):
     month_valid (...))`` — ``CSRegressionResult`` leaves with batch dims.
     """
     gram, moment, n, ysum, yy = stats
-    q = gram.shape[-1]
-    month_valid = n >= q
-    eye = jnp.eye(q, dtype=gram.dtype)
-    safe_gram = jnp.where(month_valid[..., None, None], gram, eye)
-    with jax.default_matmul_precision("highest"):
-        beta = jnp.einsum(
-            "...pq,...q->...p", jnp.linalg.pinv(safe_gram), moment,
-            precision=_PRECISION,
-        )
+    pinv, month_valid = gram_pinv(stats)
+    beta = jnp.einsum("...pq,...q->...p", pinv, moment, precision=_PRECISION)
     beta = jnp.where(month_valid[..., None], beta, 0.0)
 
     bg = jnp.einsum("...p,...pq,...q->...", beta, gram, beta, precision=_PRECISION)
@@ -153,11 +170,7 @@ def _solve_month(y, x, valid, solver="lstsq"):
     n = valid.sum()
     p_aug = x.shape[-1] + 1
 
-    v = valid.astype(y.dtype)
-    ones = jnp.ones_like(y)
-    x_aug = jnp.concatenate([ones[:, None], jnp.where(valid[:, None], x, 0.0)], axis=1)
-    x_aug = x_aug * v[:, None]
-    y_z = jnp.where(valid, y, 0.0)
+    x_aug, y_z, v = augment_design(y, x, valid)
 
     month_valid = n >= p_aug
     # default_matmul_precision keeps the lstsq SVD and the residual matmuls
