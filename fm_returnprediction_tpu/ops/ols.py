@@ -104,9 +104,8 @@ def sufficient_stats(y: jnp.ndarray, x: jnp.ndarray, valid: jnp.ndarray) -> Norm
 def gram_pinv(stats: NormalStats):
     """Pseudo-inverse of the (safe) Gram matrices plus the month gate.
 
-    Shared by the one-shot normal solve and the sharded path's iterative
-    refinement (``parallel.fm_sharded``), which reuses the factor as a
-    preconditioner for residual-correction steps."""
+    Shared by the one-shot normal solve and the sharded path's
+    ``n_refine=0`` Gram fast path (``parallel.fm_sharded``)."""
     gram, _, n, _, _ = stats
     q = gram.shape[-1]
     month_valid = n >= q

@@ -11,24 +11,38 @@ sufficient statistics. The tiny ``(P+1)²`` solves, R² reconstruction, and
 Newey-West aggregation then run replicated on every device — they are
 O(T·P²), negligible next to the O(T·N·P²) contraction.
 
-Communication cost per FM run: one psum of ``T·(P+1)² + T·(P+1) + 3T``
-floats — for the full Lewellen panel (T≈600, P=14) that is ~150 KB, i.e.
-the cross-section is embarrassingly parallel exactly as SURVEY §5 predicts.
+Communication cost per FM run: the default TSQR path (below) psums the
+offset-placed R stack, ``T·D·(Q+1)²`` floats (~10 MB at T=600, D=8, Q=15);
+the ``n_refine=0`` Gram fast path psums only the sufficient statistics,
+``T·(Q² + Q + 3)`` floats (~150 KB). Either way the cross-section is
+embarrassingly parallel up to one small collective, as SURVEY §5 predicts.
 
-Numerics note: the distributed path necessarily uses the normal-equation
-route (sufficient statistics are what collectives can sum), which squares
-the design's condition number — and the reference's ``n >= P+1`` gate
-(``src/regressions.py:52``) admits near-singular boundary months where a
-one-shot Gram solve visibly drifts from the SVD parity path. The fallback
-is ITERATIVE REFINEMENT entirely inside SPMD: after the Gram solve, each
-step recomputes residuals from the RAW sharded rows (not from the rounded
-Gram product), psums the correction moment ``Xᵀr``, and re-solves against
-the cached Gram pseudo-inverse. Each step costs one extra O(T·N·P/D)
-contraction + one O(T·P) psum and recovers the accuracy the Gram route
-lost (measured in ``tests/test_parallel.py``: near-singular months that
-drift ~1e-4 one-shot agree with lstsq to ~1e-9 after two steps in f64).
-R² is likewise recomputed from raw residuals rather than reconstructed
-from rounded sufficient statistics.
+Numerics note: a pure normal-equation route (sufficient statistics are the
+obvious thing collectives can sum) squares the design's condition number —
+and the reference's ``n >= P+1`` gate (``src/regressions.py:52``) admits
+(near-)rank-deficient boundary months where NO amount of Gram-side work can
+recover the minimum-norm solution that ``lstsq``/statsmodels-pinv returns
+(residual-correction refinement shrinks the residual but leaves the
+near-null-space component unpinned — measured drift 2.4e+1 in round 2).
+The default path is therefore DISTRIBUTED TSQR: each device computes a
+thin-QR R factor of its local masked ``[X | y]`` block (one batched
+``(T, N/D, Q+1)`` QR), the tiny ``(Q+1)×(Q+1)`` R factors are gathered
+over ICI (as a psum of offset-placed blocks), and the replicated ``lstsq``
+on the stacked R ``G`` solves the ORIGINAL problem exactly:
+``GᵀG = [X|y]ᵀ[X|y]`` implies ``‖G_x β − g_y‖² = ‖Xβ − y‖²`` for every β,
+so the minimum-norm least-squares solution of the compressed system IS the
+global one, and ``cond(G_x) = cond(X)`` — no condition-number squaring.
+When a local block has no more
+rows than ``Q+1`` (the boundary-month regime), the QR step is skipped and
+raw rows are stacked instead — the gathered system is then EXACTLY the
+global one. Measured in ``tests/test_parallel.py``: near-singular months
+that drift ~1e-4..1e+1 under the one-shot Gram route agree with
+single-chip ``lstsq`` to ~1e-15 in the raw-stack regime and ~2e-6 at
+cond 1e6 in the QR-compressed regime (f64) — both far inside the 1e-4
+parity budget. ``n_refine=0`` selects the one-shot Gram fast path (one MXU
+einsum + psum of sufficient statistics) for callers that know their months
+are well-conditioned. R² is always recomputed from raw residuals rather
+than reconstructed from rounded sufficient statistics.
 """
 
 from __future__ import annotations
@@ -59,52 +73,93 @@ __all__ = ["monthly_cs_ols_sharded", "fama_macbeth_sharded"]
 _PRECISION = jax.lax.Precision.HIGHEST
 
 
+def _tsqr_lstsq(x_aug, y_z, axis_name: str, n_shards: int):
+    """Distributed minimum-norm least squares via TSQR compression.
+
+    Per month: QR the local masked ``[X | y]`` block, gather the small R
+    factors, stack to ``G`` with ``GᵀG = [X|y]ᵀ[X|y]``, and solve the
+    compressed system with the SAME ``jnp.linalg.lstsq`` (SVD) the
+    single-chip parity path uses (``ops.ols._solve_month``) — identical
+    objective, no condition-number squaring (module docstring). ``rcond``
+    is passed explicitly as ``eps·(global padded row count)``: lstsq's
+    default scales with the row count of the matrix it is GIVEN, and the
+    compressed stack has ~D·(Q+1) rows where the single-chip design has N —
+    without this, months with cond(X) between the two thresholds would be
+    truncated on one path and solved on the other, blowing the parity
+    budget. The gather is a psum of offset-placed blocks rather than
+    ``all_gather`` so shard_map's replication checker can statically prove
+    the stacked ``G`` (and hence the solution) is replicated.
+    """
+    n_rows_global = n_shards * x_aug.shape[1]
+    rcond = jnp.finfo(x_aug.dtype).eps * max(n_rows_global, x_aug.shape[-1] + 1)
+    m = jnp.concatenate([x_aug, y_z[..., None]], axis=-1)
+    with jax.default_matmul_precision("highest"):
+        if m.shape[1] <= m.shape[2]:
+            # QR of a wide/square block is the same size as the block — no
+            # compression, only rounding. Stack the raw rows instead: the
+            # gathered G is then exactly the global [X | y] (contiguous firm
+            # shards preserve row order), so the solve below is bit-identical
+            # in exact arithmetic to the single-chip lstsq. This is the
+            # boundary-month regime (few rows per shard) where parity
+            # matters most.
+            r_local = m
+        else:
+            r_local = jnp.linalg.qr(m, mode="r")  # (T, Q+1, Q+1)
+        t, k, q1 = r_local.shape
+        stack = jnp.zeros((t, n_shards * k, q1), r_local.dtype)
+        offset = jax.lax.axis_index(axis_name) * k
+        zero = jnp.zeros((), offset.dtype)
+        stack = jax.lax.dynamic_update_slice(stack, r_local, (zero, offset, zero))
+        g = jax.lax.psum(stack, axis_name)
+        beta = jax.vmap(lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond)[0])(
+            g[..., :-1], g[..., -1]
+        )
+    return beta
+
+
 def monthly_cs_ols_sharded(
     y, x, mask, mesh: Mesh, axis_name: str = "firms", n_refine: int = 2
 ) -> CSRegressionResult:
     """Cross-sectional OLS for every month, firm axis sharded over ``mesh``.
 
     Inputs must already be firm-sharded/padded (see ``mesh.shard_panel``).
-    Result leaves are replicated across devices. ``n_refine`` iterative-
-    refinement steps (module docstring) pull near-singular months back to
-    the SVD parity solution; 0 restores the one-shot Gram solve.
+    Result leaves are replicated across devices. ``n_refine >= 1`` (default)
+    selects the distributed TSQR solve with single-chip ``lstsq`` parity on
+    every month including (near-)rank-deficient ones; ``n_refine=0``
+    restores the one-shot Gram solve, which is faster (one MXU einsum) but
+    drifts on ill-conditioned months (module docstring). The parameter name
+    is kept from the retired residual-refinement design for API
+    compatibility; the step count beyond 1 is ignored.
     """
 
     def kernel(y_l, x_l, mask_l):
         valid = row_validity(y_l, x_l, mask_l)
         x_aug, y_z, v = augment_design(y_l, x_l, valid)
-        # Sufficient stats are additive over firm shards (ops.ols docstring),
-        # so the local contraction + one psum == the global contraction.
-        stats = jax.lax.psum(
-            sufficient_stats(y_l, x_l, valid), axis_name
-        )  # one ICI collective
-        pinv, month_valid = gram_pinv(stats)
-        beta = jnp.einsum("tpq,tq->tp", pinv, stats.moment, precision=_PRECISION)
+        if n_refine == 0:
+            # Sufficient stats are additive over firm shards (ops.ols
+            # docstring), so local contraction + one psum == global.
+            stats = jax.lax.psum(sufficient_stats(y_l, x_l, valid), axis_name)
+            n, ysum, yy = stats.n, stats.ysum, stats.yy
+            pinv, month_valid = gram_pinv(stats)
+            beta = jnp.einsum("tpq,tq->tp", pinv, stats.moment, precision=_PRECISION)
+        else:
+            n, ysum, yy = jax.lax.psum(
+                (v.sum(-1), y_z.sum(-1), jnp.sum(y_z * y_z, -1)), axis_name
+            )
+            month_valid = n >= x_aug.shape[-1]
+            beta = _tsqr_lstsq(x_aug, y_z, axis_name, mesh.shape[axis_name])
         beta = jnp.where(month_valid[:, None], beta, 0.0)
 
-        def residual(b):
-            return (
-                y_z - jnp.einsum("tnq,tq->tn", x_aug, b, precision=_PRECISION)
-            ) * v
-
-        for _ in range(n_refine):
-            # Correction moment from RAW rows — the quantity the one-shot
-            # Gram product rounds away; one psum of T·(P+1) floats per step.
-            corr = jax.lax.psum(
-                jnp.einsum("tnq,tn->tq", x_aug, residual(beta), precision=_PRECISION),
-                axis_name,
-            )
-            delta = jnp.einsum("tpq,tq->tp", pinv, corr, precision=_PRECISION)
-            beta = beta + jnp.where(month_valid[:, None], delta, 0.0)
-
-        # R² from raw residuals of the refined solution (centered, as
+        # R² from raw residuals of the solved coefficients (centered, as
         # statsmodels' rsquared) — not the rounded Gram reconstruction.
-        resid = residual(beta)
+        resid = (
+            y_z - jnp.einsum("tnq,tq->tn", x_aug, beta, precision=_PRECISION)
+        ) * v
         sse = jax.lax.psum(jnp.sum(resid * resid, axis=1), axis_name)
-        sst = stats.yy - stats.ysum * stats.ysum / jnp.maximum(stats.n, 1.0)
+        sst = yy - ysum * ysum / jnp.maximum(n, 1.0)
         r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
         r2 = jnp.where(month_valid, r2, 0.0)
-        return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, stats.n, month_valid)
+        return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, n, month_valid)
 
     shard = jax.shard_map(
         kernel,
@@ -161,5 +216,7 @@ def fama_macbeth_sharded(
         mesh = make_mesh(axis_name=axis_name)
     if place:
         y, x, mask = shard_panel(y, x, mask, mesh, axis_name=axis_name)
-    run = _jitted_fm(mesh, nw_lags, min_months, weight, axis_name, n_refine)
+    # Only the 0-vs-nonzero distinction changes the program (TSQR vs Gram),
+    # so normalize to keep the compile cache at two entries per mesh.
+    run = _jitted_fm(mesh, nw_lags, min_months, weight, axis_name, min(n_refine, 1))
     return run(y, x, mask)

@@ -285,6 +285,41 @@ def test_sharded_refinement_recovers_lstsq_on_near_singular_months():
     )
 
 
+def test_sharded_tsqr_compressed_regime_near_singular():
+    """The QR-compression branch (local rows > Q+1, so the raw-stack exact
+    path does NOT apply) on near-singular months: TSQR must stay well inside
+    the 1e-4 parity budget vs single-chip lstsq (measured ~2e-6 at
+    cond 1e6 in f64), while the one-shot Gram route drifts catastrophically."""
+    from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
+    from fm_returnprediction_tpu.parallel.fm_sharded import monthly_cs_ols_sharded
+    from fm_returnprediction_tpu.parallel.mesh import shard_panel
+
+    rng = np.random.default_rng(5)
+    t, n, p, cond = 12, 512, 6, 1e6
+    base = rng.standard_normal((t, n, 1))
+    x = np.repeat(base, p, axis=2) + rng.standard_normal((t, n, p)) / cond
+    beta = rng.standard_normal(p)
+    y = x @ beta + 0.01 * rng.standard_normal((t, n))
+    mask = np.zeros((t, n), dtype=bool)
+    for i in range(t):
+        mask[i, rng.choice(n, size=p + 1, replace=False)] = True
+    y = jnp.asarray(np.where(mask, y, np.nan))
+    x, mask = jnp.asarray(x), jnp.asarray(mask)
+
+    cs_svd = monthly_cs_ols(y, x, mask, solver="lstsq")
+    mesh = make_mesh(axis_name="firms")
+    ys, xs, ms = shard_panel(y, x, mask, mesh)
+    n_local = ys.shape[1] // mesh.shape["firms"]
+    assert n_local > p + 2, "fixture must exercise the QR branch"
+    cs = monthly_cs_ols_sharded(ys, xs, ms, mesh)
+
+    valid = np.asarray(cs_svd.month_valid)
+    want = np.asarray(cs_svd.slopes)[valid]
+    got = np.asarray(cs.slopes)[valid]
+    drift = np.max(np.abs(got - want) / np.maximum(np.abs(want), 1.0))
+    assert drift < 5e-5, f"compressed TSQR drift {drift:.2e}"
+
+
 def test_build_panel_mesh_daily_stage_matches_single_device():
     """get_factors routes the daily stage through the firm-sharded kernels
     when a mesh is passed; vol/beta columns must match the single-device
