@@ -92,10 +92,13 @@ def rolling_std(
 ) -> jnp.ndarray:
     """pandas ``.rolling(window, min_periods).std()`` (ddof=1) on axis 0.
 
-    On TPU this dispatches to the fused pallas moments kernel
-    (``ops.pallas_kernels``): one HBM read of ``x`` instead of the several
-    masked/squared/counted intermediates of the cumsum path — measured 2.5×
-    on a (12608, 4096) f32 daily strip on v5e.
+    On TPU this dispatches to the fully fused pallas kernel
+    (``ops.pallas_kernels.rolling_std_fused``): one HBM read of ``x`` and
+    one write of the finished std, vs the several masked/squared/counted
+    intermediates plus windowed differencing of the XLA cumsum path. (The
+    round-2 three-output version measured 0.95× vs XLA — BENCH_r02 — which
+    is why the kernel now fuses the differencing and finalization too; the
+    current measurement lands in the latest BENCH artifact via bench.py.)
     """
     if use_pallas is None:
         use_pallas = x.ndim == 2 and _pallas_default()
