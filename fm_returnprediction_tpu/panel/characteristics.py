@@ -46,7 +46,7 @@ from fm_returnprediction_tpu.ops.daily_chunked import (
 )
 from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
 from fm_returnprediction_tpu.ops.rolling import rolling_prod, rolling_sum
-from fm_returnprediction_tpu.panel.daily import build_compact_daily, build_daily_panel
+from fm_returnprediction_tpu.panel.daily import build_compact_daily
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
 
 __all__ = ["FACTORS_DICT", "BASE_COLUMNS", "compute_monthly_characteristics", "get_factors"]
@@ -165,6 +165,7 @@ def get_factors(
     dtype=np.float64,
     mesh=None,
     firm_chunk=None,
+    timer=None,
 ) -> Tuple[DensePanel, Dict[str, str]]:
     """Dense-panel equivalent of the reference's ``get_factors``
     (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
@@ -181,65 +182,63 @@ def get_factors(
             "firm_chunk applies only to the single-device compact path; "
             "the mesh path shards the full firm axis (pass one or the other)"
         )
-    df = crsp_comp.copy()
-    df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
-    panel = long_to_dense(df, "jdate", "permno", BASE_COLUMNS, dtype=dtype)
+    from fm_returnprediction_tpu.utils.timing import StageTimer
 
-    var_index = tuple((name, panel.var_index(name)) for name in BASE_COLUMNS)
-    monthly = compute_monthly_characteristics(
-        jnp.asarray(panel.values), jnp.asarray(panel.mask), var_index
-    )
+    timer = timer or StageTimer()
+    with timer.stage("factors/long_to_dense"):
+        df = crsp_comp.copy()
+        df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
+        panel = long_to_dense(df, "jdate", "permno", BASE_COLUMNS, dtype=dtype)
 
-    if mesh is not None:
-        from fm_returnprediction_tpu.parallel.daily_sharded import (
-            daily_characteristics_sharded,
+    with timer.stage("factors/monthly_characteristics"):
+        var_index = tuple((name, panel.var_index(name)) for name in BASE_COLUMNS)
+        monthly = compute_monthly_characteristics(
+            jnp.asarray(panel.values), jnp.asarray(panel.mask), var_index
         )
 
-        daily = build_daily_panel(crsp_d, crsp_index_d, panel.months, dtype=dtype)
-        vol, beta = daily_characteristics_sharded(
-            daily.ret, daily.mask, daily.mkt, daily.day_month_id,
-            daily.week_id, daily.week_month_id, daily.n_months, daily.n_weeks,
-            mesh=mesh, mkt_present=daily.mkt_present,
-        )
-        daily_ids = daily.ids
-        vol_np = np.asarray(vol)[:, : len(daily_ids)]   # drop mesh padding
-        beta_np = np.asarray(beta)[:, : len(daily_ids)]
-    else:
-        # Compacted ingest: never materializes the dense (D, N) daily grid,
-        # on host or device — the full-CRSP single-chip path.
+    # Compacted ingest on BOTH the single-device and mesh paths: the dense
+    # (D, N) daily grid is never materialized on host or device (round-2
+    # VERDICT item 5). With a mesh, each strip's firm axis shards over the
+    # devices inside ``daily_characteristics_compact_chunked``; the dense
+    # mesh kernels remain available as ``parallel.daily_sharded`` for
+    # callers that already hold a (D, N) panel.
+    with timer.stage("factors/daily_ingest"):
         cd = build_compact_daily(crsp_d, crsp_index_d, panel.months, dtype=dtype)
+    with timer.stage("factors/daily_kernels"):
         vol_np, beta_np = daily_characteristics_compact_chunked(
             cd.row_values, cd.row_pos, cd.offsets, cd.mkt, cd.mkt_present,
             cd.day_month_id, cd.week_id, cd.week_month_id,
             cd.n_days, cd.n_weeks, cd.n_months, firm_chunk=firm_chunk,
+            mesh=mesh,
         )
         daily_ids = cd.ids
 
-    # Align daily-firm columns onto the monthly panel's permno vocabulary
-    # (left-merge semantics: monthly firms absent from daily data get NaN).
-    pos = np.searchsorted(daily_ids, panel.ids)
-    pos_c = np.clip(pos, 0, len(daily_ids) - 1)
-    hit = daily_ids[pos_c] == panel.ids          # (N,) daily data exists
-    keep = hit[None, :] & panel.mask             # left-merge: panel rows only
-    vol_m = np.where(keep, vol_np[:, pos_c], np.nan)
-    beta_m = np.where(keep, beta_np[:, pos_c], np.nan)
+    with timer.stage("factors/merge_winsorize"):
+        # Align daily-firm columns onto the monthly panel's permno vocabulary
+        # (left-merge semantics: monthly firms absent from daily data get NaN).
+        pos = np.searchsorted(daily_ids, panel.ids)
+        pos_c = np.clip(pos, 0, len(daily_ids) - 1)
+        hit = daily_ids[pos_c] == panel.ids          # (N,) daily data exists
+        keep = hit[None, :] & panel.mask             # left-merge: panel rows only
+        vol_m = np.where(keep, vol_np[:, pos_c], np.nan)
+        beta_m = np.where(keep, beta_np[:, pos_c], np.nan)
 
-    new_vars = {name: np.asarray(arr) for name, arr in monthly.items()}
-    new_vars["rolling_std_252"] = vol_m
-    new_vars["beta"] = beta_m
-    enriched = panel.with_vars(new_vars)
+        new_vars = {name: np.asarray(arr) for name, arr in monthly.items()}
+        new_vars["rolling_std_252"] = vol_m
+        new_vars["beta"] = beta_m
+        enriched = panel.with_vars(new_vars)
 
-    winsorized = _winsorize_panel(
-        jnp.asarray(enriched.values),
-        jnp.asarray(enriched.mask),
-        tuple(enriched.var_names),
-        tuple(FACTORS_DICT.values()),
-    )
-    final = DensePanel(
-        values=np.asarray(winsorized),
-        mask=enriched.mask,
-        months=enriched.months,
-        ids=enriched.ids,
-        var_names=enriched.var_names,
-    )
+        winsorized = _winsorize_panel(
+            jnp.asarray(enriched.values),
+            jnp.asarray(enriched.mask),
+            tuple(enriched.var_names),
+            tuple(FACTORS_DICT.values()),
+        )
+        final = DensePanel(
+            values=np.asarray(winsorized),
+            mask=enriched.mask,
+            months=enriched.months,
+            ids=enriched.ids,
+            var_names=enriched.var_names,
+        )
     return final, dict(FACTORS_DICT)

@@ -118,6 +118,67 @@ def test_compact_chunked_matches_dense(rng, chunk, bucket):
     np.testing.assert_array_equal(beta, beta0)
 
 
+@pytest.mark.parametrize("chunk", [16, 40])
+def test_compact_chunked_mesh_matches_single_device(rng, chunk):
+    """The mesh path consumes the SAME compact ingest (round-2 VERDICT
+    item 5): sharding each strip's firm axis over the 8-device mesh is a
+    pure execution-schedule choice — outputs are bit-identical to the
+    single-device compact path, and the strip program stays collective-free
+    under SPMD partitioning."""
+    import jax
+
+    from fm_returnprediction_tpu.ops.daily_chunked import (
+        daily_characteristics_compact_chunked,
+    )
+    from fm_returnprediction_tpu.ops.daily_compact import daily_compact_strip
+    from fm_returnprediction_tpu.parallel.mesh import make_mesh
+
+    d = _daily_fixture(rng)
+    csr = _to_csr(d)
+    kw = dict(window=60, min_periods=20, window_weeks=26)
+    vol0, beta0 = daily_characteristics_compact_chunked(
+        **csr, **kw, firm_chunk=chunk, use_pallas=False
+    )
+    mesh = make_mesh(axis_name="firms")
+    vol, beta = daily_characteristics_compact_chunked(
+        **csr, **kw, firm_chunk=chunk, mesh=mesh
+    )
+    np.testing.assert_array_equal(vol, vol0)
+    np.testing.assert_array_equal(beta, beta0)
+
+    # the shard_map'd strip program must contain no collectives
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu.ops.daily_chunked import _mesh_strip_fn
+
+    h, c = 64, 16
+    rect_vals = jax.device_put(
+        jnp.zeros((h, c)), NamedSharding(mesh, P(None, "firms"))
+    )
+    rect_pos = jax.device_put(
+        jnp.full((h, c), csr["n_days"], dtype=np.int32),
+        NamedSharding(mesh, P(None, "firms")),
+    )
+    rep = NamedSharding(mesh, P())
+    mesh_fn = _mesh_strip_fn(
+        mesh, "firms", csr["n_days"], csr["n_weeks"], csr["n_months"],
+        kw["window"], kw["min_periods"], kw["window_weeks"],
+    )
+    hlo = mesh_fn.lower(
+        rect_vals, rect_pos,
+        jax.device_put(jnp.asarray(csr["mkt_d"]), rep),
+        jax.device_put(jnp.asarray(csr["mkt_present"]), rep),
+        jax.device_put(jnp.asarray(csr["day_month_id"]), rep),
+        jax.device_put(jnp.asarray(csr["week_id"]), rep),
+        jax.device_put(jnp.asarray(csr["week_month_id"]), rep),
+    ).compile().as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute", "all-to-all",
+               "reduce-scatter"):
+        assert op not in hlo, f"unexpected collective {op} in compact strip program"
+
+
 def test_build_compact_daily_matches_dense_panel(rng):
     """Host CSR builder agrees with the dense builder on the synthetic
     universe: same ids/day vocabulary, and rows land at the same positions."""
