@@ -35,7 +35,11 @@ class StageTimer:
             )
 
     def total(self) -> float:
-        return sum(self.durations.values())
+        """Sum of TOP-LEVEL stages only. Names containing "/" are nested
+        sub-stages (e.g. ``panel/universe_filter`` inside ``build_panel``)
+        whose time is already counted by their parent — summing them too
+        would double-count the largest stages."""
+        return sum(v for k, v in self.durations.items() if "/" not in k)
 
     def dump(self, path: Path) -> None:
         Path(path).parent.mkdir(parents=True, exist_ok=True)
