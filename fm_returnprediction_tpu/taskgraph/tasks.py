@@ -97,21 +97,36 @@ def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
 
 
 def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
+    import os
+
     import jax
     import numpy as np
 
     from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
+    from fm_returnprediction_tpu.utils.timing import trace
 
     dtype = np.dtype(config("DTYPE"))
     if dtype == np.float64 and not jax.config.jax_enable_x64:
         dtype = np.float32
-    panel, factors_dict = build_panel(load_raw_data(raw_dir), dtype=dtype)
+    # FMRP_TRACE=<dir> wraps the compute tasks in a jax.profiler trace
+    # (SURVEY §5 tracing prescription; round-2 VERDICT item 8).
+    with trace(os.environ.get("FMRP_TRACE")):
+        panel, factors_dict = build_panel(load_raw_data(raw_dir), dtype=dtype)
     panel.save(processed_dir / PANEL_FILE)
     with open(processed_dir / FACTORS_FILE, "w") as f:
         json.dump(factors_dict, f, indent=2)
 
 
 def _reports(processed_dir: Path, output_dir: Path) -> None:
+    import os
+
+    from fm_returnprediction_tpu.utils.timing import trace
+
+    with trace(os.environ.get("FMRP_TRACE")):
+        return _reports_traced(processed_dir, output_dir)
+
+
+def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
     from fm_returnprediction_tpu.panel.dense import DensePanel
     from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
     from fm_returnprediction_tpu.reporting.deciles import (
