@@ -1,0 +1,334 @@
+"""End-to-end reference-formula differential (round-2 VERDICT item 7).
+
+Per-kernel oracles cannot catch COMPOSITION errors — a winsorize/subset
+ordering slip, a complete-case handling difference, a lag applied at the
+wrong layer — because each kernel is verified in isolation. This test
+closes that gap: a plain-pandas transcription of the reference's full
+``get_factors → winsorize → get_subsets → Table 1 → Table 2`` composition
+(``src/calc_Lewellen_2014.py:531-574,44-112,577-868``;
+``src/regressions.py:9-130``) runs on the SAME merged monthly panel and
+daily data the framework consumes, and the final numerics must agree to
+1e-4 (the BASELINE parity bar).
+
+The transcription uses row-wise groupby/rolling pandas semantics — the
+reference's computational model — with no imports from the framework's ops
+layer. The weekly beta comes from the independent calendar oracle
+(``tests/test_beta_calendar_oracle.py``), which shares nothing with the
+kernel either.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.data.wrds_pull import subset_to_common_stock_and_exchanges
+from fm_returnprediction_tpu.models.lewellen import MODELS
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+from fm_returnprediction_tpu.panel.characteristics import FACTORS_DICT, get_factors
+from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+from fm_returnprediction_tpu.panel.transform_compustat import (
+    add_report_date,
+    calc_book_equity,
+    expand_compustat_annual_to_monthly,
+    merge_CRSP_and_Compustat,
+)
+from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
+from fm_returnprediction_tpu.reporting.table1 import build_table_1
+
+from test_beta_calendar_oracle import oracle_weekly_betas
+
+ATOL = 1e-4
+RTOL = 1e-4
+
+
+# --------------------------------------------------------------------------
+# pandas transcription of the reference composition
+# --------------------------------------------------------------------------
+
+def _ref_characteristics(merged: pd.DataFrame) -> pd.DataFrame:
+    """The 12 monthly characteristics with the reference's row-shift
+    groupby semantics (``src/calc_Lewellen_2014.py:137-341``)."""
+    df = merged.sort_values(["permno", "jdate"]).reset_index(drop=True).copy()
+    g = df.groupby("permno", sort=False)
+
+    me_lag = g["me"].shift(1)
+    be_lag = g["be"].shift(1)
+    df["log_size"] = np.log(me_lag)
+    df["log_bm"] = np.log(be_lag) - np.log(me_lag)
+    df["return_12_2"] = (
+        (1.0 + g["retx"].shift(2))
+        .groupby(df["permno"], sort=False)
+        .rolling(11, min_periods=11)
+        .apply(np.prod, raw=True)
+        .reset_index(level=0, drop=True)
+        - 1.0
+    )
+    df["accruals_final"] = df["accruals"] - df["depreciation"]
+    df["roa"] = df["earnings"] / df["assets"]
+    df["log_assets_growth"] = np.log(df["assets"] / g["assets"].shift(12))
+    dvc_12 = (
+        df.groupby("permno", sort=False)["dvc"]
+        .rolling(12, min_periods=1)
+        .sum()
+        .reset_index(level=0, drop=True)
+    )
+    df["dy"] = dvc_12 / g["prc"].shift(1)
+    lr = np.log1p(df["retx"])
+    lr_13 = lr.groupby(df["permno"], sort=False).shift(13)
+    df["log_return_13_36"] = (
+        lr_13.groupby(df["permno"], sort=False)
+        .rolling(24, min_periods=24)
+        .sum()
+        .reset_index(level=0, drop=True)
+    )
+    shr_lag = g["shrout"].shift(1)
+    df["log_issues_12"] = np.log(shr_lag) - np.log(g["shrout"].shift(12))
+    df["log_issues_36"] = np.log(shr_lag) - np.log(g["shrout"].shift(36))
+    df["debt_price"] = df["total_debt"] / me_lag
+    df["sales_price"] = df["sales"] / me_lag
+    return df
+
+
+def _ref_daily(crsp_d: pd.DataFrame, crsp_index_d: pd.DataFrame) -> pd.DataFrame:
+    """Vol-252 (pandas rolling, last obs per month) and the weekly beta
+    (independent calendar oracle) as a (permno, jdate) frame."""
+    d = crsp_d.sort_values(["permno", "dlycaldt"]).copy()
+    vol = (
+        d.groupby("permno", sort=False)["retx"]
+        .rolling(252, min_periods=100)
+        .std()
+        .reset_index(level=0, drop=True)
+        * math.sqrt(252)
+    )
+    d = d.assign(_vol=vol, jdate=d["dlycaldt"] + pd.offsets.MonthEnd(0))
+    last = d.drop_duplicates(["permno", "jdate"], keep="last")
+    vol_frame = last[["permno", "jdate", "_vol"]].rename(columns={"_vol": "rolling_std_252"})
+
+    stock_rows = [
+        (int(p), ts.date(), None if pd.isna(r) else float(r))
+        for p, ts, r in zip(d["permno"], pd.DatetimeIndex(d["dlycaldt"]), d["retx"])
+    ]
+    idx = crsp_index_d.drop_duplicates("caldt", keep="last")
+    index_rows = {
+        ts.date(): (None if pd.isna(v) else float(v))
+        for ts, v in zip(pd.DatetimeIndex(idx["caldt"]), idx["vwretx"])
+    }
+    betas = oracle_weekly_betas(stock_rows, index_rows)
+    rows = [
+        {"permno": p, "_ym": ym, "beta": (np.nan if b is None else b)}
+        for (p, ym), b in betas.items()
+    ]
+    beta_frame = pd.DataFrame(rows)
+    vol_frame = vol_frame.assign(
+        _ym=[(ts.year, ts.month) for ts in pd.DatetimeIndex(vol_frame["jdate"])]
+    )
+    return vol_frame.merge(beta_frame, on=["permno", "_ym"], how="outer")
+
+
+def _ref_winsorize(df: pd.DataFrame, cols) -> pd.DataFrame:
+    """Per-month cross-sectional clip at [1%, 99%], skipping months with
+    fewer than 5 valid observations (``src/calc_Lewellen_2014.py:505-529``)."""
+    df = df.copy()
+    for col in cols:
+        def clip_month(s):
+            x = s.to_numpy(dtype=float)
+            finite = np.isfinite(x)
+            if finite.sum() < 5:
+                return s
+            lo, hi = np.percentile(x[finite], [1.0, 99.0])
+            return pd.Series(np.clip(x, lo, hi), index=s.index)
+
+        df[col] = df.groupby("jdate", sort=False)[col].transform(clip_month)
+    return df
+
+
+def _ref_subsets(df: pd.DataFrame):
+    """NYSE 20th/50th ME percentile universes (``:44-112``)."""
+    nyse = df[df["primaryexch"] == "N"]
+    bp = nyse.groupby("jdate")["me"].quantile([0.2, 0.5]).unstack()
+    bp = bp.reindex(df["jdate"].unique())
+    b20 = df["jdate"].map(bp[0.2])
+    b50 = df["jdate"].map(bp[0.5])
+    return {
+        "All stocks": df,
+        "All-but-tiny stocks": df[df["me"] >= b20],
+        "Large stocks": df[df["me"] >= b50],
+    }
+
+
+def _ref_table1(subsets, variables_dict):
+    """Time-series averages of monthly cross-sectional stats (``:577-670``):
+    ±inf as missing, ddof=1 std (months with ≥2 obs), distinct-permno N."""
+    out = {}
+    for sub_name, sdf in subsets.items():
+        for disp, col in variables_dict.items():
+            x = sdf[col].replace([np.inf, -np.inf], np.nan)
+            by_month = x.groupby(sdf["jdate"])
+            means = by_month.mean()
+            stds = by_month.std(ddof=1)
+            counts = by_month.count()
+            avg = means[counts >= 1].mean()
+            std = stds[counts >= 2].mean()
+            n = sdf.loc[x.notna(), "permno"].nunique()
+            out[(sub_name, disp)] = (avg, std, n)
+    return out
+
+
+def _ref_fm(sdf: pd.DataFrame, pred_cols, nw_lags=4, min_months=10):
+    """Monthly cross-sectional OLS + FM aggregation
+    (``src/regressions.py:9-130``): complete-case dropna, n >= P+1 month
+    gate, centered R², NW weight 1 - k/T."""
+    cols = ["jdate", "permno", "retx"] + list(pred_cols)
+    data = sdf[cols].dropna(subset=["retx"] + list(pred_cols))
+    slopes, r2s, ns = {}, [], []
+    for month, grp in data.groupby("jdate"):
+        n = len(grp)
+        if n < len(pred_cols) + 1:
+            continue
+        y = grp["retx"].to_numpy(dtype=float)
+        x = np.column_stack([np.ones(n)] + [grp[c].to_numpy(dtype=float) for c in pred_cols])
+        beta, *_ = np.linalg.lstsq(x, y, rcond=None)
+        resid = y - x @ beta
+        sst = ((y - y.mean()) ** 2).sum()
+        r2 = 1.0 - (resid @ resid) / sst if sst > 0 else 0.0
+        slopes[month] = beta[1:]
+        r2s.append(r2)
+        ns.append(n)
+    if not slopes:
+        return None
+    slope_df = pd.DataFrame.from_dict(slopes, orient="index", columns=list(pred_cols)).sort_index()
+
+    coefs, tstats = {}, {}
+    for c in pred_cols:
+        s = slope_df[c].dropna().to_numpy(dtype=float)
+        t = len(s)
+        if t < min_months:
+            coefs[c], tstats[c] = np.nan, np.nan
+            continue
+        mu = s.mean()
+        u = s - mu
+        gamma0 = u @ u
+        acc = 0.0
+        for k in range(1, nw_lags + 1):
+            if k < t:
+                acc += max(1.0 - k / t, 0.0) * (u[k:] @ u[:-k])
+        # np.sqrt of a negative NW variance (possible under the 1 - k/T
+        # weights on short series) is NaN, as in the reference — not a crash
+        with np.errstate(invalid="ignore"):
+            se = float(np.sqrt((gamma0 + 2.0 * acc) / t**2))
+        coefs[c] = mu
+        tstats[c] = mu / se if se > 0 else np.nan
+    return {
+        "coef": coefs,
+        "tstat": tstats,
+        "mean_r2": float(np.mean(r2s)),
+        "mean_n": float(np.mean(ns)),
+    }
+
+
+# --------------------------------------------------------------------------
+# the differential
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def universe():
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=40, n_months=60))
+    crsp_m = subset_to_common_stock_and_exchanges(data["crsp_m"])
+    crsp_d = subset_to_common_stock_and_exchanges(data["crsp_d"])
+    crsp = calculate_market_equity(crsp_m)
+    comp = add_report_date(data["comp"].copy())
+    comp = calc_book_equity(comp)
+    comp = expand_compustat_annual_to_monthly(comp)
+    merged = merge_CRSP_and_Compustat(crsp, comp, data["ccm"])
+    if "mthcaldt" not in merged.columns:
+        merged["mthcaldt"] = merged["jdate"]
+    return merged, crsp_d, data["crsp_index_d"]
+
+
+@pytest.fixture(scope="module")
+def framework_side(universe):
+    merged, crsp_d, index_d = universe
+    panel, factors_dict = get_factors(merged, crsp_d, index_d, dtype=np.float64)
+    masks = compute_subset_masks(panel)
+    return panel, factors_dict, masks
+
+
+@pytest.fixture(scope="module")
+def reference_side(universe):
+    merged, crsp_d, index_d = universe
+    df = _ref_characteristics(merged)
+    daily = _ref_daily(crsp_d, index_d)
+    df["_ym"] = [(ts.year, ts.month) for ts in pd.DatetimeIndex(df["jdate"])]
+    df = df.merge(
+        daily[["permno", "_ym", "rolling_std_252"]], on=["permno", "_ym"], how="left"
+    ).merge(
+        daily[["permno", "_ym", "beta"]].dropna(subset=["beta"]),
+        on=["permno", "_ym"], how="left",
+    )
+    df = _ref_winsorize(df, list(FACTORS_DICT.values()))
+    return df
+
+
+def test_table1_matches_reference_transcription(framework_side, reference_side):
+    panel, factors_dict, masks = framework_side
+    table = build_table_1(panel, masks, factors_dict)
+    want = _ref_table1(_ref_subsets(reference_side), factors_dict)
+
+    checked = 0
+    for (sub, disp), (avg, std, n) in want.items():
+        got_avg = table.loc[disp, (sub, "Avg")]
+        got_std = table.loc[disp, (sub, "Std")]
+        got_n = table.loc[disp, (sub, "N")]
+        np.testing.assert_allclose(got_avg, avg, rtol=RTOL, atol=ATOL,
+                                   err_msg=f"Avg {sub}/{disp}")
+        np.testing.assert_allclose(got_std, std, rtol=RTOL, atol=ATOL,
+                                   err_msg=f"Std {sub}/{disp}")
+        assert int(got_n) == int(n), f"N {sub}/{disp}: {got_n} vs {n}"
+        checked += 1
+    assert checked == len(factors_dict) * 3
+
+
+def test_table2_fm_matches_reference_transcription(framework_side, reference_side):
+    panel, factors_dict, masks = framework_side
+    subsets = _ref_subsets(reference_side)
+
+    y = jnp.asarray(panel.var("retx"))
+    checked = 0
+    for model in MODELS:
+        pred_cols = [factors_dict[d] for d in model.predictors]
+        x = jnp.asarray(panel.select(pred_cols))
+        for sub_name, mask in masks.items():
+            cs, summary = fama_macbeth(y, x, jnp.asarray(mask))
+            want = _ref_fm(subsets[sub_name], pred_cols)
+            if want is None:
+                assert not bool(np.asarray(cs.month_valid).any())
+                continue
+            for i, c in enumerate(pred_cols):
+                got = float(np.asarray(summary.coef)[i])
+                wc = want["coef"][c]
+                if np.isnan(wc):
+                    assert np.isnan(got), f"{model.name}/{sub_name}/{c}"
+                else:
+                    np.testing.assert_allclose(
+                        got, wc, rtol=RTOL, atol=ATOL,
+                        err_msg=f"coef {model.name}/{sub_name}/{c}",
+                    )
+                    np.testing.assert_allclose(
+                        float(np.asarray(summary.tstat)[i]), want["tstat"][c],
+                        rtol=1e-3, atol=1e-3,
+                        err_msg=f"tstat {model.name}/{sub_name}/{c}",
+                    )
+            np.testing.assert_allclose(
+                float(np.asarray(summary.mean_r2)), want["mean_r2"],
+                rtol=RTOL, atol=ATOL, err_msg=f"R2 {model.name}/{sub_name}",
+            )
+            np.testing.assert_allclose(
+                float(np.asarray(summary.mean_n)), want["mean_n"],
+                rtol=RTOL, atol=ATOL, err_msg=f"N {model.name}/{sub_name}",
+            )
+            checked += 1
+    assert checked >= 6, f"only {checked} model x subset cells compared"
