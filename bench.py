@@ -25,10 +25,14 @@ over-claim without (round-1 VERDICT "What's weak" #1-2):
   kernel vs the XLA cumsum path on a (12608, 4096) strip, recording the
   speedup claimed at ``ops/rolling.py`` (TPU only; null on CPU).
 
-All timings synchronize by pulling a result to the host (``np.asarray``),
-not ``block_until_ready`` alone — on the tunneled axon backend the latter
-has been observed to return before execution completes, which is exactly
-the over-claim this bench exists to avoid.
+All timings synchronize by pulling a result to the host (``np.asarray``
+or a scalar device-side reduction), not ``block_until_ready`` alone — on
+the tunneled axon backend the latter has been observed to return before
+execution completes, which is exactly the over-claim this bench exists to
+avoid. (History: BENCH_r01's 3.1 ms "kernel" figure for the same
+T720_N6000_B10000 sweep was a dispatch-only measurement artifact — no
+execution barrier — superseded by the honest sync here; the ~600x gap
+between r01 and r02 kernel numbers is that artifact, not a regression.)
 
 Prints ONE JSON line. Env knobs: FMRP_BENCH_FAST=1 shrinks every shape for
 CPU smoke runs; FMRP_BENCH_MONTHS/_FIRMS/_REPLICATES (kernel),
