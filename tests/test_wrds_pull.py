@@ -35,6 +35,34 @@ def test_universe_filter(wrds):
     assert len(out) < len(wrds["crsp_m"])
 
 
+def test_universe_filter_categorical_and_columns(wrds):
+    """The categorical fast path (code comparisons) selects the same rows
+    as the string path, including a category value absent from the flag's
+    dictionary, and ``columns=`` prunes the result."""
+    from fm_returnprediction_tpu.data.wrds_pull import FLAG_COLUMNS
+
+    base = wrds["crsp_m"]
+    want = subset_to_common_stock_and_exchanges(base)
+    cat = base.copy()
+    for c in FLAG_COLUMNS:
+        cat[c] = cat[c].astype("category")
+    got = subset_to_common_stock_and_exchanges(cat)
+    assert len(got) == len(want)
+    assert (got["permno"].to_numpy() == want["permno"].to_numpy()).all()
+
+    pruned = subset_to_common_stock_and_exchanges(
+        cat, columns=["permno", "mthcaldt", "retx"]
+    )
+    assert list(pruned.columns) == ["permno", "mthcaldt", "retx"]
+    assert (pruned["permno"].to_numpy() == want["permno"].to_numpy()).all()
+
+    # a wanted value missing from the category dictionary must not crash
+    # (e.g. a universe with no ACOR issuers): drop ACOR from the dictionary
+    assert "ACOR" not in cat["issuertype"].cat.categories
+    got2 = subset_to_common_stock_and_exchanges(cat)
+    assert len(got2) == len(want)
+
+
 def test_crsp_sql_monthly_vs_daily():
     monthly = build_crsp_stock_sql("M", "1964-01-01", "2013-12-31")
     daily = build_crsp_stock_sql("D", "1964-01-01", "2013-12-31")
