@@ -134,7 +134,12 @@ def build_compact_daily(
     # those the slow way first.
     date_raw = crsp_d["dlycaldt"].to_numpy()
     if date_raw.dtype.kind != "M":
-        date_raw = np.asarray(pd.DatetimeIndex(crsp_d["dlycaldt"]))
+        # tz-aware columns stay object through a bare DatetimeIndex round
+        # trip — force a concrete naive unit (UTC instants), as the old
+        # pandas path did
+        date_raw = np.asarray(
+            pd.DatetimeIndex(crsp_d["dlycaldt"]), dtype="datetime64[s]"
+        )
     date_i8 = date_raw.view(np.int64)
     retx = crsp_d["retx"].to_numpy(dtype=dtype)
 

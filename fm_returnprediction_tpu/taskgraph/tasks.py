@@ -307,7 +307,23 @@ def build_notebook_tasks(
             if page.exists():
                 shutil.copy2(page, docs_dir / page.name)
 
-    return [
+    def _build_site() -> None:
+        from fm_returnprediction_tpu.taskgraph.docs_site import build_docs_site
+
+        base = Path(config("BASE_DIR"))
+        build_docs_site(base, base / "docs" / "site")
+
+    base_dir = Path(config("BASE_DIR"))
+    site_sources = [p for p in [base_dir / "README.md"] if p.is_file()]
+    site_sources += sorted((base_dir / "docs").glob("*.md"))
+    try:
+        import markdown  # noqa: F401
+
+        have_markdown = True
+    except ImportError:  # pragma: no cover - environment-dependent
+        have_markdown = False
+
+    tasks = [
         Task(
             name="convert_notebooks",
             actions=convert_cmds,
@@ -327,3 +343,18 @@ def build_notebook_tasks(
             doc="Execute driver notebooks, render HTML into docs",
         ),
     ]
+    if have_markdown:  # skip the site task where the renderer is absent
+        tasks.append(
+            Task(
+                name="docs_site",
+                actions=[_build_site],
+                # depend on the rendered SOURCES too, not just the notebook
+                # HTML — an edited README must rebuild the site
+                file_dep=html + site_sources,
+                targets=[base_dir / "docs" / "site" / "index.html"],
+                task_dep=["run_notebooks"],
+                doc="Render markdown docs + notebook HTML into a static site "
+                    "(reference docs_src/conf.py equivalent)",
+            )
+        )
+    return tasks

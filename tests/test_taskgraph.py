@@ -235,3 +235,31 @@ def test_failure_preserves_last_success_timing(tmp_path):
         assert not r.run()
         assert r.timings()["t"] == first          # success timing survives
         assert not r.is_up_to_date(task)          # but task is stale
+
+
+def test_build_docs_site(tmp_path):
+    """Static-site builder renders markdown pages + notebook HTML with nav
+    links and the GitHub Pages marker (reference docs_src equivalent)."""
+    pytest.importorskip("markdown")
+    from fm_returnprediction_tpu.taskgraph.docs_site import build_docs_site
+
+    base = tmp_path
+    (base / "README.md").write_text("# Title\n\nSome `code` and a table:\n\n"
+                                    "| a | b |\n|---|---|\n| 1 | 2 |\n")
+    (base / "docs").mkdir()
+    (base / "docs" / "architecture.md").write_text("## Arch\n\ntext\n")
+    nb = base / "docs" / "notebooks"
+    nb.mkdir()
+    (nb / "driver.html").write_text("<html><body>nb</body></html>")
+
+    site = base / "docs" / "site"
+    written = build_docs_site(base, site)
+
+    index = (site / "index.html").read_text()
+    assert "<table>" in index and "<code>code</code>" in index
+    assert 'href="architecture.html"' in index
+    assert 'href="notebooks/driver.html"' in index
+    assert (site / "architecture.html").is_file()
+    assert (site / "notebooks" / "driver.html").read_text().endswith("</html>")
+    assert (site / ".nojekyll").is_file()
+    assert all(p.exists() for p in written)
