@@ -1,0 +1,58 @@
+"""Bench-scale generator: schema parity with the hermetic synthetic backend
+and pipeline runnability — the bench must exercise the same code paths the
+tests verify, or its numbers describe a different program."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.benchscale import (
+    generate_benchscale_wrds,
+    write_benchscale_cache,
+)
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+
+
+@pytest.fixture(scope="module")
+def both():
+    bench = generate_benchscale_wrds(n_permnos=120, n_months=48)
+    synth = generate_synthetic_wrds(SyntheticConfig(n_firms=30, n_months=24))
+    return bench, synth
+
+
+def test_benchscale_schema_covers_synthetic(both):
+    """Every column the hermetic generator emits (and therefore every column
+    the pipeline may touch) exists in the bench-scale frames with a
+    compatible kind — except jdate, which the pipeline derives when absent."""
+    bench, synth = both
+    derivable = {"crsp_m": set(), "crsp_d": set(), "comp": set(), "ccm": set(),
+                 "crsp_index_d": set()}
+    for key in synth:
+        missing = set(synth[key].columns) - set(bench[key].columns) - derivable[key]
+        assert not missing, f"{key} missing columns: {missing}"
+
+
+def test_benchscale_pipeline_runs_and_recovers_beta(tmp_path):
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    write_benchscale_cache(tmp_path, n_permnos=100, n_months=48)
+    res = run_pipeline(raw_data_dir=tmp_path, make_figure=False,
+                       make_deciles=False, compile_pdf=False, output_dir=None)
+    beta = res.panel.var("beta")
+    finite = np.isfinite(beta)
+    assert finite.sum() > 200
+    # betas were drawn U(0.3, 1.8); the factor loadings must be recoverable
+    assert 0.6 < float(np.nanmean(beta)) < 1.5
+    assert isinstance(res.table_2, pd.DataFrame) and len(res.table_2) > 0
+
+
+def test_benchscale_cache_reuse(tmp_path):
+    p1 = write_benchscale_cache(tmp_path, n_permnos=40, n_months=30)
+    marker = (tmp_path / "benchscale.json").read_text()
+    mtime = (tmp_path / "CRSP_stock_d.parquet").stat().st_mtime_ns
+    p2 = write_benchscale_cache(tmp_path, n_permnos=40, n_months=30)
+    assert p1 == p2
+    assert (tmp_path / "CRSP_stock_d.parquet").stat().st_mtime_ns == mtime
+    # changed params regenerate
+    write_benchscale_cache(tmp_path, n_permnos=41, n_months=30)
+    assert (tmp_path / "benchscale.json").read_text() != marker
