@@ -191,6 +191,10 @@ def run_pipeline(
 
     with timer.stage("build_panel"):
         panel, factors_dict = build_panel(data, dtype=dtype, mesh=mesh, timer=timer)
+    # The raw frames (the 77M-row daily table in particular) are dead after
+    # the panel exists; releasing them cuts several GB of allocator pressure
+    # before the reporting stages' large temporaries.
+    del data
 
     with timer.stage("subset_masks"):
         subset_masks = compute_subset_masks(panel)
