@@ -168,6 +168,9 @@ def _bench_pipeline_real(fast: bool):
 
     t = int(os.environ.get("FMRP_BENCH_REAL_MONTHS", 600))
     n = int(os.environ.get("FMRP_BENCH_REAL_FIRMS", 22000))
+    # parse BEFORE the expensive runs: a malformed value must fail fast,
+    # not throw away a completed full-scale cold measurement
+    budget = float(os.environ.get("FMRP_BENCH_REAL_BUDGET_S", 1500))
     raw_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "_cache", f"benchscale_T{t}_N{n}"
     )
@@ -175,15 +178,23 @@ def _bench_pipeline_real(fast: bool):
     write_benchscale_cache(raw_dir, n_permnos=n, n_months=t)
     gen = time.perf_counter() - t0
 
-    cold, _ = _run_pipeline_timed(raw_dir)
-    warm, stages = _run_pipeline_timed(raw_dir)
-    return {
+    cold, cold_stages = _run_pipeline_timed(raw_dir)
+    out = {
         "real_pipeline_cold_s": round(cold, 4),
-        "real_pipeline_warm_s": round(warm, 4),
-        "real_pipeline_stage_s": stages,
         "real_pipeline_gen_s": round(gen, 2),
         "real_pipeline_shape": f"T{t}_N{n}",
     }
+    # Soft budget: on a slow interconnect a second full-scale run can blow
+    # the driver's bench window — better a recorded cold number + breakdown
+    # than a timeout that loses the whole artifact.
+    if cold <= budget:
+        warm, stages = _run_pipeline_timed(raw_dir)
+        out["real_pipeline_warm_s"] = round(warm, 4)
+        out["real_pipeline_stage_s"] = stages
+    else:
+        out["real_pipeline_stage_s"] = cold_stages
+        out["real_pipeline_warm_skipped"] = f"cold {cold:.0f}s > budget {budget:.0f}s"
+    return out
 
 
 def _bench_daily_fullscale(fast: bool):
@@ -291,23 +302,36 @@ def main() -> None:
         "device": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
     }
+    sections = [_bench_pipeline, _bench_pipeline_real, _bench_kernel]
+    if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
+        sections.append(_bench_daily_fullscale)
+    sections.append(_bench_pallas)
+
     # FMRP_TRACE=<dir> wraps the whole bench in a jax.profiler trace
     # (round-2 VERDICT item 8) — open with TensorBoard/xprof.
     with trace(os.environ.get("FMRP_TRACE")):
-        extra.update(_bench_pipeline(fast))
-        extra.update(_bench_pipeline_real(fast))
-        extra.update(_bench_kernel(fast))
-        if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
-            extra.update(_bench_daily_fullscale(fast))
-        extra.update(_bench_pallas(fast))
+        for section in sections:
+            # fault isolation: one section failing must not lose the whole
+            # JSON artifact (the driver records exactly one line)
+            try:
+                extra.update(section(fast))
+            except Exception as exc:  # noqa: BLE001 - recorded, not hidden
+                extra[f"{section.__name__}_error"] = repr(exc)[:300]
 
     budget = 60.0
     if "real_pipeline_warm_s" in extra:
         warm = extra["real_pipeline_warm_s"]
         metric = f"e2e_pipeline_{extra['real_pipeline_shape']}_warm_wall_s"
-    else:
+    elif "real_pipeline_cold_s" in extra:
+        warm = extra["real_pipeline_cold_s"]
+        metric = f"e2e_pipeline_{extra['real_pipeline_shape']}_cold_wall_s"
+    elif "pipeline_warm_s" in extra:
         warm = extra["pipeline_warm_s"]
         metric = f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s"
+    else:  # every pipeline section errored — still emit a parseable line
+        print(json.dumps({"metric": "bench_failed", "value": -1.0,
+                          "unit": "s", "vs_baseline": 0.0, "extra": extra}))
+        return
     print(
         json.dumps(
             {

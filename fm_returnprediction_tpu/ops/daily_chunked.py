@@ -298,6 +298,22 @@ def daily_characteristics_compact_chunked(
 
     vol_out = np.empty((n_months, n_firms), dtype=dtype)
     beta_out = np.empty((n_months, n_firms), dtype=dtype)
+    # Pipelined schedule: dispatch ahead of the pulls (jax dispatch is
+    # async, so strip i+1's host assembly and host→device transfer overlap
+    # strip i's device compute) but keep at most ``max_inflight`` strips
+    # un-pulled — the pull is the execution barrier that bounds how many
+    # strips' input buffers are live on the device at once. Pulling inside
+    # the loop with no lookahead would serialize transfer and compute;
+    # never pulling until the end would let queued strips pin the whole
+    # compact volume in device memory.
+    max_inflight = 2
+    pending = []
+
+    def drain_one():
+        firms_d, vol_d, beta_d = pending.pop(0)
+        vol_out[:, firms_d] = np.asarray(vol_d)[:, : len(firms_d)]
+        beta_out[:, firms_d] = np.asarray(beta_d)[:, : len(firms_d)]
+
     for start in range(0, n_firms, c):
         firms = order[start : start + c]
         h = bucket(int(counts[firms].max(initial=1)))
@@ -320,6 +336,9 @@ def daily_characteristics_compact_chunked(
                 window=window, min_periods=min_periods,
                 window_weeks=window_weeks, use_pallas=use_pallas,
             )
-        vol_out[:, firms] = np.asarray(vol_s)[:, : len(firms)]
-        beta_out[:, firms] = np.asarray(beta_s)[:, : len(firms)]
+        pending.append((firms, vol_s, beta_s))
+        if len(pending) > max_inflight:
+            drain_one()
+    while pending:
+        drain_one()
     return vol_out, beta_out
