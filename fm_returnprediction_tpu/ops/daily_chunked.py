@@ -337,7 +337,7 @@ def daily_characteristics_compact_chunked(
                 window_weeks=window_weeks, use_pallas=use_pallas,
             )
         pending.append((firms, vol_s, beta_s))
-        if len(pending) > max_inflight:
+        if len(pending) >= max_inflight:
             drain_one()
     while pending:
         drain_one()

@@ -30,6 +30,16 @@ from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
 __all__ = ["build_table_2", "run_model_fm"]
 
 
+def _model_columns(model: ModelSpec, variables_dict: Dict[str, str]) -> list:
+    """Panel column names for a model's predictors, validated."""
+    xvars = []
+    for label in model.predictors:
+        if label not in variables_dict:
+            raise ValueError(f"'{label}' not found in variables_dict!")
+        xvars.append(variables_dict[label])
+    return xvars
+
+
 def run_model_fm(
     panel: DensePanel,
     subset_mask: jnp.ndarray,
@@ -39,19 +49,21 @@ def run_model_fm(
     nw_lags: int = 4,
     solver: str = "lstsq",
     mesh=None,
+    y: Optional[jnp.ndarray] = None,
+    x: Optional[jnp.ndarray] = None,
 ):
     """One (model, subset) Fama-MacBeth run on the dense panel.
 
-    With ``mesh`` the firm axis shards across devices (Gram-psum path,
+    With ``mesh`` the firm axis shards across devices (TSQR path,
     ``parallel.fm_sharded``); otherwise the single-device batched solver
-    runs with the requested ``solver``."""
-    xvars = []
-    for label in model.predictors:
-        if label not in variables_dict:
-            raise ValueError(f"'{label}' not found in variables_dict!")
-        xvars.append(variables_dict[label])
-    y = jnp.asarray(panel.var(return_col))
-    x = jnp.asarray(panel.select(xvars))
+    runs with the requested ``solver``. ``y``/``x`` accept device-resident
+    precomputed tensors so sweep callers (``build_table_2``) can push the
+    predictor union once and slice per model on device — THIS function
+    stays the single code path for the actual FM call either way."""
+    if y is None:
+        y = jnp.asarray(panel.var(return_col))
+    if x is None:
+        x = jnp.asarray(panel.select(_model_columns(model, variables_dict)))
     mask = jnp.asarray(subset_mask)
     if mesh is not None:
         from fm_returnprediction_tpu.parallel import fama_macbeth_sharded
@@ -70,10 +82,27 @@ def build_table_2(
     """Assemble the formatted reference-layout Table 2. ``mesh`` runs every
     (model, subset) FM with the firm axis sharded across devices."""
     models = models if models is not None else MODELS
+
+    # Push the predictor union and the regressand to the device ONCE and
+    # slice per model on device: the model sets overlap heavily, and at real
+    # shape re-pushing (T, N, P) per (model, subset) moved ~9x the bytes.
+    needed = []
+    for model in models:
+        for col in _model_columns(model, variables_dict):
+            if col not in needed:
+                needed.append(col)
+    y = jnp.asarray(panel.var("retx"))
+    x_all = jnp.asarray(panel.select(needed))
+    col_idx = {c: i for i, c in enumerate(needed)}
+
     rows = []
     for model in models:
+        idx = [col_idx[c] for c in _model_columns(model, variables_dict)]
+        x = x_all[:, :, jnp.asarray(idx)]
         for subset_name, mask in subset_masks.items():
-            _, fm = run_model_fm(panel, mask, model, variables_dict, mesh=mesh)
+            _, fm = run_model_fm(
+                panel, mask, model, variables_dict, mesh=mesh, y=y, x=x
+            )
             coef = np.asarray(fm.coef)
             tstat = np.asarray(fm.tstat)
             mean_r2 = float(fm.mean_r2)
