@@ -32,7 +32,11 @@ class DensePanel:
 
     Attributes
     ----------
-    values : (T, N, K) float array, NaN where absent/missing.
+    values : (T, N, K) float array, NaN where absent/missing. May be numpy
+             (fresh from ``long_to_dense`` / ``load``) or a DEVICE-resident
+             jax array (the enriched pipeline panel) — consumers slice it
+             and wrap with ``jnp.asarray``/``np.asarray`` as needed, which
+             is a no-op on the matching kind.
     mask   : (T, N) bool, True where the firm-month row exists in the source.
     months : (T,) datetime64[ns], sorted unique observation dates.
     ids    : (N,) array of firm identifiers (permno order = column order).

@@ -78,6 +78,7 @@ def build_table_2(
     variables_dict: Dict[str, str],
     models: Optional[list] = None,
     mesh=None,
+    return_col: str = "retx",
 ) -> pd.DataFrame:
     """Assemble the formatted reference-layout Table 2. ``mesh`` runs every
     (model, subset) FM with the firm axis sharded across devices."""
@@ -91,7 +92,7 @@ def build_table_2(
         for col in _model_columns(model, variables_dict):
             if col not in needed:
                 needed.append(col)
-    y = jnp.asarray(panel.var("retx"))
+    y = jnp.asarray(panel.var(return_col))
     x_all = jnp.asarray(panel.select(needed))
     col_idx = {c: i for i, c in enumerate(needed)}
 
@@ -101,7 +102,8 @@ def build_table_2(
         x = x_all[:, :, jnp.asarray(idx)]
         for subset_name, mask in subset_masks.items():
             _, fm = run_model_fm(
-                panel, mask, model, variables_dict, mesh=mesh, y=y, x=x
+                panel, mask, model, variables_dict,
+                return_col=return_col, mesh=mesh, y=y, x=x,
             )
             coef = np.asarray(fm.coef)
             tstat = np.asarray(fm.tstat)
