@@ -203,6 +203,8 @@ def _bench_daily_fullscale(fast: bool):
         daily_characteristics_compact_chunked,
     )
 
+    from fm_returnprediction_tpu.data.benchscale import flat_ranges
+
     d_days = 1024 if fast else 12608
     n_firms = 2000 if fast else 25000
     m = 60 if fast else 600
@@ -212,11 +214,7 @@ def _bench_daily_fullscale(fast: bool):
     starts = rng.integers(0, d_days - counts + 1)
     offsets = np.zeros(n_firms + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
-    row_pos = np.empty(r, dtype=np.int16)
-    for f in range(n_firms):
-        row_pos[offsets[f]:offsets[f + 1]] = np.arange(
-            starts[f], starts[f] + counts[f], dtype=np.int16
-        )
+    row_pos = flat_ranges(starts, counts)[0].astype(np.int16)
     args = dict(
         row_values=(rng.standard_normal(r) * 0.02).astype(np.float32),
         row_pos=row_pos,

@@ -29,20 +29,16 @@ import numpy as np
 import pandas as pd
 from pandas.tseries.offsets import MonthEnd
 
-__all__ = ["generate_benchscale_wrds", "write_benchscale_cache"]
+from fm_returnprediction_tpu.data.synthetic import FILE_NAMES as _FILE_NAMES
 
-_FILE_NAMES = {
-    "crsp_m": "CRSP_stock_m.parquet",
-    "crsp_d": "CRSP_stock_d.parquet",
-    "crsp_index_d": "CRSP_index_d.parquet",
-    "comp": "Compustat_fund.parquet",
-    "ccm": "CRSP_Comp_Link_Table.parquet",
-}
+__all__ = ["flat_ranges", "generate_benchscale_wrds", "write_benchscale_cache"]
 
 
-def _flat_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+def flat_ranges(starts: np.ndarray, counts: np.ndarray) -> tuple:
     """Concatenated [starts[i], starts[i]+counts[i]) ranges without a Python
-    loop: global arange minus each row's group offset."""
+    loop: global arange minus each row's group offset. Returns
+    ``(positions, within)`` — the flattened range values and each element's
+    offset within its own group."""
     offsets = np.zeros(len(counts) + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     within = np.arange(offsets[-1], dtype=np.int64) - np.repeat(offsets[:-1], counts)
@@ -116,7 +112,7 @@ def generate_benchscale_wrds(
     d0 = month_day_lo[m0]
     d1 = month_day_hi[m1]
     d_counts = (d1 - d0).astype(np.int64)
-    day_idx, _ = _flat_ranges(d0, d_counts)
+    day_idx, _ = flat_ranges(d0, d_counts)
     r_daily = len(day_idx)
 
     ret = np.repeat(betas, d_counts) * mkt[day_idx]
@@ -145,7 +141,7 @@ def generate_benchscale_wrds(
 
     # --- monthly ----------------------------------------------------------
     m_counts = (m1 - m0 + 1).astype(np.int64)
-    month_idx, within_m = _flat_ranges(m0, m_counts)
+    month_idx, within_m = flat_ranges(m0, m_counts)
     r_m = len(month_idx)
     mretx = rng.normal(0.008, 0.07, r_m)
     shrout_m = np.repeat(base_shr, m_counts) * np.exp(
@@ -189,7 +185,7 @@ def generate_benchscale_wrds(
     y0 = months.year.values[m0] - 1
     y1 = months.year.values[m1]
     y_counts = (y1 - y0 + 1).astype(np.int64)
-    year_flat, _ = _flat_ranges(y0, y_counts)
+    year_flat, _ = flat_ranges(y0, y_counts)
     r_y = len(year_flat)
     assets = np.repeat(rng.uniform(50, 5000, n_permnos), y_counts) * np.exp(
         rng.normal(0.08, 0.15, r_y)

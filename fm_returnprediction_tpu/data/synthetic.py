@@ -25,7 +25,18 @@ import numpy as np
 import pandas as pd
 from pandas.tseries.offsets import MonthEnd
 
-__all__ = ["SyntheticConfig", "generate_synthetic_wrds", "write_synthetic_cache"]
+__all__ = ["FILE_NAMES", "SyntheticConfig", "generate_synthetic_wrds", "write_synthetic_cache"]
+
+# Canonical cache file names (reference ``src/calc_Lewellen_2014.py:1236-1240``)
+# — the single definition shared by the pipeline loader and both synthetic
+# backends.
+FILE_NAMES = {
+    "crsp_m": "CRSP_stock_m.parquet",
+    "crsp_d": "CRSP_stock_d.parquet",
+    "crsp_index_d": "CRSP_index_d.parquet",
+    "comp": "Compustat_fund.parquet",
+    "ccm": "CRSP_Comp_Link_Table.parquet",
+}
 
 
 class SyntheticConfig:
@@ -245,15 +256,8 @@ def write_synthetic_cache(
     data = generate_synthetic_wrds(cfg)
     raw_data_dir = Path(raw_data_dir)
     raw_data_dir.mkdir(parents=True, exist_ok=True)
-    names = {
-        "crsp_m": "CRSP_stock_m.parquet",
-        "crsp_d": "CRSP_stock_d.parquet",
-        "crsp_index_d": "CRSP_index_d.parquet",
-        "comp": "Compustat_fund.parquet",
-        "ccm": "CRSP_Comp_Link_Table.parquet",
-    }
     paths = {}
-    for key, name in names.items():
+    for key, name in FILE_NAMES.items():
         path = raw_data_dir / name
         data[key].to_parquet(path, index=False)
         paths[key] = path

@@ -48,6 +48,7 @@ from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
 from fm_returnprediction_tpu.ops.rolling import rolling_prod, rolling_sum
 from fm_returnprediction_tpu.panel.daily import build_compact_daily
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
+from fm_returnprediction_tpu.utils.timing import StageTimer
 
 __all__ = ["FACTORS_DICT", "BASE_COLUMNS", "compute_monthly_characteristics", "get_factors"]
 
@@ -189,8 +190,6 @@ def get_factors(
             "firm_chunk applies only to the single-device compact path; "
             "the mesh path shards the full firm axis (pass one or the other)"
         )
-    from fm_returnprediction_tpu.utils.timing import StageTimer
-
     timer = timer or StageTimer()
     with timer.stage("factors/long_to_dense"):
         df = crsp_comp.copy()

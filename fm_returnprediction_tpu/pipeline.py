@@ -21,7 +21,11 @@ from typing import Dict, Optional
 import numpy as np
 import pandas as pd
 
-from fm_returnprediction_tpu.data.synthetic import SyntheticConfig, generate_synthetic_wrds
+from fm_returnprediction_tpu.data.synthetic import (
+    FILE_NAMES,
+    SyntheticConfig,
+    generate_synthetic_wrds,
+)
 from fm_returnprediction_tpu.panel.characteristics import get_factors
 from fm_returnprediction_tpu.panel.dense import DensePanel
 from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
@@ -32,7 +36,10 @@ from fm_returnprediction_tpu.panel.transform_compustat import (
     merge_CRSP_and_Compustat,
 )
 from fm_returnprediction_tpu.panel.transform_crsp import calculate_market_equity
-from fm_returnprediction_tpu.data.wrds_pull import subset_to_common_stock_and_exchanges
+from fm_returnprediction_tpu.data.wrds_pull import (
+    FLAG_COLUMNS as _FLAG_COLUMNS,
+    subset_to_common_stock_and_exchanges,
+)
 from fm_returnprediction_tpu.reporting.deciles import build_decile_table, save_decile_table
 from fm_returnprediction_tpu.reporting.figure1 import create_figure_1
 from fm_returnprediction_tpu.reporting.latex import (
@@ -47,13 +54,7 @@ from fm_returnprediction_tpu.utils.timing import StageTimer
 
 __all__ = ["PipelineResult", "load_raw_data", "build_panel", "run_pipeline"]
 
-RAW_FILE_NAMES = {
-    "comp": "Compustat_fund.parquet",
-    "ccm": "CRSP_Comp_Link_Table.parquet",
-    "crsp_d": "CRSP_stock_d.parquet",
-    "crsp_m": "CRSP_stock_m.parquet",
-    "crsp_index_d": "CRSP_index_d.parquet",
-}
+RAW_FILE_NAMES = dict(FILE_NAMES)  # canonical mapping lives in data.synthetic
 
 
 @dataclasses.dataclass
@@ -72,8 +73,6 @@ class PipelineResult:
 # filter needs the CIZ flag columns. Everything else in the ~77M-row daily
 # file (prices, shares, jdate, permco) is dead weight that costs ~10x the
 # read time at real scale — prune it at the read.
-from fm_returnprediction_tpu.data.wrds_pull import FLAG_COLUMNS as _FLAG_COLUMNS
-
 _CRSP_D_COLUMNS = ["permno", "dlycaldt", "retx"] + _FLAG_COLUMNS
 
 
