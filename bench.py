@@ -287,18 +287,72 @@ def _bench_pallas(fast: bool):
     }
 
 
-def main() -> None:
-    import jax
+def _devices_or_die(timeout_s: int = 240):
+    """Initialize the JAX backend, but probe it in a SUBPROCESS first.
 
+    A broken accelerator relay makes ``jax.devices()`` hang FOREVER inside a
+    C call (observed: the tunneled axon backend mid-outage) — SIGALRM cannot
+    interrupt that, and without a deadline the driver's whole bench window
+    dies with no artifact. A throwaway subprocess with a hard timeout proves
+    the backend comes up before this process commits to initializing it; on
+    failure this prints the parseable failure line and exits."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        if probe.returncode != 0:
+            raise RuntimeError(
+                f"backend probe rc={probe.returncode}: {probe.stderr[-200:]}"
+            )
+        # The probe is TOCTOU: an intermittent outage can start between the
+        # probe and the parent's own init, which then hangs in the same
+        # uninterruptible C call. A watchdog thread prints the artifact and
+        # hard-exits if the parent init misses its own deadline.
+        import os as _os
+        import threading
+
+        done = threading.Event()
+
+        def _watchdog():
+            if not done.wait(timeout_s):
+                print(json.dumps({
+                    "metric": "bench_failed", "value": -1.0, "unit": "s",
+                    "vs_baseline": 0.0,
+                    "extra": {"backend_init_error":
+                              f"in-process init exceeded {timeout_s}s"},
+                }), flush=True)
+                _os._exit(0)
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+        import jax
+
+        devices = jax.devices()
+        done.set()
+        return devices
+    except Exception as exc:  # noqa: BLE001 - recorded, then exit
+        print(json.dumps({
+            "metric": "bench_failed", "value": -1.0, "unit": "s",
+            "vs_baseline": 0.0,
+            "extra": {"backend_init_error": repr(exc)[:300]},
+        }))
+        raise SystemExit(0)
+
+
+def main() -> None:
     from fm_returnprediction_tpu.settings import enable_compilation_cache
     from fm_returnprediction_tpu.utils.timing import trace
 
+    devices = _devices_or_die()
     enable_compilation_cache()
     fast = os.environ.get("FMRP_BENCH_FAST", "0") == "1"
 
     extra = {
-        "device": jax.devices()[0].platform,
-        "n_devices": len(jax.devices()),
+        "device": devices[0].platform,
+        "n_devices": len(devices),
     }
     sections = [_bench_pipeline, _bench_pipeline_real, _bench_kernel]
     if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
