@@ -161,30 +161,39 @@ def daily_characteristics_chunked(
 @functools.lru_cache(maxsize=16)
 def _mesh_strip_fn(mesh, axis_name: str, n_days: int, n_weeks: int,
                    n_months: int, window: int, min_periods: int,
-                   window_weeks: int):
+                   window_weeks: int, contiguous: bool = False):
     """shard_map'd strip program: the firm axis is split EXPLICITLY, so
     every op inside is device-local by construction — no reliance on GSPMD
     inferring that the per-column scatter needs no communication (it
-    conservatively all-gathers the scatter indices otherwise)."""
+    conservatively all-gathers the scatter indices otherwise).
+    ``contiguous=True`` selects the starts/counts ingest variant."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    from fm_returnprediction_tpu.ops.daily_compact import daily_compact_strip
+    from fm_returnprediction_tpu.ops.daily_compact import (
+        daily_compact_strip,
+        daily_compact_strip_contiguous,
+    )
 
     kernel = functools.partial(
-        daily_compact_strip,
+        daily_compact_strip_contiguous if contiguous else daily_compact_strip,
         n_days=n_days, n_weeks=n_weeks, n_months=n_months,
         window=window, min_periods=min_periods, window_weeks=window_weeks,
         # GSPMD/shard_map cannot partition the pallas custom-call; the XLA
         # cumsum path is firm-local.
         use_pallas=False,
     )
+    if contiguous:
+        in_specs = (P(None, axis_name), P(axis_name), P(axis_name),
+                    P(), P(), P(), P(), P())
+    else:
+        in_specs = (P(None, axis_name), P(None, axis_name),
+                    P(), P(), P(), P(), P())
     return jax.jit(
         jax.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(P(None, axis_name), P(None, axis_name),
-                      P(), P(), P(), P(), P()),
+            in_specs=in_specs,
             out_specs=(P(None, axis_name), P(None, axis_name)),
         )
     )
@@ -275,14 +284,16 @@ def daily_characteristics_compact_chunked(
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         strip_sharding = NamedSharding(mesh, P(None, axis_name))
+        firm_sharding = NamedSharding(mesh, P(axis_name))
         rep = NamedSharding(mesh, P())
         # device_put straight from numpy: each device fetches only its shard
         # from host memory (a jnp.asarray first would commit the full strip
         # to device 0 and then reshard — double the transfer).
         place_strip = lambda a: jax.device_put(a, strip_sharding)
+        place_firm = lambda a: jax.device_put(a, firm_sharding)
         place_rep = lambda a: jax.device_put(np.asarray(a), rep)
     else:
-        place_strip = place_rep = jnp.asarray
+        place_strip = place_firm = place_rep = jnp.asarray
 
     mkt_j = place_rep(np.asarray(mkt_d))
     mkt_present_j = place_rep(np.asarray(mkt_present))
@@ -290,10 +301,41 @@ def daily_characteristics_compact_chunked(
     week_j = place_rep(np.asarray(week_id))
     week_month_j = place_rep(np.asarray(week_month_id))
 
-    if mesh is not None:
-        mesh_fn = _mesh_strip_fn(
-            mesh, axis_name, int(n_days), int(n_weeks), int(n_months),
-            int(window), int(min_periods), int(window_weeks),
+    # Per-firm day-contiguity (positions strictly increase per firm, so a
+    # firm is contiguous iff its position span equals count-1). Contiguous
+    # strips ship per-firm starts/counts instead of the (H, C) int16
+    # position rectangle — a third of the strip's bytes, and the rectangle
+    # assembly memcpy disappears. CRSP rows exist for every trading day
+    # while a firm is listed, so this is the common case.
+    if n_firms and len(row_pos):
+        cap = len(row_pos) - 1
+        fi = np.minimum(offsets[:-1], cap)   # clamp: zero-count firms index
+        li = np.clip(offsets[1:] - 1, 0, cap)  # a neighbor, gated below
+        first_pos = np.where(counts > 0, row_pos[fi].astype(np.int64), 0)
+        last_pos = np.where(counts > 0, row_pos[li].astype(np.int64), -1)
+        # count 0: (-1) - 0 == counts - 1, so empty firms count as contiguous
+        # with start 0 / count 0 → every pos slot is padding, as before
+        firm_contiguous = (last_pos - first_pos) == (counts - 1)
+    else:
+        first_pos = np.zeros(n_firms, np.int64)
+        firm_contiguous = np.zeros(n_firms, bool)
+
+    def strip_fn(contiguous: bool):
+        if mesh is not None:
+            return _mesh_strip_fn(
+                mesh, axis_name, int(n_days), int(n_weeks), int(n_months),
+                int(window), int(min_periods), int(window_weeks),
+                contiguous=contiguous,
+            )
+        from fm_returnprediction_tpu.ops.daily_compact import (
+            daily_compact_strip_contiguous,
+        )
+
+        kernel = daily_compact_strip_contiguous if contiguous else daily_compact_strip
+        return functools.partial(
+            kernel, n_days=n_days, n_weeks=n_weeks, n_months=n_months,
+            window=window, min_periods=min_periods,
+            window_weeks=window_weeks, use_pallas=use_pallas,
         )
 
     vol_out = np.empty((n_months, n_firms), dtype=dtype)
@@ -318,23 +360,27 @@ def daily_characteristics_compact_chunked(
         firms = order[start : start + c]
         h = bucket(int(counts[firms].max(initial=1)))
         rect_vals = np.full((h, c), np.nan, dtype=dtype)
-        rect_pos = np.full((h, c), n_days, dtype=row_pos.dtype)
         for k, f in enumerate(firms):
             a, b = offsets[f], offsets[f + 1]
             rect_vals[: b - a, k] = row_values[a:b]
-            rect_pos[: b - a, k] = row_pos[a:b]
-        if mesh is not None:
-            vol_s, beta_s = mesh_fn(
-                place_strip(rect_vals), place_strip(rect_pos),
+        if len(firms) and bool(firm_contiguous[firms].all()):
+            starts_arr = np.zeros(c, dtype=np.int32)
+            counts_arr = np.zeros(c, dtype=np.int32)  # width-padding firms: 0 rows
+            starts_arr[: len(firms)] = first_pos[firms]
+            counts_arr[: len(firms)] = counts[firms]
+            vol_s, beta_s = strip_fn(True)(
+                place_strip(rect_vals), place_firm(starts_arr),
+                place_firm(counts_arr),
                 mkt_j, mkt_present_j, month_j, week_j, week_month_j,
             )
         else:
-            vol_s, beta_s = daily_compact_strip(
+            rect_pos = np.full((h, c), n_days, dtype=row_pos.dtype)
+            for k, f in enumerate(firms):
+                a, b = offsets[f], offsets[f + 1]
+                rect_pos[: b - a, k] = row_pos[a:b]
+            vol_s, beta_s = strip_fn(False)(
                 place_strip(rect_vals), place_strip(rect_pos),
                 mkt_j, mkt_present_j, month_j, week_j, week_month_j,
-                n_days, n_weeks, n_months,
-                window=window, min_periods=min_periods,
-                window_weeks=window_weeks, use_pallas=use_pallas,
             )
         pending.append((firms, vol_s, beta_s))
         if len(pending) >= max_inflight:

@@ -43,7 +43,53 @@ from fm_returnprediction_tpu.ops.daily_kernels import (
 )
 from fm_returnprediction_tpu.ops.rolling import rolling_std
 
-__all__ = ["daily_compact_strip"]
+__all__ = ["daily_compact_strip", "daily_compact_strip_contiguous"]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_days", "n_weeks", "n_months",
+        "window", "min_periods", "window_weeks", "use_pallas",
+    ),
+)
+def daily_compact_strip_contiguous(
+    comp_ret: jnp.ndarray,
+    starts: jnp.ndarray,
+    counts: jnp.ndarray,
+    mkt_d: jnp.ndarray,
+    mkt_present: jnp.ndarray,
+    day_month_id: jnp.ndarray,
+    week_id: jnp.ndarray,
+    week_month_id: jnp.ndarray,
+    n_days: int,
+    n_weeks: int,
+    n_months: int,
+    window: int = 252,
+    min_periods: int = 100,
+    window_weeks: int = 156,
+    use_pallas: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``daily_compact_strip`` for strips whose firms' rows are DAY-
+    CONTIGUOUS (the norm in CRSP: rows exist for every trading day while
+    listed, null returns are NaN VALUES on present rows). The (H, C) int16
+    position rectangle then carries no information beyond per-firm
+    ``starts``/``counts`` — reconstructing it on device from two (C,) int32
+    vectors cuts a third of the strip's transfer bytes.
+    """
+    h = comp_ret.shape[0]
+    row = jnp.arange(h, dtype=jnp.int32)[:, None]
+    pos = jnp.where(
+        row < counts.astype(jnp.int32)[None, :],
+        starts.astype(jnp.int32)[None, :] + row,
+        n_days,
+    )
+    return daily_compact_strip(
+        comp_ret, pos, mkt_d, mkt_present, day_month_id, week_id,
+        week_month_id, n_days, n_weeks, n_months,
+        window=window, min_periods=min_periods,
+        window_weeks=window_weeks, use_pallas=use_pallas,
+    )
 
 
 @functools.partial(

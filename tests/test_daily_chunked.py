@@ -118,6 +118,106 @@ def test_compact_chunked_matches_dense(rng, chunk, bucket):
     np.testing.assert_array_equal(beta, beta0)
 
 
+def _contiguous_daily_fixture(rng, d=240, n=31, n_months=12):
+    """Every firm's rows span a contiguous day range (CRSP-like: rows exist
+    for each trading day while listed; nulls are NaN VALUES on present
+    rows) — the regime the starts/counts ingest variant targets."""
+    base = _daily_fixture(rng, d=d, n=n, n_months=n_months)
+    mask = np.zeros((d, n), dtype=bool)
+    for k in range(n):
+        a = int(rng.integers(0, d - 20))
+        b = int(rng.integers(a + 10, d))
+        mask[a:b, k] = True
+    base["mask_d"] = mask
+    return base
+
+
+def test_compact_strip_contiguous_matches_pos_path(rng):
+    """The starts/counts variant is byte-for-byte the pos-rectangle strip
+    program on contiguous data."""
+    from fm_returnprediction_tpu.ops.daily_compact import (
+        daily_compact_strip,
+        daily_compact_strip_contiguous,
+    )
+
+    d = _contiguous_daily_fixture(rng)
+    csr = _to_csr(d)
+    counts = np.diff(csr["offsets"])
+    n_firms = len(counts)
+    h = int(counts.max())
+    rect_vals = np.full((h, n_firms), np.nan)
+    rect_pos = np.full((h, n_firms), csr["n_days"], dtype=csr["row_pos"].dtype)
+    starts = np.zeros(n_firms, np.int32)
+    for k in range(n_firms):
+        a, b = csr["offsets"][k], csr["offsets"][k + 1]
+        rect_vals[: b - a, k] = csr["row_values"][a:b]
+        rect_pos[: b - a, k] = csr["row_pos"][a:b]
+        starts[k] = csr["row_pos"][a]
+    shared = (
+        jnp.asarray(csr["mkt_d"]), jnp.asarray(csr["mkt_present"]),
+        jnp.asarray(csr["day_month_id"]), jnp.asarray(csr["week_id"]),
+        jnp.asarray(csr["week_month_id"]),
+    )
+    kw = dict(n_days=csr["n_days"], n_weeks=csr["n_weeks"],
+              n_months=csr["n_months"], window=60, min_periods=20,
+              window_weeks=26, use_pallas=False)
+    vol_p, beta_p = daily_compact_strip(
+        jnp.asarray(rect_vals), jnp.asarray(rect_pos), *shared, **kw
+    )
+    vol_c, beta_c = daily_compact_strip_contiguous(
+        jnp.asarray(rect_vals), jnp.asarray(starts),
+        jnp.asarray(counts.astype(np.int32)), *shared, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(vol_c), np.asarray(vol_p))
+    np.testing.assert_array_equal(np.asarray(beta_c), np.asarray(beta_p))
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+@pytest.mark.parametrize("chunk", [8, 40])
+def test_compact_chunked_contiguous_matches_dense(rng, chunk, use_mesh):
+    """End-to-end: the chunked driver auto-selects the starts/counts ingest
+    on contiguous data (single-device and mesh) and still reproduces the
+    dense kernels bit-exactly."""
+    from fm_returnprediction_tpu.ops.daily_chunked import (
+        daily_characteristics_compact_chunked,
+    )
+    from fm_returnprediction_tpu.parallel.mesh import make_mesh
+
+    d = _contiguous_daily_fixture(rng)
+    vol0, beta0 = _unchunked(d)
+    csr = _to_csr(d)
+    mesh = make_mesh(axis_name="firms") if use_mesh else None
+    vol, beta = daily_characteristics_compact_chunked(
+        **csr, window=60, min_periods=20, window_weeks=26,
+        firm_chunk=chunk, mesh=mesh, use_pallas=False if mesh is None else None,
+    )
+    np.testing.assert_array_equal(vol, vol0)
+    np.testing.assert_array_equal(beta, beta0)
+
+
+def test_compact_chunked_empty_firms(rng):
+    """Zero-row firms in the CSR (valid public-API input) must produce
+    all-NaN columns, not crash the contiguity precompute — including an
+    empty firm at position 0 and at the end."""
+    from fm_returnprediction_tpu.ops.daily_chunked import (
+        daily_characteristics_compact_chunked,
+    )
+
+    d = _contiguous_daily_fixture(rng, n=9)
+    csr = _to_csr(d)
+    # splice empty firms at the front and back of the firm axis
+    offsets = np.concatenate([[0], csr["offsets"], [csr["offsets"][-1]]])
+    csr = {**csr, "offsets": offsets}
+    vol, beta = daily_characteristics_compact_chunked(
+        **csr, window=60, min_periods=20, window_weeks=26, firm_chunk=4,
+        use_pallas=False,
+    )
+    assert vol.shape[1] == 11
+    assert np.isnan(vol[:, 0]).all() and np.isnan(vol[:, -1]).all()
+    assert np.isnan(beta[:, 0]).all() and np.isnan(beta[:, -1]).all()
+    assert np.isfinite(vol[:, 1:-1]).any()
+
+
 @pytest.mark.parametrize("chunk", [16, 40])
 def test_compact_chunked_mesh_matches_single_device(rng, chunk):
     """The mesh path consumes the SAME compact ingest (round-2 VERDICT
