@@ -164,6 +164,9 @@ def generate_benchscale_wrds(
             "retx": mretx,
             "prc": prc_m,
             "shrout": shrout_m,
+            "vol": shrout_m * 1000.0
+            * np.repeat(rng.uniform(0.02, 0.20, n_permnos), m_counts)
+            * rng.lognormal(0.0, 0.4, r_m),
             "jdate": jdate_m,
             **_flag_frame(r_m, flag_values, rep_m),
         }

@@ -154,6 +154,11 @@ def generate_synthetic_wrds(cfg: SyntheticConfig | None = None) -> Dict[str, pd.
         fd["m"] = fd["d"] + MonthEnd(0)
         grouped = fd.groupby("m")
         issue_rate = float(rng.uniform(0.0, 0.005))
+        # monthly share volume for the opt-in turnover characteristic:
+        # per-firm turnover level ~ the published 0.08/month scale, with
+        # lognormal month-to-month variation (vol is in shares, shrout in
+        # thousands — the CRSP unit convention turnover = vol/(shrout·1e3))
+        turn_level = float(rng.uniform(0.02, 0.20))
         sh = shrout
         for m, grp in grouped:
             mret = float(np.prod(1 + grp["r"].to_numpy()) - 1)
@@ -168,6 +173,7 @@ def generate_synthetic_wrds(cfg: SyntheticConfig | None = None) -> Dict[str, pd.
                     retx=mret,
                     prc=float(grp["p"].iloc[-1]),
                     shrout=sh,
+                    vol=turn_level * sh * 1000.0 * float(rng.lognormal(0, 0.4)),
                     **shared,
                 )
             )
@@ -182,6 +188,7 @@ def generate_synthetic_wrds(cfg: SyntheticConfig | None = None) -> Dict[str, pd.
                         retx=float(rng.normal(0.01, 0.05)),
                         prc=float(grp["p"].iloc[-1] * 0.5),
                         shrout=shrout * 0.2,
+                        vol=turn_level * shrout * 200.0,
                         **shared,
                     )
                 )

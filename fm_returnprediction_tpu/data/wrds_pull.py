@@ -144,9 +144,14 @@ def build_crsp_stock_sql(
     if freq.upper() == "M":
         table, date_col = "msf_v2", "mthcaldt"
         tot_ret, prc_ret, prc = "mthret", "mthretx", "mthprc"
+        # mthvol (CIZ share volume) feeds the opt-in Turnover_{-1,-12}
+        # characteristic — a column the reference never pulls because it
+        # never computes turnover (SURVEY §6 note).
+        extra = "mthvol AS vol,"
     elif freq.upper() == "D":
         table, date_col = "dsf_v2", "dlycaldt"
         tot_ret, prc_ret, prc = "dlyret", "dlyretx", "dlyprc"
+        extra = ""
     else:
         raise ValueError("freq must be either 'D' or 'M'.")
     sql = f"""
@@ -158,6 +163,7 @@ def build_crsp_stock_sql(
             {tot_ret} AS totret,
             {prc_ret} AS retx,
             {prc} AS prc,
+            {extra}
             shrout
         FROM crsp.{table}
         WHERE {date_col} >= '{start_date}'

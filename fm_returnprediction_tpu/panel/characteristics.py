@@ -45,12 +45,19 @@ from fm_returnprediction_tpu.ops.daily_chunked import (
     daily_characteristics_compact_chunked,
 )
 from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
-from fm_returnprediction_tpu.ops.rolling import rolling_prod, rolling_sum
+from fm_returnprediction_tpu.ops.rolling import rolling_mean, rolling_prod, rolling_sum
 from fm_returnprediction_tpu.panel.daily import build_compact_daily
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
 from fm_returnprediction_tpu.utils.timing import StageTimer
 
-__all__ = ["FACTORS_DICT", "BASE_COLUMNS", "compute_monthly_characteristics", "get_factors"]
+__all__ = [
+    "FACTORS_DICT",
+    "BASE_COLUMNS",
+    "TURNOVER_LABEL",
+    "TURNOVER_COLUMN",
+    "compute_monthly_characteristics",
+    "get_factors",
+]
 
 # Display-name → column map, matching the notebook's working mapping
 # (reference cell 24; the .py's "rolling_beta" name is the known defect
@@ -88,6 +95,17 @@ BASE_COLUMNS = [
     "dvc",
     "is_nyse",
 ]
+
+# Opt-in 16th characteristic (INCLUDE_TURNOVER=1): the published Lewellen
+# Table 1 carries a Turnover_{-1,-12} row (avg monthly share turnover over
+# months t-12..t-1) that the reference pipeline never computes — no calc
+# function exists and its SQL never pulls volume (SURVEY §6 note). Definition
+# follows the paper: turnover_m = vol_m / shares outstanding (CRSP units:
+# vol in shares, shrout in thousands), averaged over the trailing 12 rows
+# ending at t-1, all 12 required (the strictest min_periods convention of
+# the other full-window characteristics, e.g. return_12_2).
+TURNOVER_LABEL = "Turnover (-1,-12)"
+TURNOVER_COLUMN = "turnover_12"
 
 _MONTHLY_OUT = [
     "log_size",
@@ -142,6 +160,9 @@ def compute_monthly_characteristics(
         "debt_price": total_debt / me_lag,
         "sales_price": sales / me_lag,
     }
+    if "vol" in idx:  # static: var_index is a static argname
+        turnover = comp("vol") / (shrout * 1000.0)
+        out[TURNOVER_COLUMN] = rolling_mean(lag(turnover, 1), 12, 12)
     return {name: scatter_back(arr, plan) for name, arr in out.items()}
 
 
@@ -174,6 +195,7 @@ def get_factors(
     mesh=None,
     firm_chunk=None,
     timer=None,
+    include_turnover=None,
 ) -> Tuple[DensePanel, Dict[str, str]]:
     """Dense-panel equivalent of the reference's ``get_factors``
     (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
@@ -184,20 +206,40 @@ def get_factors(
     The daily stage (the data-volume hot spot) runs firm-sharded over
     ``mesh`` when one is given, else firm-chunked on the single device
     (``firm_chunk=None`` = auto budget; see ``ops.daily_chunked``).
+
+    ``include_turnover`` (default: the INCLUDE_TURNOVER setting) adds the
+    16th published-Table-1 characteristic the reference lacks; it requires a
+    ``vol`` column in ``crsp_comp`` (the puller adds it, old caches may not
+    have it).
     """
     if mesh is not None and firm_chunk is not None:
         raise ValueError(
             "firm_chunk applies only to the single-device compact path; "
             "the mesh path shards the full firm axis (pass one or the other)"
         )
+    if include_turnover is None:
+        from fm_returnprediction_tpu.settings import config
+
+        include_turnover = bool(int(config("INCLUDE_TURNOVER")))
+    base_columns = list(BASE_COLUMNS)
+    factors_dict = dict(FACTORS_DICT)
+    if include_turnover:
+        if "vol" not in crsp_comp.columns:
+            raise KeyError(
+                "INCLUDE_TURNOVER=1 needs a 'vol' column in the monthly "
+                "panel; re-pull CRSP monthly data (the cache may predate "
+                "volume support) or disable the flag."
+            )
+        base_columns.append("vol")
+        factors_dict[TURNOVER_LABEL] = TURNOVER_COLUMN
     timer = timer or StageTimer()
     with timer.stage("factors/long_to_dense"):
         df = crsp_comp.copy()
         df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
-        panel = long_to_dense(df, "jdate", "permno", BASE_COLUMNS, dtype=dtype)
+        panel = long_to_dense(df, "jdate", "permno", base_columns, dtype=dtype)
 
     with timer.stage("factors/monthly_characteristics"):
-        var_index = tuple((name, panel.var_index(name)) for name in BASE_COLUMNS)
+        var_index = tuple((name, panel.var_index(name)) for name in base_columns)
         monthly = compute_monthly_characteristics(
             jnp.asarray(panel.values), jnp.asarray(panel.mask), var_index
         )
@@ -234,7 +276,7 @@ def get_factors(
         new_vars["beta"] = beta_m
         enriched = panel.with_vars(new_vars)
 
-        win_names = [n for n in FACTORS_DICT.values() if n in enriched.var_names]
+        win_names = [n for n in factors_dict.values() if n in enriched.var_names]
         win_idx = jnp.asarray([enriched.var_index(n) for n in win_names])
         # ONE full-panel push; the final panel stays DEVICE-resident, so
         # every reporting stage (tables, figure, deciles) slices on device
@@ -252,4 +294,4 @@ def get_factors(
             ids=enriched.ids,
             var_names=enriched.var_names,
         )
-    return final, dict(FACTORS_DICT)
+    return final, factors_dict

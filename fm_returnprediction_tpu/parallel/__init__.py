@@ -26,14 +26,24 @@ from fm_returnprediction_tpu.parallel.mesh import (
     pad_to_multiple,
     shard_panel,
 )
+from fm_returnprediction_tpu.parallel.multihost import (
+    as_flat_mesh,
+    fama_macbeth_hier,
+    initialize_multihost,
+    make_mesh_2d,
+)
 
 __all__ = [
     "BootstrapResult",
+    "as_flat_mesh",
     "block_bootstrap_se",
     "bootstrap_replicate_means",
     "daily_characteristics_sharded",
     "default_mesh",
+    "fama_macbeth_hier",
     "fama_macbeth_sharded",
+    "initialize_multihost",
+    "make_mesh_2d",
     "monthly_cs_ols_sharded",
     "host_local_mesh",
     "make_mesh",

@@ -68,7 +68,7 @@ from fm_returnprediction_tpu.ops.ols import (
 )
 from fm_returnprediction_tpu.parallel.mesh import make_mesh, shard_panel
 
-__all__ = ["monthly_cs_ols_sharded", "fama_macbeth_sharded"]
+__all__ = ["cs_ols_kernel", "monthly_cs_ols_sharded", "fama_macbeth_sharded"]
 
 _PRECISION = jax.lax.Precision.HIGHEST
 
@@ -117,6 +117,44 @@ def _tsqr_lstsq(x_aug, y_z, axis_name: str, n_shards: int):
     return beta
 
 
+def cs_ols_kernel(y_l, x_l, mask_l, axis_name: str, n_shards: int, n_refine: int):
+    """The per-device cross-sectional OLS body, for use INSIDE ``shard_map``.
+
+    ``y_l (T, N/D)``, ``x_l (T, N/D, P)``, ``mask_l (T, N/D)`` are the local
+    firm shard; the only collectives are psums over ``axis_name`` (the firm
+    axis), so a caller may map additional mesh axes over the month dimension
+    with zero extra communication (``parallel.multihost``). Returns a
+    ``CSRegressionResult`` whose leaves are replicated over ``axis_name``.
+    """
+    valid = row_validity(y_l, x_l, mask_l)
+    x_aug, y_z, v = augment_design(y_l, x_l, valid)
+    if n_refine == 0:
+        # Sufficient stats are additive over firm shards (ops.ols
+        # docstring), so local contraction + one psum == global.
+        stats = jax.lax.psum(sufficient_stats(y_l, x_l, valid), axis_name)
+        n, ysum, yy = stats.n, stats.ysum, stats.yy
+        pinv, month_valid = gram_pinv(stats)
+        beta = jnp.einsum("tpq,tq->tp", pinv, stats.moment, precision=_PRECISION)
+    else:
+        n, ysum, yy = jax.lax.psum(
+            (v.sum(-1), y_z.sum(-1), jnp.sum(y_z * y_z, -1)), axis_name
+        )
+        month_valid = n >= x_aug.shape[-1]
+        beta = _tsqr_lstsq(x_aug, y_z, axis_name, n_shards)
+    beta = jnp.where(month_valid[:, None], beta, 0.0)
+
+    # R² from raw residuals of the solved coefficients (centered, as
+    # statsmodels' rsquared) — not the rounded Gram reconstruction.
+    resid = (
+        y_z - jnp.einsum("tnq,tq->tn", x_aug, beta, precision=_PRECISION)
+    ) * v
+    sse = jax.lax.psum(jnp.sum(resid * resid, axis=1), axis_name)
+    sst = yy - ysum * ysum / jnp.maximum(n, 1.0)
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
+    r2 = jnp.where(month_valid, r2, 0.0)
+    return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, n, month_valid)
+
+
 def monthly_cs_ols_sharded(
     y, x, mask, mesh: Mesh, axis_name: str = "firms", n_refine: int = 2
 ) -> CSRegressionResult:
@@ -133,33 +171,9 @@ def monthly_cs_ols_sharded(
     """
 
     def kernel(y_l, x_l, mask_l):
-        valid = row_validity(y_l, x_l, mask_l)
-        x_aug, y_z, v = augment_design(y_l, x_l, valid)
-        if n_refine == 0:
-            # Sufficient stats are additive over firm shards (ops.ols
-            # docstring), so local contraction + one psum == global.
-            stats = jax.lax.psum(sufficient_stats(y_l, x_l, valid), axis_name)
-            n, ysum, yy = stats.n, stats.ysum, stats.yy
-            pinv, month_valid = gram_pinv(stats)
-            beta = jnp.einsum("tpq,tq->tp", pinv, stats.moment, precision=_PRECISION)
-        else:
-            n, ysum, yy = jax.lax.psum(
-                (v.sum(-1), y_z.sum(-1), jnp.sum(y_z * y_z, -1)), axis_name
-            )
-            month_valid = n >= x_aug.shape[-1]
-            beta = _tsqr_lstsq(x_aug, y_z, axis_name, mesh.shape[axis_name])
-        beta = jnp.where(month_valid[:, None], beta, 0.0)
-
-        # R² from raw residuals of the solved coefficients (centered, as
-        # statsmodels' rsquared) — not the rounded Gram reconstruction.
-        resid = (
-            y_z - jnp.einsum("tnq,tq->tn", x_aug, beta, precision=_PRECISION)
-        ) * v
-        sse = jax.lax.psum(jnp.sum(resid * resid, axis=1), axis_name)
-        sst = yy - ysum * ysum / jnp.maximum(n, 1.0)
-        r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
-        r2 = jnp.where(month_valid, r2, 0.0)
-        return CSRegressionResult(beta[:, 1:], beta[:, 0], r2, n, month_valid)
+        return cs_ols_kernel(
+            y_l, x_l, mask_l, axis_name, mesh.shape[axis_name], n_refine
+        )
 
     shard = jax.shard_map(
         kernel,

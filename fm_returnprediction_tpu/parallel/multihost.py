@@ -1,0 +1,266 @@
+"""Multi-host execution: distributed runtime init + months×firms 2-D mesh.
+
+The reference has no distributed layer (SURVEY §2.1 "Distributed
+communication backend: Absent"); its nearest analog is a SLURM job-ID check
+that recolors the console (``dodo.py:31-34``). The TPU-native multi-host
+design follows the standard JAX recipe — one process per host, every
+process runs the same program, `jax.distributed.initialize` wires the
+coordination service, and meshes span the GLOBAL device set — with the mesh
+laid out so each collective rides the right interconnect:
+
+- **months → hosts (DCN).** Cross-sectional months are independent
+  (SURVEY §5): the per-month OLS needs NO cross-month communication, so the
+  time axis shards across hosts and DCN carries only the final slope
+  gather, ``T·(P+1)`` floats (~40 KB for the full panel) once per FM run.
+- **firms → intra-host devices (ICI).** The firm-axis TSQR/Gram psum
+  (``fm_sharded``: ~10 MB / ~150 KB per run) stays inside each host's ICI
+  domain, never touching DCN.
+
+This is the "shard the collective-heavy axis over ICI, the embarrassingly
+parallel axis over DCN" layout of the public scaling playbook, applied to
+the panel workload. The bootstrap stage is already communication-minimal
+(2·P floats), so it flattens the same devices into a 1-D replicate mesh
+(``as_flat_mesh``) rather than needing its own hierarchy.
+
+Single-host virtual meshes (``xla_force_host_platform_device_count``)
+exercise the exact same code: ``make_mesh_2d(month_shards=2)`` on 8 CPU
+devices builds the (2, 4) mesh the tests and the driver dryrun use, and
+the collectives compile to the same HLO they would on a pod — only the
+physical transport differs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fm_returnprediction_tpu.ops.fama_macbeth import (
+    FamaMacbethSummary,
+    fama_macbeth_summary,
+)
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+from fm_returnprediction_tpu.parallel.fm_sharded import cs_ols_kernel
+from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple
+
+__all__ = [
+    "initialize_multihost",
+    "make_mesh_2d",
+    "as_flat_mesh",
+    "fama_macbeth_hier",
+]
+
+
+def _distributed_client_active() -> bool:
+    """True when the JAX distributed runtime is already initialized.
+
+    Probes the distributed client directly instead of ``process_count()``:
+    a device/process query INITIALIZES the XLA backends, after which
+    ``jax.distributed.initialize`` permanently raises — the probe must not
+    be the thing that breaks the initialization it guards. Private API;
+    degrade to "not initialized" (and let ``initialize`` itself raise on a
+    true double call) if the attribute moves across JAX versions.
+    """
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> tuple[int, int]:
+    """Bring up the JAX distributed runtime when a multi-process run is
+    configured; no-op otherwise. Returns ``(process_index, process_count)``.
+
+    Configuration, in precedence order:
+
+    1. explicit arguments (manual clusters / tests);
+    2. ``FMRP_MULTIHOST=1`` in the environment — triggers
+       ``jax.distributed.initialize()`` with no arguments, which
+       auto-detects the topology on Cloud TPU pods and SLURM/GKE clusters.
+       The pipeline and taskgraph CLIs call this at startup, so setting the
+       env var is all a pod launcher needs;
+    3. neither: single-process, return ``(0, 1)`` without touching the
+       distributed runtime (the safe default for laptops and CI).
+
+    Call ONCE per process, before any other JAX computation — a device or
+    process query initializes the XLA backends, after which the distributed
+    runtime can no longer be brought up (``jax.distributed.initialize``
+    raises; that error propagates rather than being masked here).
+    Idempotent: when the distributed client is already up, the call just
+    returns the current process coordinates.
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    wanted = explicit or os.environ.get("FMRP_MULTIHOST", "0") == "1"
+    if wanted and not _distributed_client_active():
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()
+    return jax.process_index(), jax.process_count()
+
+
+def make_mesh_2d(
+    month_shards: Optional[int] = None,
+    month_axis: str = "months",
+    firm_axis: str = "firms",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (month_shards, n_devices // month_shards) hierarchical mesh.
+
+    ``month_shards`` defaults to ``jax.process_count()`` so each mesh ROW is
+    one host's devices: the month axis then crosses hosts (DCN) and the
+    firm axis stays within a host (ICI). Devices are ordered by
+    ``(process_index, id)`` to guarantee that alignment. On a single
+    process, pass ``month_shards`` explicitly to carve a virtual hierarchy
+    out of the local devices (tests, dryrun).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    h = jax.process_count() if month_shards is None else month_shards
+    if h < 1:
+        raise ValueError(f"month_shards must be >= 1, got {h}")
+    d, rem = divmod(len(devices), h)
+    if rem or d == 0:
+        raise ValueError(
+            f"{len(devices)} devices do not factor into {h} month shards"
+        )
+    return Mesh(
+        np.asarray(devices).reshape(h, d), axis_names=(month_axis, firm_axis)
+    )
+
+
+def as_flat_mesh(mesh: Mesh, axis_name: str = "boot") -> Mesh:
+    """The same devices as a 1-D mesh (for the replicate-sharded bootstrap:
+    its one psum is 2·P floats, cheap even over DCN, so every device in the
+    hierarchy contributes replicates)."""
+    return Mesh(mesh.devices.reshape(-1), axis_names=(axis_name,))
+
+
+def _gather_month_shards(tree, month_axis: str, n_shards: int):
+    """Rebuild full (T, ...) arrays from contiguous month shards, as a psum
+    of offset-placed blocks — the same trick as ``fm_sharded._tsqr_lstsq``,
+    and for the same reason: ``all_gather`` output defeats shard_map's
+    static replication checker, while a psum provably replicates. Bool
+    leaves ride as int8 (psum has no bool) and cast back."""
+
+    def gather(a):
+        as_bool = a.dtype == jnp.bool_
+        v = a.astype(jnp.int8) if as_bool else a
+        t_l = v.shape[0]
+        full = jnp.zeros((n_shards * t_l,) + v.shape[1:], v.dtype)
+        offset = jax.lax.axis_index(month_axis) * t_l
+        zero = jnp.zeros((), offset.dtype)
+        starts = (offset,) + (zero,) * (v.ndim - 1)
+        full = jax.lax.psum(
+            jax.lax.dynamic_update_slice(full, v, starts), month_axis
+        )
+        return full.astype(jnp.bool_) if as_bool else full
+
+    return jax.tree.map(gather, tree)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_fm_hier(mesh: Mesh, month_axis: str, firm_axis: str,
+                    nw_lags: int, min_months: int, weight: str, n_refine: int):
+    """One compiled hierarchical-FM program per (mesh, hyperparams) combo
+    (same function-identity-cache rationale as ``fm_sharded._jitted_fm``)."""
+    n_firm_shards = mesh.shape[firm_axis]
+    n_month_shards = mesh.shape[month_axis]
+
+    def kernel(y_l, x_l, mask_l):
+        # Per-month OLS on the local (T/H, N/D) block: collectives only over
+        # the firm axis (ICI). Months never communicate here.
+        cs_local = cs_ols_kernel(
+            y_l, x_l, mask_l, firm_axis, n_firm_shards, n_refine
+        )
+        # One gather over the month axis (DCN) rebuilds the full (T, ...)
+        # slope series on every device; contiguous month shards concatenate
+        # back in chronological order. ~T·(P+1) floats.
+        cs_full = _gather_month_shards(cs_local, month_axis, n_month_shards)
+        # NW/FM aggregation is O(T·P) — replicated everywhere, like the
+        # single-mesh path.
+        summary = fama_macbeth_summary(
+            cs_full, nw_lags=nw_lags, min_months=min_months, weight=weight
+        )
+        return cs_full, summary
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(
+                P(month_axis, firm_axis),
+                P(month_axis, firm_axis, None),
+                P(month_axis, firm_axis),
+            ),
+            out_specs=(
+                CSRegressionResult(P(), P(), P(), P(), P()),
+                FamaMacbethSummary(P(), P(), P(), P(), P(), P()),
+            ),
+        )
+    )
+
+
+def fama_macbeth_hier(
+    y,
+    x,
+    mask,
+    mesh: Optional[Mesh] = None,
+    month_axis: str = "months",
+    firm_axis: str = "firms",
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+    n_refine: int = 2,
+    place: bool = True,
+) -> tuple[CSRegressionResult, FamaMacbethSummary]:
+    """Multi-host FM on a 2-D (months × firms) mesh.
+
+    Semantically identical to ``fama_macbeth`` / ``fama_macbeth_sharded``
+    (the firm-axis solve is the same ``cs_ols_kernel``); only the layout
+    differs. Months pad up to a mesh-row multiple with ``mask=False`` rows —
+    padded months fail the ``n >= P+1`` gate exactly like the reference's
+    skipped months (``src/regressions.py:52``) and are trimmed from the
+    returned per-month result.
+    """
+    if mesh is None:
+        mesh = make_mesh_2d(month_axis=month_axis, firm_axis=firm_axis)
+    t = y.shape[0]
+    h = mesh.shape[month_axis]
+    d = mesh.shape[firm_axis]
+    if place:
+        y = pad_to_multiple(jnp.asarray(y), axis=0, multiple=h, fill=jnp.nan)
+        x = pad_to_multiple(jnp.asarray(x), axis=0, multiple=h, fill=jnp.nan)
+        mask = pad_to_multiple(jnp.asarray(mask), axis=0, multiple=h, fill=False)
+        y = pad_to_multiple(y, axis=1, multiple=d, fill=jnp.nan)
+        x = pad_to_multiple(x, axis=1, multiple=d, fill=jnp.nan)
+        mask = pad_to_multiple(mask, axis=1, multiple=d, fill=False)
+        s2 = NamedSharding(mesh, P(month_axis, firm_axis))
+        s3 = NamedSharding(mesh, P(month_axis, firm_axis, None))
+        y = jax.device_put(y, s2)
+        x = jax.device_put(x, s3)
+        mask = jax.device_put(mask, s2)
+    run = _jitted_fm_hier(
+        mesh, month_axis, firm_axis, nw_lags, min_months, weight,
+        min(n_refine, 1),
+    )
+    cs, summary = run(y, x, mask)
+    if cs.slopes.shape[0] != t:  # trim month padding
+        cs = CSRegressionResult(*(leaf[:t] for leaf in cs))
+    return cs, summary

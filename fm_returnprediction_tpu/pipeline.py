@@ -97,7 +97,8 @@ def load_raw_data(raw_data_dir) -> Dict[str, pd.DataFrame]:
 
 
 def build_panel(
-    data: Dict[str, pd.DataFrame], dtype=np.float64, mesh=None, timer=None
+    data: Dict[str, pd.DataFrame], dtype=np.float64, mesh=None, timer=None,
+    include_turnover=None,
 ) -> tuple[DensePanel, Dict[str, str]]:
     """Raw frames → merged monthly panel → dense characteristic panel.
 
@@ -131,7 +132,7 @@ def build_panel(
             merged["mthcaldt"] = merged["jdate"]
     return get_factors(
         merged, data["crsp_d"], data["crsp_index_d"], dtype=dtype, mesh=mesh,
-        timer=timer,
+        timer=timer, include_turnover=include_turnover,
     )
 
 
@@ -205,18 +206,21 @@ def run_pipeline(
         table_2 = build_table_2(panel, subset_masks, factors_dict, mesh=mesh)
 
     # The figure and decile paths share the same per-subset batched OLS on
-    # the figure's 5-variable set — compute each subset's result once.
+    # the figure's 5-variable set — ONE fused program computes OLS, rolling
+    # means and decile sorts for every subset, and one device_get pulls all
+    # of it (per-subset dispatches + scalar pulls dominate the reporting
+    # wall-clock on remote TPU backends).
     cs_cache = {}
     if make_figure or make_deciles:
-        from fm_returnprediction_tpu.reporting.figure1 import figure_cs
+        from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
 
         with timer.stage("figure_cs"):
             needed = set(subset_masks) if make_deciles else {
                 "All stocks", "Large stocks"
             }
-            for name in needed:
-                if name in subset_masks:
-                    cs_cache[name] = figure_cs(panel, subset_masks[name])
+            cs_cache = subset_sweep(
+                panel, subset_masks, list(needed), make_deciles=make_deciles
+            )
 
     figure_1 = None
     if make_figure:
@@ -266,8 +270,10 @@ def _main() -> None:
     )
     args = parser.parse_args()
 
+    from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
     from fm_returnprediction_tpu.settings import apply_backend, enable_compilation_cache
 
+    initialize_multihost()  # no-op unless FMRP_MULTIHOST=1; must precede backend init
     apply_backend(args.backend)
     enable_compilation_cache()
     if not args.synthetic and (args.firms is not None or args.months is not None):

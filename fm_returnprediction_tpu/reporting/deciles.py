@@ -36,22 +36,36 @@ def build_decile_table(
 ) -> pd.DataFrame:
     """Rows: Decile 1 (low Ê[r]) … Decile 10 (high), 10−1 spread, t-stat,
     months used. Columns: the three size universes. ``cs_cache`` maps
-    subset name → precomputed ``figure_cs`` result to share the batched OLS
-    with the figure path."""
+    subset name → a precomputed ``figure_cs`` result (the batched OLS is
+    then shared with the figure path) or a ``figure1.SubsetSweepEntry``
+    carrying the finished decile result (nothing device-side runs at all)."""
+    from fm_returnprediction_tpu.reporting.figure1 import SubsetSweepEntry
+
     xvars = list(FIGURE1_VARS.keys())
-    y = jnp.asarray(panel.var(return_col))
-    x = jnp.asarray(panel.select(xvars))
+    y = None
+    x = None
 
     cols = {}
     for subset in SUBSET_ORDER:
-        mask = jnp.asarray(subset_masks[subset])
-        fr = rolling_er_forecast(
-            y, x, mask, window=window, min_periods=min_periods,
-            cs=(cs_cache or {}).get(subset),
-        )
-        res = decile_sorts(
-            fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
-        )
+        entry = (cs_cache or {}).get(subset)
+        if (
+            isinstance(entry, SubsetSweepEntry)
+            and entry.deciles is not None
+            and entry.decile_params == (window, min_periods, n_deciles, min_obs)
+        ):
+            res = entry.deciles
+        else:
+            if y is None:
+                y = jnp.asarray(panel.var(return_col))
+                x = jnp.asarray(panel.select(xvars))
+            mask = jnp.asarray(subset_masks[subset])
+            cs = entry.cs if isinstance(entry, SubsetSweepEntry) else entry
+            fr = rolling_er_forecast(
+                y, x, mask, window=window, min_periods=min_periods, cs=cs,
+            )
+            res = decile_sorts(
+                fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
+            )
         col = {
             f"Decile {d + 1}": float(np.asarray(res.mean_returns)[d])
             for d in range(n_deciles)

@@ -12,8 +12,10 @@ kernel serves both paths.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
@@ -23,7 +25,7 @@ from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
 from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
 from fm_returnprediction_tpu.panel.dense import DensePanel
 
-__all__ = ["figure_cs", "rolling_slopes", "create_figure_1"]
+__all__ = ["figure_cs", "rolling_slopes", "create_figure_1", "subset_sweep"]
 
 
 def figure_cs(panel: DensePanel, subset_mask, return_col: str = "retx"):
@@ -35,6 +37,91 @@ def figure_cs(panel: DensePanel, subset_mask, return_col: str = "retx"):
     return monthly_cs_ols(y, x, jnp.asarray(subset_mask))
 
 
+class SubsetSweepEntry(NamedTuple):
+    """Per-subset figure/decile computation, pulled to host in one transfer."""
+
+    cs: object       # CSRegressionResult (numpy leaves)
+    rolled: object   # (T, 5) figure rolling slope means, calendar-placed
+    deciles: object  # DecileSortResult (numpy leaves) or None
+    decile_params: object = None  # (window, min_periods, n_deciles, min_obs)
+    # consumers must check decile_params against their own arguments before
+    # trusting `deciles` (build_decile_table does)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "min_periods", "n_deciles", "min_obs",
+                     "make_deciles"),
+)
+def _subset_sweep_device(y, x, masks, window, min_periods, n_deciles,
+                         min_obs, make_deciles):
+    """Figure OLS + rolling means (+ forecast deciles) for EVERY subset in
+    one compiled program — one dispatch and one host pull for the whole
+    figure/decile reporting family, instead of per-subset dispatches plus
+    a dozen scalar pulls each (which dominate on remote TPU backends).
+    The big (T, N) forecast intermediates stay on device; only per-month
+    and per-decile summaries leave."""
+    from fm_returnprediction_tpu.models.forecast import (
+        decile_sorts,
+        rolling_er_forecast,
+    )
+
+    def one(mask):
+        cs = monthly_cs_ols(y, x, mask)
+        rolled = rolling_over_valid_rows(
+            cs.slopes, cs.month_valid, window, min_periods
+        )
+        if not make_deciles:
+            return cs, rolled, None
+        fr = rolling_er_forecast(
+            y, x, mask, window=window, min_periods=min_periods, cs=cs
+        )
+        dec = decile_sorts(
+            fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
+        )
+        return cs, rolled, dec
+
+    return jax.vmap(one)(masks)
+
+
+def subset_sweep(
+    panel: DensePanel,
+    subset_masks: Dict,
+    names,
+    return_col: str = "retx",
+    window: int = 120,
+    min_periods: int = 60,
+    n_deciles: int = 10,
+    min_obs: int = 50,
+    make_deciles: bool = True,
+) -> Dict[str, SubsetSweepEntry]:
+    """Run the fused figure/decile program over ``names`` and return numpy
+    results per subset (one ``device_get`` for everything)."""
+    xvars = list(FIGURE1_VARS.keys())
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(xvars))
+    names = [n for n in names if n in subset_masks]
+    stacked = jnp.stack([jnp.asarray(subset_masks[n]) for n in names])
+    out = jax.device_get(
+        _subset_sweep_device(
+            y, x, stacked, window, min_periods, n_deciles, min_obs,
+            make_deciles,
+        )
+    )
+    cs_all, rolled_all, dec_all = out
+    params = (window, min_periods, n_deciles, min_obs)
+    return {
+        name: SubsetSweepEntry(
+            jax.tree.map(lambda leaf, _i=i: leaf[_i], cs_all),
+            rolled_all[i],
+            None if dec_all is None
+            else jax.tree.map(lambda leaf, _i=i: leaf[_i], dec_all),
+            None if dec_all is None else params,
+        )
+        for i, name in enumerate(names)
+    }
+
+
 def rolling_slopes(
     panel: DensePanel,
     subset_mask: jnp.ndarray,
@@ -42,11 +129,14 @@ def rolling_slopes(
     min_periods: int = 60,
     return_col: str = "retx",
     cs=None,
+    rolled=None,
 ) -> pd.DataFrame:
     """120-month rolling mean of monthly Model-2(figure) slopes for one subset.
 
     Returns a DataFrame indexed by month with one column per figure variable.
-    ``cs`` optionally reuses a precomputed ``figure_cs`` result.
+    ``cs`` optionally reuses a precomputed ``figure_cs`` result; ``rolled``
+    additionally reuses the calendar-placed rolling means (both supplied by
+    ``subset_sweep`` entries, already on host).
     """
     xvars = list(FIGURE1_VARS.keys())
     if cs is None:
@@ -54,8 +144,9 @@ def rolling_slopes(
 
     # Roll over consecutive surviving result rows (the reference rolls the
     # slope FRAME, src/calc_Lewellen_2014.py:926), label by their dates.
-    rolled_cal = rolling_over_valid_rows(cs.slopes, cs.month_valid,
-                                         window, min_periods)
+    rolled_cal = rolled if rolled is not None else rolling_over_valid_rows(
+        cs.slopes, cs.month_valid, window, min_periods
+    )
     valid = np.asarray(cs.month_valid)
     months = pd.DatetimeIndex(panel.months)[valid]
     frame = pd.DataFrame(
@@ -81,9 +172,12 @@ def create_figure_1(
     slopes_dict = {}
     for subset_name in ["All stocks", "Large stocks"]:
         if subset_name in subset_masks:
+            entry = (cs_cache or {}).get(subset_name)
+            cs, rolled = entry, None
+            if isinstance(entry, SubsetSweepEntry):
+                cs, rolled = entry.cs, entry.rolled
             slopes_dict[subset_name] = rolling_slopes(
-                panel, subset_masks[subset_name],
-                cs=(cs_cache or {}).get(subset_name),
+                panel, subset_masks[subset_name], cs=cs, rolled=rolled,
             )
 
     fig, axes = plt.subplots(nrows=2, ncols=1, figsize=(14, 10), sharex=True)

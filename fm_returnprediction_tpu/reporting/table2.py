@@ -16,8 +16,10 @@ contracts preserved exactly:
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
@@ -28,6 +30,36 @@ from fm_returnprediction_tpu.panel.dense import DensePanel
 from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
 
 __all__ = ["build_table_2", "run_model_fm"]
+
+# Table 2's FM hyperparameters, defined ONCE: run_model_fm's defaults and
+# the fused sweep below must stay in lockstep (the reference uses NW lag 4
+# and statsmodels' pinv solve everywhere, src/regressions.py:78-100).
+TABLE2_NW_LAGS = 4
+TABLE2_SOLVER = "lstsq"
+
+
+@functools.partial(jax.jit, static_argnames=("idxs", "nw_lags", "solver"))
+def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver):
+    """Every (model, subset) FM summary in ONE compiled program.
+
+    The 3×3 sweep as separate calls costs 9 dispatches plus ~4 small
+    device→host pulls per cell — on a remote/tunneled TPU backend that
+    round-trip latency dominated the whole reporting stage. Here the model
+    loop is static (different predictor counts → different shapes), subsets
+    vmap over a stacked mask tensor, and the caller pulls the full summary
+    pytree with one ``jax.device_get``.
+    """
+    out = []
+    for idx in idxs:  # static: one branch per model, inlined by trace
+        x = x_all[:, :, jnp.asarray(idx)]
+        out.append(
+            jax.vmap(
+                lambda m, _x=x: fama_macbeth(
+                    y, _x, m, nw_lags=nw_lags, solver=solver
+                )[1]
+            )(masks)
+        )
+    return tuple(out)
 
 
 def _model_columns(model: ModelSpec, variables_dict: Dict[str, str]) -> list:
@@ -46,8 +78,8 @@ def run_model_fm(
     model: ModelSpec,
     variables_dict: Dict[str, str],
     return_col: str = "retx",
-    nw_lags: int = 4,
-    solver: str = "lstsq",
+    nw_lags: int = TABLE2_NW_LAGS,
+    solver: str = TABLE2_SOLVER,
     mesh=None,
     y: Optional[jnp.ndarray] = None,
     x: Optional[jnp.ndarray] = None,
@@ -57,9 +89,11 @@ def run_model_fm(
     With ``mesh`` the firm axis shards across devices (TSQR path,
     ``parallel.fm_sharded``); otherwise the single-device batched solver
     runs with the requested ``solver``. ``y``/``x`` accept device-resident
-    precomputed tensors so sweep callers (``build_table_2``) can push the
-    predictor union once and slice per model on device — THIS function
-    stays the single code path for the actual FM call either way."""
+    precomputed tensors so sweep callers can push the predictor union once
+    and slice per model on device. ``build_table_2`` routes through this
+    function on the mesh path; its single-device path uses the fused
+    ``_fm_sweep`` program instead (one dispatch for all 9 cells) with the
+    same ``TABLE2_*`` hyperparameters, so results are identical."""
     if y is None:
         y = jnp.asarray(panel.var(return_col))
     if x is None:
@@ -95,16 +129,42 @@ def build_table_2(
     y = jnp.asarray(panel.var(return_col))
     x_all = jnp.asarray(panel.select(needed))
     col_idx = {c: i for i, c in enumerate(needed)}
+    subset_names = list(subset_masks)
+
+    if mesh is None:
+        idxs = tuple(
+            tuple(col_idx[c] for c in _model_columns(model, variables_dict))
+            for model in models
+        )
+        stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
+        summaries = jax.device_get(
+            _fm_sweep(y, x_all, stacked, idxs,
+                      nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER)
+        )
+        cells = {
+            (mi, name): jax.tree.map(lambda leaf, _si=si: leaf[_si], summaries[mi])
+            for mi in range(len(models))
+            for si, name in enumerate(subset_names)
+        }
+    else:
+        # The firm axis is sharded: one shard_map program per model (the
+        # sweep's vmap-over-subsets would replicate the mask axis through
+        # the collective). Dispatch count is already minimal here.
+        cells = {}
+        for mi, model in enumerate(models):
+            idx = [col_idx[c] for c in _model_columns(model, variables_dict)]
+            x = x_all[:, :, jnp.asarray(idx)]
+            for name in subset_names:
+                _, fm = run_model_fm(
+                    panel, subset_masks[name], model, variables_dict,
+                    return_col=return_col, mesh=mesh, y=y, x=x,
+                )
+                cells[(mi, name)] = jax.device_get(fm)
 
     rows = []
-    for model in models:
-        idx = [col_idx[c] for c in _model_columns(model, variables_dict)]
-        x = x_all[:, :, jnp.asarray(idx)]
-        for subset_name, mask in subset_masks.items():
-            _, fm = run_model_fm(
-                panel, mask, model, variables_dict,
-                return_col=return_col, mesh=mesh, y=y, x=x,
-            )
+    for mi, model in enumerate(models):
+        for subset_name in subset_names:
+            fm = cells[(mi, subset_name)]
             coef = np.asarray(fm.coef)
             tstat = np.asarray(fm.tstat)
             mean_r2 = float(fm.mean_r2)

@@ -92,6 +92,11 @@ d["OUTPUT_DIR"] = if_relative_make_abs(_env("OUTPUT_DIR", default="_output"))
 d["BACKEND"] = _env("BACKEND", default="tpu")
 d["MESH_DEVICES"] = int(_env("MESH_DEVICES", default="1"))
 d["DTYPE"] = _env("DTYPE", default="float32")
+# Opt-in 16th characteristic: the published Lewellen Table 1 has a
+# Turnover_{-1,-12} row the reference pipeline never computes (SURVEY §6
+# note). 1 = pull/require monthly volume and compute it. Default 0 keeps
+# strict reference-behavior parity (15 variables).
+d["INCLUDE_TURNOVER"] = int(_env("INCLUDE_TURNOVER", default="0"))
 
 
 def config(*args, **kwargs):

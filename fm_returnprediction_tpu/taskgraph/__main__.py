@@ -33,6 +33,9 @@ def main(argv=None) -> int:
                         help="override the BACKEND setting")
     args = parser.parse_args(argv)
 
+    from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
+
+    initialize_multihost()  # no-op unless FMRP_MULTIHOST=1; must precede backend init
     apply_backend(args.backend)
     enable_compilation_cache()
 

@@ -1,0 +1,158 @@
+"""Multi-host layer on the virtual 8-device CPU mesh.
+
+Exercises the months×firms hierarchical mesh (``parallel.multihost``): the
+2-D ``fama_macbeth_hier`` must agree with the single-chip solver and the
+1-D firm-sharded path — including month padding when T does not divide the
+host axis, and the near-singular boundary months the TSQR path exists for.
+On virtual CPU devices the collectives compile to the same HLO a pod would
+run; only the physical transport differs (module docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+from fm_returnprediction_tpu.parallel import (
+    as_flat_mesh,
+    block_bootstrap_se,
+    fama_macbeth_hier,
+    initialize_multihost,
+    make_mesh,
+    make_mesh_2d,
+)
+from fm_returnprediction_tpu.panel.dense import long_to_dense
+
+from oracle import make_synthetic_long_panel
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(47)
+    df, pred_cols = make_synthetic_long_panel(rng)
+    dense = long_to_dense(df, "mthcaldt", "permno", ["retx"] + pred_cols)
+    y = jnp.asarray(dense.var("retx"))
+    x = jnp.asarray(dense.select(pred_cols))
+    mask = jnp.asarray(dense.mask)
+    return y, x, mask
+
+
+def test_initialize_multihost_single_process_noop(monkeypatch):
+    monkeypatch.delenv("FMRP_MULTIHOST", raising=False)
+    assert initialize_multihost() == (0, 1)
+
+
+def test_make_mesh_2d_shapes_and_validation():
+    mesh = make_mesh_2d(month_shards=2)
+    assert mesh.shape == {"months": 2, "firms": 4}
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        make_mesh_2d(month_shards=3)  # 8 devices don't factor
+    with pytest.raises(ValueError):
+        make_mesh_2d(month_shards=0)
+    flat = as_flat_mesh(mesh)
+    assert flat.shape == {"boot": 8}
+    assert set(d.id for d in flat.devices.flat) == set(
+        d.id for d in mesh.devices.flat
+    )
+
+
+@pytest.mark.parametrize("month_shards", [2, 4])
+def test_hier_fm_matches_single_chip(panel, month_shards):
+    y, x, mask = panel
+    mesh = make_mesh_2d(month_shards=month_shards)
+    cs_h, fm_h = fama_macbeth_hier(y, x, mask, mesh=mesh)
+    cs_1, fm_1 = fama_macbeth(y, x, mask)
+
+    assert cs_h.slopes.shape == cs_1.slopes.shape
+    np.testing.assert_array_equal(
+        np.asarray(cs_h.month_valid), np.asarray(cs_1.month_valid)
+    )
+    valid = np.asarray(cs_1.month_valid)
+    np.testing.assert_allclose(
+        np.asarray(cs_h.slopes)[valid], np.asarray(cs_1.slopes)[valid],
+        rtol=1e-6, atol=1e-9,
+    )
+    np.testing.assert_allclose(
+        np.asarray(cs_h.r2)[valid], np.asarray(cs_1.r2)[valid],
+        rtol=1e-6, atol=1e-9,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_h.coef), np.asarray(fm_1.coef), rtol=1e-6, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_h.tstat), np.asarray(fm_1.tstat), rtol=1e-5, atol=1e-8
+    )
+
+
+def test_hier_fm_month_padding(panel):
+    """A month count that does not divide the 4-row month axis pads up;
+    padded months must be invisible (exactly like reference-skipped months)
+    and the returned per-month result must be trimmed back to T."""
+    y, x, mask = panel
+    t = y.shape[0] - 1 if (y.shape[0] - 1) % 4 else y.shape[0] - 3
+    assert t % 4 != 0
+    y, x, mask = y[:t], x[:t], mask[:t]
+    mesh = make_mesh_2d(month_shards=4)
+    cs_h, fm_h = fama_macbeth_hier(y, x, mask, mesh=mesh)
+    cs_1, fm_1 = fama_macbeth(y, x, mask)
+    assert cs_h.slopes.shape[0] == t
+    np.testing.assert_array_equal(
+        np.asarray(cs_h.month_valid), np.asarray(cs_1.month_valid)
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_h.coef), np.asarray(fm_1.coef), rtol=1e-6, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm_h.nw_se), np.asarray(fm_1.nw_se), rtol=1e-6, atol=1e-9
+    )
+    assert int(fm_h.n_months) == int(fm_1.n_months)
+
+
+def test_hier_fm_near_singular_months():
+    """Boundary months (n = P+1, cond ~ 1e6) agree with single-chip lstsq —
+    the regime the TSQR path exists for, now through the 2-D mesh."""
+    rng = np.random.default_rng(3)
+    t, n, p = 12, 64, 5
+    x = rng.standard_normal((t, n, p))
+    y = rng.standard_normal((t, n))
+    mask = np.ones((t, n), bool)
+    # make half the months boundary months: only P+1 valid rows, nearly
+    # collinear design
+    for ti in range(0, t, 2):
+        mask[ti, p + 1 :] = False
+        base = rng.standard_normal(p)
+        for r in range(p + 1):
+            x[ti, r] = base + 1e-6 * rng.standard_normal(p)
+    y = jnp.asarray(np.where(mask, y, np.nan))
+    x = jnp.asarray(x)
+    mask = jnp.asarray(mask)
+
+    mesh = make_mesh_2d(month_shards=2)
+    cs_h, _ = fama_macbeth_hier(y, x, mask, mesh=mesh)
+    cs_1, _ = fama_macbeth(y, x, mask)
+    valid = np.asarray(cs_1.month_valid)
+    assert valid.all()
+    drift = np.abs(np.asarray(cs_h.slopes) - np.asarray(cs_1.slopes)).max()
+    assert drift < 1e-6, f"hier FM drifts {drift:.3e} from lstsq"
+
+
+def test_bootstrap_on_flattened_hier_mesh(panel):
+    """The replicate-sharded bootstrap over as_flat_mesh(2-D) must equal the
+    plain 1-D mesh result (same key → same replicate draws)."""
+    y, x, mask = panel
+    cs, _ = fama_macbeth(y, x, mask)
+    slope_valid = cs.month_valid[:, None] & jnp.isfinite(cs.slopes)
+    key = jax.random.key(11)
+    flat = as_flat_mesh(make_mesh_2d(month_shards=2))
+    res_flat = block_bootstrap_se(
+        cs.slopes, slope_valid, key, n_replicates=64, mesh=flat
+    )
+    res_1d = block_bootstrap_se(
+        cs.slopes, slope_valid, key, n_replicates=64,
+        mesh=make_mesh(axis_name="boot"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_flat.se), np.asarray(res_1d.se), rtol=1e-7, atol=1e-12
+    )

@@ -34,10 +34,15 @@ def test_table1_parity_against_published():
 
 
 def test_parity_label_map_covers_every_computed_row():
-    """The canonical map must translate every pipeline display name to a
-    distinct published row, covering the full computed oracle scope."""
-    oracle_rows = set(published_table_1(computed_only=True).index)
-    assert set(PARITY_LABEL_MAP.keys()) == set(FACTORS_DICT.keys())
+    """The canonical map must translate every pipeline display name (the 15
+    reference-scope variables plus the opt-in turnover) to a distinct
+    published row, covering the full published oracle."""
+    from fm_returnprediction_tpu.panel.characteristics import TURNOVER_LABEL
+
+    oracle_rows = set(published_table_1(computed_only=False).index)
+    assert set(PARITY_LABEL_MAP.keys()) == set(FACTORS_DICT.keys()) | {
+        TURNOVER_LABEL
+    }
     assert set(PARITY_LABEL_MAP.values()) == oracle_rows
     assert len(set(PARITY_LABEL_MAP.values())) == len(PARITY_LABEL_MAP)
 
