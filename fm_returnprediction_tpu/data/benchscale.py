@@ -251,7 +251,10 @@ def write_benchscale_cache(
     matches, so only the first bench run pays generation + parquet I/O."""
     raw_data_dir = Path(raw_data_dir)
     marker = raw_data_dir / "benchscale.json"
-    params = {"n_permnos": n_permnos, "n_months": n_months, "seed": seed, "v": 1}
+    # bump "v" whenever the generated schema changes (v2: monthly volume
+    # column for the opt-in turnover characteristic) so pre-change caches
+    # regenerate instead of silently lacking columns
+    params = {"n_permnos": n_permnos, "n_months": n_months, "seed": seed, "v": 2}
     if marker.is_file():
         try:
             if json.loads(marker.read_text()) == params and all(
