@@ -99,6 +99,16 @@ def run_model_fm(
     if x is None:
         x = jnp.asarray(panel.select(_model_columns(model, variables_dict)))
     mask = jnp.asarray(subset_mask)
+    if mesh is not None and len(mesh.shape) == 2:
+        # 2-D months×firms mesh (a pod): months across hosts over DCN,
+        # firm collectives pinned to ICI (parallel.multihost docstring).
+        from fm_returnprediction_tpu.parallel import fama_macbeth_hier
+
+        month_axis, firm_axis = mesh.axis_names
+        return fama_macbeth_hier(
+            y, x, mask, mesh=mesh, month_axis=month_axis,
+            firm_axis=firm_axis, nw_lags=nw_lags,
+        )
     if mesh is not None:
         from fm_returnprediction_tpu.parallel import fama_macbeth_sharded
 

@@ -138,6 +138,59 @@ def test_hier_fm_near_singular_months():
     assert drift < 1e-6, f"hier FM drifts {drift:.3e} from lstsq"
 
 
+def test_hier_fm_collective_contract(panel):
+    """The hierarchical program's communication contract, asserted on the
+    compiled HLO: every collective is a psum (all-reduce) — the firm-axis
+    TSQR/stats reductions and the month-axis slope gather. No all-gather,
+    no all-to-all, no collective-permute, no reduce-scatter: the month axis
+    exists so DCN carries ONE small reduction, and the psum-placed gather
+    (not lax.all_gather) is what the replication checker admits."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fm_returnprediction_tpu.parallel.multihost import _jitted_fm_hier
+
+    y, x, mask = panel
+    mesh = make_mesh_2d(month_shards=2)
+    t = y.shape[0] - y.shape[0] % 2
+    n = x.shape[1] - x.shape[1] % 4
+    s2 = NamedSharding(mesh, P("months", "firms"))
+    s3 = NamedSharding(mesh, P("months", "firms", None))
+    args = (
+        jax.device_put(y[:t, :n], s2),
+        jax.device_put(x[:t, :n], s3),
+        jax.device_put(mask[:t, :n], s2),
+    )
+    run = _jitted_fm_hier(mesh, "months", "firms", 4, 10, "reference", 1)
+    hlo = run.lower(*args).compile().as_text()
+    assert "all-reduce" in hlo, "expected psum collectives in the hier program"
+    for op in ("all-gather", "collective-permute", "all-to-all",
+               "reduce-scatter"):
+        assert op not in hlo, f"unexpected collective {op} in hier FM program"
+
+
+def test_table2_on_hier_mesh_matches_single_device():
+    """build_table_2 accepts the 2-D months×firms mesh and reproduces the
+    single-device table cell for cell (formatted output equality)."""
+    import pandas as pd
+
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.pipeline import build_panel
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=60, n_months=60))
+    panel, factors = build_panel(data)
+    masks = compute_subset_masks(panel)
+    t2_one = build_table_2(panel, masks, factors)
+    t2_hier = build_table_2(
+        panel, masks, factors, mesh=make_mesh_2d(month_shards=2)
+    )
+    pd.testing.assert_frame_equal(t2_one, t2_hier)
+
+
 def test_bootstrap_on_flattened_hier_mesh(panel):
     """The replicate-sharded bootstrap over as_flat_mesh(2-D) must equal the
     plain 1-D mesh result (same key → same replicate draws)."""
