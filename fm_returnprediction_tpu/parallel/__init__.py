@@ -1,10 +1,13 @@
-"""Multi-chip layer: device mesh, firm-sharded FM, replicate-sharded bootstrap.
+"""Multi-chip layer: device mesh, sharded FM/bootstrap, multi-host hierarchy.
 
 The reference is single-process serial (SURVEY §2.1 rows "Data parallelism",
 "Distributed communication backend": Absent). This package is the TPU-native
-replacement: a named home for the ``jax.sharding.Mesh`` plus the two sharded
-stages of the north-star workload — Gram-psum cross-sectional OLS over the
-firm axis and the 10k moving-block bootstrap over the replicate axis.
+replacement: a named home for the ``jax.sharding.Mesh`` plus the sharded
+stages of the north-star workload — distributed-TSQR cross-sectional OLS
+over the firm axis (``fm_sharded``), the 10k moving-block bootstrap over
+the replicate axis (``bootstrap``), firm-sharded daily kernels
+(``daily_sharded``), and the multi-host months×firms hierarchy with
+``jax.distributed`` bring-up (``multihost``).
 """
 
 from fm_returnprediction_tpu.parallel.bootstrap import (
