@@ -309,7 +309,7 @@ def _cpu_fallback_possible(timeout_s: int) -> bool:
         return False
 
 
-def _devices_or_die(timeout_s: int = 240):
+def _devices_or_die(timeout_s: int = 150):
     """Initialize the JAX backend, but probe it in a SUBPROCESS first.
 
     A broken accelerator relay makes ``jax.devices()`` hang FOREVER inside a
