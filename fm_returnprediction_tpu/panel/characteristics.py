@@ -250,6 +250,15 @@ def get_factors(
     # devices inside ``daily_characteristics_compact_chunked``; the dense
     # mesh kernels remain available as ``parallel.daily_sharded`` for
     # callers that already hold a (D, N) panel.
+    # Daily kernels are per-firm-column with zero collectives, so a 2-D
+    # months×firms mesh (the multi-host FM layout) flattens to one firm
+    # axis here — every device takes a firm slice; replicating strips
+    # across month rows would do the same work H times.
+    daily_mesh = mesh
+    if mesh is not None and len(mesh.shape) > 1:
+        from fm_returnprediction_tpu.parallel import as_flat_mesh
+
+        daily_mesh = as_flat_mesh(mesh, axis_name="firms")
     with timer.stage("factors/daily_ingest"):
         cd = build_compact_daily(crsp_d, crsp_index_d, panel.months, dtype=dtype)
     with timer.stage("factors/daily_kernels"):
@@ -257,7 +266,7 @@ def get_factors(
             cd.row_values, cd.row_pos, cd.offsets, cd.mkt, cd.mkt_present,
             cd.day_month_id, cd.week_id, cd.week_month_id,
             cd.n_days, cd.n_weeks, cd.n_months, firm_chunk=firm_chunk,
-            mesh=mesh,
+            mesh=daily_mesh,
         )
         daily_ids = cd.ids
 

@@ -191,6 +191,25 @@ def test_table2_on_hier_mesh_matches_single_device():
     pd.testing.assert_frame_equal(t2_one, t2_hier)
 
 
+def test_build_panel_on_hier_mesh_matches_single_device():
+    """The whole panel build accepts the 2-D mesh: the daily stage flattens
+    it to one firm axis (zero collectives) and the result matches the
+    single-device build exactly."""
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.pipeline import build_panel
+
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=50, n_months=54))
+    panel_one, _ = build_panel(data)
+    panel_hier, _ = build_panel(data, mesh=make_mesh_2d(month_shards=2))
+    np.testing.assert_allclose(
+        np.asarray(panel_one.values), np.asarray(panel_hier.values),
+        rtol=1e-12, atol=1e-12, equal_nan=True,
+    )
+
+
 def test_bootstrap_on_flattened_hier_mesh(panel):
     """The replicate-sharded bootstrap over as_flat_mesh(2-D) must equal the
     plain 1-D mesh result (same key → same replicate draws)."""
