@@ -188,6 +188,15 @@ def run_pipeline(
             if len(jax.devices()) <= 1:
                 raise RuntimeError("use_mesh=True but only one device is available")
             mesh = make_mesh(axis_name="firms")
+        if mesh is not None and jax.process_count() > 1:
+            # Multi-host run (FMRP_MULTIHOST launcher): use the months×firms
+            # hierarchy so firm-axis collectives stay on ICI and DCN carries
+            # only the per-FM slope gather (parallel.multihost docstring).
+            # Table 2 routes a 2-D mesh through fama_macbeth_hier and the
+            # daily stage flattens it back to one firm axis.
+            from fm_returnprediction_tpu.parallel import make_mesh_2d
+
+            mesh = make_mesh_2d()
 
     with timer.stage("build_panel"):
         panel, factors_dict = build_panel(data, dtype=dtype, mesh=mesh, timer=timer)
