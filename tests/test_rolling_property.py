@@ -1,0 +1,86 @@
+"""Property-based differential tests: rolling ops vs pandas, random shapes.
+
+The fixed-case oracles (`tests/test_rolling_ops.py`) pin the pipeline's
+window/min_periods combinations; these hypothesis sweeps cover the space
+between them — arbitrary windows, min_periods, NaN densities and series
+lengths — against pandas ``rolling`` as the semantics oracle (the reference
+is pandas, SURVEY §2.1 ★ rows). Small example counts keep the 1-core suite
+fast; failures shrink to minimal cases.
+"""
+
+import numpy as np
+import pandas as pd
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.rolling import (
+    rolling_mean,
+    rolling_prod,
+    rolling_std,
+    rolling_sum,
+)
+
+@st.composite
+def _cases(draw):
+    t = draw(st.integers(min_value=1, max_value=40))
+    window = draw(st.integers(min_value=1, max_value=12))
+    # pandas requires min_periods <= window
+    min_periods = draw(st.integers(min_value=1, max_value=window))
+    nan_frac = draw(st.floats(min_value=0.0, max_value=0.6))
+    seed = draw(st.integers(min_value=0, max_value=2**31 - 1))
+    return t, window, min_periods, nan_frac, seed
+
+
+_CASE = _cases()
+
+
+def _series(t, nan_frac, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, 2))
+    x[rng.random((t, 2)) < nan_frac] = np.nan
+    return x
+
+
+def _check(op, pandas_op, t, window, min_periods, nan_frac, seed):
+    x = _series(t, nan_frac, seed)
+    got = np.asarray(op(jnp.asarray(x), window, min_periods))
+    want = pandas_op(pd.DataFrame(x)).to_numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_CASE)
+def test_rolling_sum_matches_pandas(case):
+    t, w, mp, nf, seed = case
+    _check(rolling_sum, lambda df: df.rolling(w, min_periods=mp).sum(),
+           t, w, mp, nf, seed)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_CASE)
+def test_rolling_mean_matches_pandas(case):
+    t, w, mp, nf, seed = case
+    _check(rolling_mean, lambda df: df.rolling(w, min_periods=mp).mean(),
+           t, w, mp, nf, seed)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_CASE)
+def test_rolling_std_matches_pandas(case):
+    t, w, mp, nf, seed = case
+    _check(rolling_std, lambda df: df.rolling(w, min_periods=mp).std(),
+           t, w, mp, nf, seed)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_CASE)
+def test_rolling_prod_matches_pandas(case):
+    """pandas .apply(np.prod) propagates NaN once min_periods non-NaN rows
+    are present (np.prod of a window containing NaN is NaN)."""
+    t, w, mp, nf, seed = case
+    _check(
+        rolling_prod,
+        lambda df: df.rolling(w, min_periods=mp).apply(np.prod, raw=True),
+        t, w, mp, nf, seed,
+    )
