@@ -398,8 +398,12 @@ def main() -> None:
         os.environ.setdefault("FMRP_BENCH_MONTHS", "240")
         os.environ.setdefault("FMRP_BENCH_FIRMS", "2000")
         # one full-scale pass is evidence enough on a host-only run; the
-        # budget skips the warm repeat and records cold + stage breakdown
+        # budget skips the warm repeat and records cold + stage breakdown,
+        # and the standalone daily section is redundant with the real
+        # pipeline's daily stage numbers. The whole fallback run must fit
+        # the driver's bench window — a killed bench records NO artifact.
         os.environ.setdefault("FMRP_BENCH_REAL_BUDGET_S", "300")
+        os.environ.setdefault("FMRP_BENCH_DAILY", "0")
     sections = [_bench_pipeline, _bench_pipeline_real, _bench_kernel]
     if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
         sections.append(_bench_daily_fullscale)
