@@ -57,7 +57,7 @@ def rolling_er_forecast(
     mask: jnp.ndarray,
     window: int = 120,
     min_periods: int = 60,
-    solver: str = "lstsq",
+    solver: str = "qr",
     cs=None,
 ) -> ForecastResult:
     """Strictly out-of-sample Ê[r] from lagged rolling FM coefficients.

@@ -96,7 +96,7 @@ def fama_macbeth(
     nw_lags: int = 4,
     min_months: int = 10,
     weight: str = "reference",
-    solver: str = "lstsq",
+    solver: str = "qr",
 ) -> tuple[CSRegressionResult, FamaMacbethSummary]:
     """End-to-end FM: batched monthly OLS + aggregation, one jittable call."""
     cs = monthly_cs_ols(y, x, mask, solver=solver)

@@ -33,9 +33,11 @@ __all__ = ["build_table_2", "run_model_fm"]
 
 # Table 2's FM hyperparameters, defined ONCE: run_model_fm's defaults and
 # the fused sweep below must stay in lockstep (the reference uses NW lag 4
-# and statsmodels' pinv solve everywhere, src/regressions.py:78-100).
+# and statsmodels' pinv solve everywhere, src/regressions.py:78-100; the
+# "qr" solver is the same minimum-norm solution via MXU-friendly TSQR
+# compression — ops.ols._solve_month).
 TABLE2_NW_LAGS = 4
-TABLE2_SOLVER = "lstsq"
+TABLE2_SOLVER = "qr"
 
 
 @functools.partial(jax.jit, static_argnames=("idxs", "nw_lags", "solver"))

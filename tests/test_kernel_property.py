@@ -63,6 +63,31 @@ def test_monthly_cs_ols_matches_numpy_lstsq(case):
         )
 
 
+def test_qr_solver_matches_lstsq_on_near_singular_months():
+    """The default "qr" solver must reproduce the direct SVD lstsq solution
+    in the boundary regime the reference's gate admits (n = P+1, cond ~ 1e6)
+    — the same bar the sharded TSQR path is held to."""
+    rng = np.random.default_rng(7)
+    t, n, p = 10, 64, 5
+    x = rng.standard_normal((t, n, p))
+    y = rng.standard_normal((t, n))
+    mask = np.ones((t, n), bool)
+    for ti in range(0, t, 2):
+        mask[ti, p + 1:] = False
+        base = rng.standard_normal(p)
+        for r in range(p + 1):
+            x[ti, r] = base + 1e-6 * rng.standard_normal(p)
+    y = np.where(mask, y, np.nan)
+
+    qr = monthly_cs_ols(jnp.asarray(y), jnp.asarray(x), jnp.asarray(mask),
+                        solver="qr")
+    sv = monthly_cs_ols(jnp.asarray(y), jnp.asarray(x), jnp.asarray(mask),
+                        solver="lstsq")
+    assert np.asarray(sv.month_valid).all()
+    drift = np.abs(np.asarray(qr.slopes) - np.asarray(sv.slopes)).max()
+    assert drift < 1e-6, f"qr drifts {drift:.3e} from lstsq"
+
+
 @st.composite
 def _nw_cases(draw):
     t = draw(st.integers(min_value=1, max_value=40))
