@@ -167,16 +167,40 @@ def build_compact_daily(
     ids = permno[change]
     counts = np.diff(np.append(np.flatnonzero(change), len(permno)))
 
-    # day vocabulary + positions: hash-factorize (O(R)) into appearance
-    # order, sort only the ~12.6k distinct days, and remap the codes — a
-    # 70M-row searchsorted into the vocabulary costs ~7s more on one core
-    codes, days_appear = pd.factorize(date_i8, sort=False)
-    day_order = np.argsort(days_appear)
-    days_i8 = days_appear[day_order]
-    remap = np.empty_like(day_order)
-    remap[day_order] = np.arange(len(day_order))
-    pos = remap[codes]
-    days_idx = pd.DatetimeIndex(days_i8.view(date_raw.dtype))
+    # day vocabulary + positions. Fast path: CRSP trading dates are
+    # day-aligned timestamps, so (date - min) // day_step direct-addresses a
+    # tiny calendar-span table — one scatter builds the vocabulary and one
+    # gather assigns positions (measured ~4s vs ~27s for the 70M-row hash
+    # factorize + remap on one core). Misaligned timestamps or absurd spans
+    # fall back to the general hash path with identical semantics
+    # (distinct raw timestamps stay distinct vocabulary entries).
+    _DAY_STEPS = {"D": 1, "s": 86_400, "ms": 86_400_000,
+                  "us": 86_400_000_000, "ns": 86_400_000_000_000}
+    step = _DAY_STEPS.get(np.datetime_data(date_raw.dtype)[0])
+    days_i8 = None
+    if step is not None and len(date_i8):
+        dmin = int(date_i8.min())
+        span = (int(date_i8.max()) - dmin) // step + 1
+        aligned = dmin % step == 0 and span <= 1_000_000
+        if aligned and step > 1:
+            aligned = bool((date_i8 % step == 0).all())
+        if aligned:
+            day_idx = (date_i8 - dmin) // step
+            present = np.zeros(span, dtype=bool)
+            present[day_idx] = True
+            vocab = np.flatnonzero(present)
+            remap_t = np.zeros(span, dtype=np.int32)
+            remap_t[vocab] = np.arange(len(vocab), dtype=np.int32)
+            pos = remap_t[day_idx]
+            days_i8 = vocab * step + dmin
+    if days_i8 is None:
+        codes, days_appear = pd.factorize(date_i8, sort=False)
+        day_order = np.argsort(days_appear)
+        days_i8 = days_appear[day_order]
+        remap = np.empty_like(day_order)
+        remap[day_order] = np.arange(len(day_order))
+        pos = remap[codes]
+    days_idx = pd.DatetimeIndex(np.asarray(days_i8).view(date_raw.dtype))
     n_days = len(days_idx)
     pos_dtype = np.int16 if n_days < np.iinfo(np.int16).max else np.int32
 

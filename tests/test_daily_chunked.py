@@ -341,6 +341,28 @@ def test_compact_builder_dedups_keep_last(rng):
     assert cd.row_values[a] == 0.20  # keep-last won
 
 
+def test_compact_builder_day_vocab_misaligned_timestamps(rng):
+    """Intraday (non-midnight) timestamps take the hash-factorize fallback
+    and stay DISTINCT vocabulary entries — the direct-address day table must
+    not silently bucket them into calendar days."""
+    import pandas as pd
+
+    from fm_returnprediction_tpu.panel.daily import build_compact_daily
+
+    ts = pd.to_datetime(
+        ["2000-01-03 00:00", "2000-01-03 10:30", "2000-01-04 00:00"]
+    )
+    crsp_d = pd.DataFrame(
+        {"permno": [1, 1, 1], "dlycaldt": ts, "retx": [0.1, 0.2, 0.3]}
+    )
+    idx = pd.DataFrame({"caldt": ts, "vwretx": [0.0, 0.0, 0.0]})
+    months = np.asarray(pd.to_datetime(["2000-01-31"]))
+    cd = build_compact_daily(crsp_d, idx, months)
+    assert cd.n_days == 3  # two same-day timestamps remain distinct
+    assert list(cd.row_pos) == [0, 1, 2]
+    np.testing.assert_array_equal(np.asarray(cd.days), np.asarray(ts))
+
+
 def test_beta_all_null_market_window_nan(rng):
     """A window whose rows all lack market returns has cov = var = 0 exactly
     (polars: 0/0 = null); the cumsum-difference residuals must not turn it
