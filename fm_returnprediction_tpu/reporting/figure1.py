@@ -98,9 +98,11 @@ def subset_sweep(
     """Run the fused figure/decile program over ``names`` and return numpy
     results per subset (one ``device_get`` for everything)."""
     xvars = list(FIGURE1_VARS.keys())
+    names = [n for n in names if n in subset_masks]
+    if not names:
+        return {}
     y = jnp.asarray(panel.var(return_col))
     x = jnp.asarray(panel.select(xvars))
-    names = [n for n in names if n in subset_masks]
     stacked = jnp.stack([jnp.asarray(subset_masks[n]) for n in names])
     out = jax.device_get(
         _subset_sweep_device(
