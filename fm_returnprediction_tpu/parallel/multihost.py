@@ -102,7 +102,14 @@ def initialize_multihost(
     """
     explicit = coordinator_address is not None or num_processes is not None
     wanted = explicit or os.environ.get("FMRP_MULTIHOST", "0") == "1"
-    if wanted and not _distributed_client_active():
+    if not wanted:
+        # Do NOT query process coordinates here: jax.process_count()
+        # initializes the XLA backends, which (a) would pin the platform
+        # before apply_backend() gets a say and (b) dials remote
+        # accelerator runtimes at CLI startup even for pure --list
+        # invocations. Single-process is the documented answer.
+        return 0, 1
+    if not _distributed_client_active():
         if explicit:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -164,6 +164,50 @@ def test_synthetic_dag_end_to_end(tmp_path, monkeypatch):
         assert skipped
 
 
+@pytest.mark.slow
+def test_cli_main_runs_list_and_tasks(tmp_path):
+    """The ``python -m fm_returnprediction_tpu.taskgraph`` entry point
+    (argument parsing, multihost hook, backend/compilation-cache setup,
+    runner wiring) in a clean subprocess — the path the README advertises."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DATA_DIR": str(tmp_path / "data"),
+        "OUTPUT_DIR": str(tmp_path / "out"),
+        "JAX_CACHE_DIR": str(tmp_path / "jaxcache"),
+    }
+    # drop injected sitecustomize hooks that dial a remote accelerator at
+    # interpreter start (same hermeticity rule as tests/test_graft_entry.py)
+    if "PYTHONPATH" in env:
+        parts = [
+            p for p in env["PYTHONPATH"].split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(parts) if parts else ""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    listing = subprocess.run(
+        [sys.executable, "-m", "fm_returnprediction_tpu.taskgraph",
+         "--list", "--synthetic", "--db", str(tmp_path / "db.sqlite")],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert listing.returncode == 0, listing.stderr[-500:]
+    for name in ("config", "pull_data", "build_panel", "reports", "latex"):
+        assert name in listing.stdout
+
+    run = subprocess.run(
+        [sys.executable, "-m", "fm_returnprediction_tpu.taskgraph",
+         "--synthetic", "--db", str(tmp_path / "db.sqlite"), "pull_data"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert run.returncode == 0, run.stderr[-500:]
+    raw = tmp_path / "data" / "raw"
+    assert any(raw.glob("*.parquet")), "pull_data produced no cache files"
+
+
 def test_dense_panel_checkpoint_roundtrip(tmp_path):
     import numpy as np
 
