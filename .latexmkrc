@@ -1,0 +1,5 @@
+# Keep the LaTeX build tidy when compiling _output/research_report.tex with
+# latexmk (reporting/latex.py runs plain pdflatex twice; this file serves
+# users who prefer latexmk, as the reference's .latexmkrc does).
+$clean_ext = "synctex.gz nav snm thm soc loc glg acn vrb";
+$bibtex_use = 2;
