@@ -73,18 +73,21 @@ def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
 
 
 def _pallas_default() -> bool:
-    """Use the fused pallas path on real TPU backends unless overridden via
-    ``FMRP_PALLAS=0/1``. CPU (the parity-test backend) keeps the XLA path —
-    the pallas kernel is exercised there separately in interpret mode."""
+    """Whether ``rolling_std`` dispatches to the fused pallas kernel.
+
+    Opt-in via ``FMRP_PALLAS=1`` (or forced off with ``0``). Default OFF:
+    the round-2 three-output kernel was advertised as a win but measured
+    0.95× vs XLA on hardware; the rebuilt fully fused kernel (one HBM
+    read, one write — ``ops.pallas_kernels``) should beat the cumsum path,
+    but "should" is not a recorded artifact. ``bench.py`` measures the
+    pallas-vs-XLA ratio on every TPU round regardless of this default —
+    the default flips on when a recorded BENCH artifact shows > 1×."""
     import os
 
     flag = os.environ.get("FMRP_PALLAS")
     if flag is not None:
         return flag.strip().lower() in ("1", "true", "yes", "on")
-    try:
-        return jax.devices()[0].platform not in ("cpu",)
-    except RuntimeError:
-        return False
+    return False
 
 
 def rolling_std(
@@ -92,13 +95,15 @@ def rolling_std(
 ) -> jnp.ndarray:
     """pandas ``.rolling(window, min_periods).std()`` (ddof=1) on axis 0.
 
-    On TPU this dispatches to the fully fused pallas kernel
-    (``ops.pallas_kernels.rolling_std_fused``): one HBM read of ``x`` and
-    one write of the finished std, vs the several masked/squared/counted
-    intermediates plus windowed differencing of the XLA cumsum path. (The
-    round-2 three-output version measured 0.95× vs XLA — BENCH_r02 — which
-    is why the kernel now fuses the differencing and finalization too; the
-    current measurement lands in the latest BENCH artifact via bench.py.)
+    With ``use_pallas`` (or ``FMRP_PALLAS=1``) this dispatches to the fully
+    fused pallas kernel (``ops.pallas_kernels.rolling_std_fused``): one HBM
+    read of ``x`` and one write of the finished std, vs the several
+    masked/squared/counted intermediates plus windowed differencing of the
+    XLA cumsum path. The default stays on XLA until a recorded BENCH
+    artifact shows the fused kernel > 1× on TPU (the round-2 three-output
+    version measured 0.95× — BENCH_r02 — which is why the kernel now fuses
+    the differencing and finalization too; ``bench.py`` measures both paths
+    every TPU round).
     """
     if use_pallas is None:
         use_pallas = x.ndim == 2 and _pallas_default()
