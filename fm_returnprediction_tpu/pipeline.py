@@ -67,6 +67,7 @@ class PipelineResult:
     figure_1: Optional[tuple]
     timer: StageTimer
     decile_table: Optional[pd.DataFrame] = None
+    bootstrap_table: Optional[pd.DataFrame] = None
 
 
 # The daily stage consumes only (permno, dlycaldt, retx); the universe
@@ -145,6 +146,8 @@ def run_pipeline(
     make_figure: bool = True,
     compile_pdf: bool = True,
     make_deciles: bool = True,
+    make_bootstrap: bool = False,
+    bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
@@ -241,11 +244,33 @@ def run_pipeline(
         with timer.stage("decile_table"):
             decile_table = build_decile_table(panel, subset_masks, cs_cache=cs_cache)
 
+    bootstrap_table = None
+    if make_bootstrap:
+        from fm_returnprediction_tpu.parallel import as_flat_mesh
+        from fm_returnprediction_tpu.reporting.bootstrap_table import (
+            build_bootstrap_table,
+        )
+
+        with timer.stage("bootstrap_table"):
+            boot_mesh = None
+            if mesh is not None:
+                boot_mesh = as_flat_mesh(mesh, axis_name="boot")
+            bootstrap_table = build_bootstrap_table(
+                panel, subset_masks, factors_dict,
+                n_replicates=bootstrap_replicates, mesh=boot_mesh,
+            )
+
     if output_dir is not None:
         with timer.stage("save_artifacts"):
             save_data(table_1, table_2, figure_1, output_dir)
             if decile_table is not None:
                 save_decile_table(decile_table, output_dir)
+            if bootstrap_table is not None:
+                from fm_returnprediction_tpu.reporting.bootstrap_table import (
+                    save_bootstrap_table,
+                )
+
+                save_bootstrap_table(bootstrap_table, output_dir)
             tex = create_latex_document(output_dir)
             if tex is not None and compile_pdf:
                 compile_latex_document(tex)
@@ -259,6 +284,7 @@ def run_pipeline(
         figure_1=figure_1,
         timer=timer,
         decile_table=decile_table,
+        bootstrap_table=bootstrap_table,
     )
 
 

@@ -1,0 +1,111 @@
+"""Bootstrap standard-error table — the north-star config as an artifact.
+
+The reference reports only analytic Newey-West t-statistics
+(``src/regressions.py:78-100``); the north-star workload adds a
+10k-replicate moving-block bootstrap of the monthly slope series
+(BASELINE.json configs[4], ``parallel.bootstrap``). This module surfaces
+that computation as a reporting artifact: per (model, subset, predictor),
+the FM coefficient, the bootstrap SE of its mean, the bootstrap t, and the
+analytic NW t alongside — one table, same layout vocabulary as Table 2.
+
+Kept OUT of Table 2 itself: the reference's layout contract fixes Table 2's
+columns to {Slope, t-stat, R^2} (``src/calc_Lewellen_2014.py:714-868``),
+so the bootstrap gets its own frame and its own files
+(``bootstrap_se.pkl`` / ``bootstrap_se.tex``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.models.lewellen import MODELS
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
+from fm_returnprediction_tpu.parallel.bootstrap import block_bootstrap_se
+from fm_returnprediction_tpu.reporting.table2 import (
+    TABLE2_NW_LAGS,
+    _model_columns,
+)
+
+__all__ = ["build_bootstrap_table", "save_bootstrap_table"]
+
+
+def build_bootstrap_table(
+    panel: DensePanel,
+    subset_masks: Dict,
+    variables_dict: Dict[str, str],
+    n_replicates: int = 10_000,
+    block_length: int = TABLE2_NW_LAGS + 1,
+    seed: int = 0,
+    models: Optional[list] = None,
+    mesh=None,
+    return_col: str = "retx",
+) -> pd.DataFrame:
+    """Per (model, subset, predictor): coef, bootstrap SE/t, NW t.
+
+    Replicates shard over ``mesh`` when given (1-D replicate mesh or any
+    mesh's devices via the caller flattening). Deterministic in ``seed``.
+    """
+    models = models if models is not None else MODELS
+    subset_names = [s for s in SUBSET_ORDER if s in subset_masks]
+    y = jnp.asarray(panel.var(return_col))
+
+    rows = []
+    for model in models:
+        x = jnp.asarray(panel.select(_model_columns(model, variables_dict)))
+        for subset_name in subset_names:
+            cs, fm = fama_macbeth(
+                y, x, jnp.asarray(subset_masks[subset_name]),
+                nw_lags=TABLE2_NW_LAGS,
+            )
+            slope_valid = cs.month_valid[:, None] & jnp.isfinite(cs.slopes)
+            boot = block_bootstrap_se(
+                cs.slopes, slope_valid, jax.random.key(seed),
+                n_replicates=n_replicates, block_length=block_length,
+                mesh=mesh,
+            )
+            coef = np.asarray(fm.coef)
+            nw_t = np.asarray(fm.tstat)
+            se = np.asarray(boot.se)
+            for i, label in enumerate(model.predictors):
+                rows.append({
+                    "Model": model.name,
+                    "Predictor": label,
+                    "Subset": subset_name,
+                    "Slope": coef[i],
+                    "Boot SE": se[i],
+                    "t (boot)": coef[i] / se[i] if se[i] > 0 else np.nan,
+                    "t (NW)": nw_t[i],
+                })
+
+    table = pd.DataFrame(rows).pivot(
+        index=["Model", "Predictor"],
+        columns="Subset",
+        values=["Slope", "Boot SE", "t (boot)", "t (NW)"],
+    )
+    table = table.swaplevel(0, 1, axis=1)
+    table = table.reindex(labels=subset_names, axis=1, level=0)
+    table = table.reindex(
+        labels=["Slope", "Boot SE", "t (boot)", "t (NW)"], axis=1, level=1
+    )
+    row_order = [
+        (m.name, label) for m in models for label in m.predictors
+    ]
+    return table.reindex(row_order)
+
+
+def save_bootstrap_table(table: pd.DataFrame, output_dir) -> None:
+    from pathlib import Path
+
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    table.to_pickle(out / "bootstrap_se.pkl")
+    (out / "bootstrap_se.tex").write_text(
+        table.map(lambda v: f"{float(v):.4f}" if pd.notna(v) else "").to_latex()
+    )

@@ -1,0 +1,61 @@
+"""Bootstrap SE reporting artifact (BASELINE configs[4] as a table)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.synthetic import (
+    SyntheticConfig,
+    generate_synthetic_wrds,
+)
+from fm_returnprediction_tpu.models.lewellen import MODELS
+from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+from fm_returnprediction_tpu.pipeline import build_panel, run_pipeline
+from fm_returnprediction_tpu.reporting.bootstrap_table import (
+    build_bootstrap_table,
+)
+
+
+@pytest.fixture(scope="module")
+def built():
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=50, n_months=60))
+    panel, factors = build_panel(data)
+    return panel, factors, compute_subset_masks(panel)
+
+
+def test_bootstrap_table_layout_and_determinism(built):
+    panel, factors, masks = built
+    t1 = build_bootstrap_table(panel, masks, factors, n_replicates=64, seed=3)
+    t2 = build_bootstrap_table(panel, masks, factors, n_replicates=64, seed=3)
+    pd.testing.assert_frame_equal(t1, t2)  # key-deterministic
+
+    n_rows = sum(len(m.predictors) for m in MODELS)
+    assert t1.shape == (n_rows, 3 * 4)
+    stats = [c[1] for c in t1.columns[:4]]
+    assert stats == ["Slope", "Boot SE", "t (boot)", "t (NW)"]
+
+    # Model-1 cells (few predictors, well-populated months) must be finite,
+    # with positive SEs and boot-t on the same scale as NW-t.
+    m1 = t1.loc[MODELS[0].name]
+    sub = t1.columns.levels[0][0]
+    assert np.isfinite(m1[(sub, "Slope")].astype(float)).all()
+    assert (m1[(sub, "Boot SE")].astype(float) > 0).all()
+    ratio = (
+        m1[(sub, "t (boot)")].astype(float).abs()
+        / m1[(sub, "t (NW)")].astype(float).abs()
+    )
+    assert ((ratio > 0.2) & (ratio < 5.0)).all(), ratio.to_list()
+
+
+def test_bootstrap_table_through_pipeline(tmp_path):
+    res = run_pipeline(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=40, n_months=48),
+        make_figure=False, make_deciles=False, compile_pdf=False,
+        make_bootstrap=True, bootstrap_replicates=32,
+        output_dir=tmp_path,
+    )
+    assert res.bootstrap_table is not None
+    assert "bootstrap_table" in res.timer.durations
+    assert (tmp_path / "bootstrap_se.pkl").exists()
+    assert (tmp_path / "bootstrap_se.tex").exists()
