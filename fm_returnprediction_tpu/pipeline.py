@@ -303,6 +303,10 @@ def _main() -> None:
     parser.add_argument(
         "--months", type=int, default=None, help="synthetic only (default 120)"
     )
+    parser.add_argument(
+        "--bootstrap", type=int, default=0, metavar="B",
+        help="also build the bootstrap-SE table with B replicates",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -322,6 +326,8 @@ def _main() -> None:
         output_dir=args.output_dir,
         synthetic=args.synthetic,
         synthetic_config=cfg if args.synthetic else None,
+        make_bootstrap=args.bootstrap > 0,
+        bootstrap_replicates=args.bootstrap or 10_000,
     )
     print(result.table_1.round(3).to_string())
     print()
