@@ -86,6 +86,29 @@ def test_hier_fm_matches_single_chip(panel, month_shards):
     )
 
 
+def test_hier_fm_gram_fast_path_matches_single_chip(panel):
+    """n_refine=0 selects the Gram/psum fast path inside the 2-D mesh; on
+    well-conditioned panels it must agree with the single-chip solver."""
+    y, x, mask = panel
+    mesh = make_mesh_2d(month_shards=2)
+    _, fm_h = fama_macbeth_hier(y, x, mask, mesh=mesh, n_refine=0)
+    _, fm_1 = fama_macbeth(y, x, mask)
+    np.testing.assert_allclose(
+        np.asarray(fm_h.coef), np.asarray(fm_1.coef), rtol=1e-6, atol=1e-9
+    )
+
+
+def test_hier_fm_default_mesh(panel):
+    """mesh=None self-builds the (process_count, local) hierarchy — a (1, 8)
+    mesh on a single process — and still matches the single-chip result."""
+    y, x, mask = panel
+    cs_h, fm_h = fama_macbeth_hier(y, x, mask)
+    _, fm_1 = fama_macbeth(y, x, mask)
+    np.testing.assert_allclose(
+        np.asarray(fm_h.coef), np.asarray(fm_1.coef), rtol=1e-6, atol=1e-9
+    )
+
+
 def test_hier_fm_month_padding(panel):
     """A month count that does not divide the 4-row month axis pads up;
     padded months must be invisible (exactly like reference-skipped months)
