@@ -186,20 +186,25 @@ def run_pipeline(
 
         from fm_returnprediction_tpu.parallel import default_mesh, make_mesh
 
-        mesh = default_mesh()  # opt-in via MESH_DEVICES (None when 1)
-        if use_mesh and mesh is None:
-            if len(jax.devices()) <= 1:
-                raise RuntimeError("use_mesh=True but only one device is available")
-            mesh = make_mesh(axis_name="firms")
-        if mesh is not None and jax.process_count() > 1:
+        if jax.process_count() > 1:
             # Multi-host run (FMRP_MULTIHOST launcher): use the months×firms
             # hierarchy so firm-axis collectives stay on ICI and DCN carries
             # only the per-FM slope gather (parallel.multihost docstring).
+            # Built unconditionally — MESH_DEVICES=1 must not leave every
+            # host running a redundant full single-device pipeline copy.
             # Table 2 routes a 2-D mesh through fama_macbeth_hier and the
             # daily stage flattens it back to one firm axis.
             from fm_returnprediction_tpu.parallel import make_mesh_2d
 
             mesh = make_mesh_2d()
+        else:
+            mesh = default_mesh()  # opt-in via MESH_DEVICES (None when 1)
+            if use_mesh and mesh is None:
+                if len(jax.devices()) <= 1:
+                    raise RuntimeError(
+                        "use_mesh=True but only one device is available"
+                    )
+                mesh = make_mesh(axis_name="firms")
 
     with timer.stage("build_panel"):
         panel, factors_dict = build_panel(data, dtype=dtype, mesh=mesh, timer=timer)
@@ -260,7 +265,12 @@ def run_pipeline(
                 n_replicates=bootstrap_replicates, mesh=boot_mesh,
             )
 
-    if output_dir is not None:
+    # In a multi-host run every process reaches this point with identical
+    # (replicated) tables; only process 0 may touch the shared filesystem —
+    # concurrent identical writes + pdflatex runs race on a pod-mounted dir.
+    import jax
+
+    if output_dir is not None and jax.process_index() == 0:
         with timer.stage("save_artifacts"):
             save_data(table_1, table_2, figure_1, output_dir)
             if decile_table is not None:

@@ -38,10 +38,14 @@ __all__ = ["build_table_2", "run_model_fm"]
 # compression — ops.ols._solve_month).
 TABLE2_NW_LAGS = 4
 TABLE2_SOLVER = "qr"
+TABLE2_MIN_MONTHS = 10
+TABLE2_WEIGHT = "reference"
 
 
-@functools.partial(jax.jit, static_argnames=("idxs", "nw_lags", "solver"))
-def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver):
+@functools.partial(
+    jax.jit, static_argnames=("idxs", "nw_lags", "solver", "min_months", "weight")
+)
+def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver, min_months, weight):
     """Every (model, subset) FM summary in ONE compiled program.
 
     The 3×3 sweep as separate calls costs 9 dispatches plus ~4 small
@@ -57,7 +61,8 @@ def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver):
         out.append(
             jax.vmap(
                 lambda m, _x=x: fama_macbeth(
-                    y, _x, m, nw_lags=nw_lags, solver=solver
+                    y, _x, m, nw_lags=nw_lags, solver=solver,
+                    min_months=min_months, weight=weight,
                 )[1]
             )(masks)
         )
@@ -82,6 +87,8 @@ def run_model_fm(
     return_col: str = "retx",
     nw_lags: int = TABLE2_NW_LAGS,
     solver: str = TABLE2_SOLVER,
+    min_months: int = TABLE2_MIN_MONTHS,
+    weight: str = TABLE2_WEIGHT,
     mesh=None,
     y: Optional[jnp.ndarray] = None,
     x: Optional[jnp.ndarray] = None,
@@ -90,17 +97,31 @@ def run_model_fm(
 
     With ``mesh`` the firm axis shards across devices (TSQR path,
     ``parallel.fm_sharded``); otherwise the single-device batched solver
-    runs with the requested ``solver``. ``y``/``x`` accept device-resident
-    precomputed tensors so sweep callers can push the predictor union once
-    and slice per model on device. ``build_table_2`` routes through this
-    function on the mesh path; its single-device path uses the fused
-    ``_fm_sweep`` program instead (one dispatch for all 9 cells) with the
-    same ``TABLE2_*`` hyperparameters, so results are identical."""
+    runs with the requested ``solver``. The sharded paths implement the
+    "qr" (distributed TSQR) and "normal" (psum'd Gram) routes; "lstsq"
+    (direct SVD) exists only single-device, so requesting it with a mesh
+    raises instead of silently running a different solver. ``y``/``x`` accept
+    device-resident precomputed tensors so sweep callers can push the
+    predictor union once and slice per model on device. ``build_table_2``
+    routes through this function on the mesh path; its single-device path
+    uses the fused ``_fm_sweep`` program instead (one dispatch for all 9
+    cells) with the same ``TABLE2_*`` hyperparameters, so results are
+    identical."""
     if y is None:
         y = jnp.asarray(panel.var(return_col))
     if x is None:
         x = jnp.asarray(panel.select(_model_columns(model, variables_dict)))
     mask = jnp.asarray(subset_mask)
+    if mesh is not None:
+        # parallel.fm_sharded/_hier pick TSQR vs Gram via n_refine
+        # (0 = Gram normal equations, >=1 = TSQR): map the solver name so a
+        # caller-supplied solver is honored, not dropped.
+        if solver not in ("qr", "normal"):
+            raise ValueError(
+                f"solver={solver!r} is not available on a sharded mesh; "
+                "use 'qr' (distributed TSQR) or 'normal' (psum'd Gram)"
+            )
+        n_refine = 0 if solver == "normal" else 2
     if mesh is not None and len(mesh.shape) == 2:
         # 2-D months×firms mesh (a pod): months across hosts over DCN,
         # firm collectives pinned to ICI (parallel.multihost docstring).
@@ -109,13 +130,20 @@ def run_model_fm(
         month_axis, firm_axis = mesh.axis_names
         return fama_macbeth_hier(
             y, x, mask, mesh=mesh, month_axis=month_axis,
-            firm_axis=firm_axis, nw_lags=nw_lags,
+            firm_axis=firm_axis, nw_lags=nw_lags, min_months=min_months,
+            weight=weight, n_refine=n_refine,
         )
     if mesh is not None:
         from fm_returnprediction_tpu.parallel import fama_macbeth_sharded
 
-        return fama_macbeth_sharded(y, x, mask, mesh=mesh, nw_lags=nw_lags)
-    return fama_macbeth(y, x, mask, nw_lags=nw_lags, solver=solver)
+        return fama_macbeth_sharded(
+            y, x, mask, mesh=mesh, nw_lags=nw_lags, min_months=min_months,
+            weight=weight, n_refine=n_refine,
+        )
+    return fama_macbeth(
+        y, x, mask, nw_lags=nw_lags, min_months=min_months, weight=weight,
+        solver=solver,
+    )
 
 
 def build_table_2(
@@ -151,7 +179,8 @@ def build_table_2(
         stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
         summaries = jax.device_get(
             _fm_sweep(y, x_all, stacked, idxs,
-                      nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER)
+                      nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER,
+                      min_months=TABLE2_MIN_MONTHS, weight=TABLE2_WEIGHT)
         )
         cells = {
             (mi, name): jax.tree.map(lambda leaf, _si=si: leaf[_si], summaries[mi])

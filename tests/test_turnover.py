@@ -31,48 +31,77 @@ def built():
     return data, panel, factors
 
 
-def _oracle_turnover(crsp_m: pd.DataFrame) -> pd.DataFrame:
-    """Reference-formula transcription on the raw monthly frame."""
-    df = crsp_m.sort_values(["permno", "mthcaldt"]).copy()
+def _oracle_turnover_panel(crsp_m: pd.DataFrame, panel) -> np.ndarray:
+    """Pandas transcription of the panel's turnover on the panel's own rows.
+
+    The characteristic rolls over COMPACTED rows — the sequence of months a
+    firm is actually present in the panel (pandas row semantics, same as
+    every other monthly characteristic) — so the oracle scatters the raw
+    monthly turnover into the (T, N) panel layout and rolls each firm's
+    present-row sequence with an independent pandas shift+rolling."""
+    mask = np.asarray(panel.mask)
+    months = pd.DatetimeIndex(panel.months)
+    t_index = {m: i for i, m in enumerate(months)}
+    n_index = {p: i for i, p in enumerate(panel.ids)}
+
+    turn = np.full(mask.shape, np.nan)
+    df = crsp_m.copy()
     df["turn"] = df["vol"] / (df["shrout"] * 1000.0)
-    df["turnover_12"] = df.groupby("permno")["turn"].transform(
-        lambda s: s.shift(1).rolling(12, min_periods=12).mean()
-    )
-    return df
+    for row in df.itertuples():
+        ti = t_index.get(row.mthcaldt)
+        ni = n_index.get(row.permno)
+        if ti is not None and ni is not None and mask[ti, ni]:
+            turn[ti, ni] = row.turn
+
+    out = np.full(mask.shape, np.nan)
+    for ni in range(mask.shape[1]):
+        rows = np.flatnonzero(mask[:, ni])
+        if rows.size == 0:
+            continue
+        rolled = (
+            pd.Series(turn[rows, ni]).shift(1).rolling(12, min_periods=12).mean()
+        )
+        out[rows, ni] = rolled.to_numpy()
+    return out
 
 
 def test_turnover_matches_pandas_oracle(built):
+    """Every panel cell is asserted: the oracle reproduces the raw rolling
+    turnover AND the per-month [1%, 99%] winsorization (min 5 obs,
+    ``ops.quantiles.winsorize_cs`` semantics), so in-bounds cells must agree
+    to 1e-9 and clipped cells must land exactly on the computed bound — a
+    systematic error anywhere can no longer hide behind a match count."""
     data, panel, factors = built
     assert factors[TURNOVER_LABEL] == TURNOVER_COLUMN
     got = np.asarray(panel.var(TURNOVER_COLUMN))
-
-    # The panel keeps one representative permno per (permco, month) (ME
-    # dedup), so compare only rows present in the dense panel.
-    oracle = _oracle_turnover(data["crsp_m"])
-    months = pd.DatetimeIndex(panel.months)
-    ids = panel.ids
-    t_index = {m: i for i, m in enumerate(months)}
-    n_index = {p: i for i, p in enumerate(ids)}
-
-    checked = 0
     mask = np.asarray(panel.mask)
-    for row in oracle.itertuples():
-        ti = t_index.get(row.mthcaldt)
-        ni = n_index.get(row.permno)
-        if ti is None or ni is None or not mask[ti, ni]:
+
+    want_raw = _oracle_turnover_panel(data["crsp_m"], panel)
+
+    # Reproduce the pipeline's winsorization in the oracle: per month,
+    # 1st/99th percentile (linear interpolation) over the finite masked
+    # cross-section, clip when >= 5 observations, passthrough otherwise.
+    want = want_raw.copy()
+    n_clipped = 0
+    for ti in range(got.shape[0]):
+        ok = mask[ti] & np.isfinite(want_raw[ti])
+        if ok.sum() < 5:
             continue
-        want = row.turnover_12
-        have = got[ti, ni]
-        if np.isnan(want):
-            assert np.isnan(have), (row.permno, row.mthcaldt, have)
-        else:
-            # winsorize clips the cross-sectional tails — values inside the
-            # clip bounds must match exactly; clipped ones must not exceed
-            # the unclipped oracle magnitude ordering. Check unclipped rows
-            # by tolerance and count them.
-            if np.isfinite(have) and abs(have - want) < 1e-9:
-                checked += 1
-    assert checked > 200, f"only {checked} turnover cells matched unclipped"
+        lo, hi = np.percentile(want_raw[ti][ok], [1.0, 99.0])
+        clipped = np.clip(want_raw[ti], lo, hi)
+        n_clipped += int((clipped[ok] != want_raw[ti][ok]).sum())
+        want[ti] = np.where(ok, clipped, want_raw[ti])
+
+    in_panel = mask & np.isfinite(want)
+    assert in_panel.sum() > 200  # the fixture must exercise a real panel
+    np.testing.assert_allclose(
+        got[in_panel], want[in_panel], rtol=0, atol=1e-9
+    )
+    # NaN cells (warm-up months, gaps) must be NaN in the panel too.
+    nan_cells = mask & np.isnan(want)
+    assert np.isnan(got[nan_cells]).all()
+    # The bound-clamping branch must actually have been exercised.
+    assert n_clipped > 0, "fixture never clipped a cell; winsorize untested"
 
 
 def test_turnover_absent_by_default(built):
