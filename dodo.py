@@ -1,0 +1,84 @@
+"""doit-compatible entry point (drop-in parity with the reference's
+``dodo.py`` / ``README.md`` "run `doit`" workflow).
+
+The native runner is ``python -m fm_returnprediction_tpu.taskgraph`` — the
+in-repo engine reimplements doit's file_dep/targets/uptodate semantics
+because doit is not part of this environment. When doit IS installed (a
+user coming from the reference toolchain), this shim exposes the SAME task
+graph to it: every ``taskgraph.tasks`` Task maps 1:1 onto a doit task dict,
+so ``doit``, ``doit list``, ``doit reports`` etc. behave like the
+reference's build (reference ``dodo.py:115-206``).
+
+Environment knobs (same settings layer as the native runner):
+
+- ``FMRP_SYNTHETIC=1`` — build from the hermetic synthetic universe instead
+  of WRDS pulls (no credentials needed);
+- the usual ``.env`` keys (DATA_DIR, OUTPUT_DIR, BACKEND, ...).
+
+Run directly (``python dodo.py``) it prints the native-runner pointer
+rather than silently doing nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _doit_dict(task) -> dict:
+    """One ``taskgraph.engine.Task`` → a doit task dict.
+
+    The field names already match (the engine mirrors doit's contract);
+    only Path coercion and doit's basename/doc conventions are added.
+    """
+    d = {
+        "actions": list(task.actions),
+        "file_dep": [str(p) for p in task.file_dep],
+        "targets": [str(p) for p in task.targets],
+        "task_dep": list(task.task_dep),
+        "doc": task.doc,
+        "verbosity": 2,
+    }
+    if task.uptodate:
+        d["uptodate"] = list(task.uptodate)
+    return d
+
+
+def _all_tasks():
+    from fm_returnprediction_tpu.settings import apply_backend
+    from fm_returnprediction_tpu.taskgraph.tasks import (
+        build_notebook_tasks,
+        build_tasks,
+    )
+
+    apply_backend()
+    synthetic = os.environ.get("FMRP_SYNTHETIC", "0") == "1"
+    return build_tasks(synthetic=synthetic) + build_notebook_tasks()
+
+
+def _make_creator(task):
+    def creator():
+        return _doit_dict(task)
+
+    creator.__name__ = f"task_{task.name}"
+    creator.__doc__ = task.doc
+    return creator
+
+
+# doit discovers module-level ``task_*`` callables; generate one per graph
+# node so ``doit list`` shows the same task names as the native runner.
+for _t in _all_tasks():
+    globals()[f"task_{_t.name}"] = _make_creator(_t)
+del _t
+
+
+if __name__ == "__main__":
+    try:
+        from doit.doit_cmd import DoitMain
+
+        raise SystemExit(DoitMain().run(["run"]))
+    except ImportError:
+        print(
+            "doit is not installed. Use the native runner instead:\n"
+            "    python -m fm_returnprediction_tpu.taskgraph [task ...]\n"
+            "(same DAG, same semantics; this dodo.py is a doit-compat shim)."
+        )

@@ -1,0 +1,167 @@
+"""Prepared-inputs checkpoint: skip the host ingest path on warm runs.
+
+At real 1964-2013 CRSP shape, ~98 s of the end-to-end wall-clock is
+host-side pandas/parquet work a TPU cannot touch: reading the 77M-row daily
+parquet, the common-stock/exchange universe filter, the monthly relational
+transforms, and the long→compact daily ingest (BENCH_r03
+``real_pipeline_stage_s``). All of it is a pure function of the five raw
+cache files, so the pipeline checkpoints its two host products:
+
+- ``monthly_merged.parquet`` — the merged CRSP×Compustat monthly frame
+  (post universe filter, market equity, book equity, CCM merge): the input
+  to ``panel.characteristics.get_factors``;
+- ``compact_daily.npz``     — the per-firm compacted daily strips + the
+  shared calendar vectors (``panel.daily.CompactDaily``): the input to the
+  daily vol/beta kernels.
+
+A warm run loads these two files (IO-bound, seconds) instead of redoing the
+ingest (~76 s of the ~98 s), which is the difference between the <60 s
+north-star budget being reachable and not. This extends the reference's
+cache-as-checkpoint role (``/root/reference/src/utils.py:183-218`` caches
+raw pulls; every transform recomputes each run) one stage further, the same
+way the task graph's dense-panel npz does between build and report stages.
+
+Validity is a fingerprint over the raw files' (name, size, mtime) plus the
+compute dtype and a layout version — the make-style staleness contract: any
+re-pull or re-generation of the raw caches invalidates the checkpoint. One
+slot per raw directory (``<raw_dir>/_prepared/``), overwritten in place;
+``meta.json`` is written last (tmp + rename), so a crashed writer leaves a
+stale fingerprint, never a half-valid checkpoint. Set ``PREPARED_CACHE=0``
+to disable both reading and writing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.panel.daily import CompactDaily
+
+__all__ = [
+    "PREPARED_DIRNAME",
+    "prepared_enabled",
+    "raw_fingerprint",
+    "save_prepared",
+    "load_prepared",
+]
+
+PREPARED_DIRNAME = "_prepared"
+# Bump when the prepared LAYOUT or the ingest semantics feeding it change —
+# an old checkpoint must not satisfy a new pipeline.
+_VERSION = 1
+
+_MERGED_FILE = "monthly_merged.parquet"
+_DAILY_FILE = "compact_daily.npz"
+_META_FILE = "meta.json"
+
+
+def prepared_enabled() -> bool:
+    """The PREPARED_CACHE switch (default on), env/.env overridable."""
+    from fm_returnprediction_tpu.settings import config
+
+    return bool(int(config("PREPARED_CACHE")))
+
+
+def raw_fingerprint(raw_dir, dtype) -> str:
+    """Staleness key for the checkpoint under ``raw_dir``.
+
+    Hashes each raw cache file's (name, size, mtime_ns) — the make
+    contract: content re-reads would cost a large fraction of what the
+    checkpoint saves. ``dtype`` is in the key because the compact strips are
+    materialized in the compute dtype.
+    """
+    from fm_returnprediction_tpu.pipeline import RAW_FILE_NAMES
+
+    h = hashlib.sha256()
+    h.update(f"v{_VERSION}|{np.dtype(dtype).str}".encode())
+    for name in sorted(RAW_FILE_NAMES.values()):
+        path = Path(raw_dir) / name
+        st = path.stat()  # missing raw file: let the error surface here
+        h.update(f"|{name}:{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()
+
+
+def save_prepared(
+    prepared_dir, fingerprint: str, merged: pd.DataFrame, cd: CompactDaily
+) -> None:
+    """Write the checkpoint; meta (with the fingerprint) goes LAST so a
+    partial write is indistinguishable from a stale one. Failures degrade to
+    a warning — the checkpoint is an accelerant, never a correctness gate."""
+    prepared_dir = Path(prepared_dir)
+    try:
+        prepared_dir.mkdir(parents=True, exist_ok=True)
+        meta = prepared_dir / _META_FILE
+        meta.unlink(missing_ok=True)  # invalidate before touching payloads
+        merged.to_parquet(prepared_dir / _MERGED_FILE, index=False)
+        arrays = {
+            f.name: getattr(cd, f.name)
+            for f in dataclasses.fields(cd)
+            if isinstance(getattr(cd, f.name), np.ndarray)
+        }
+        # datetime64 won't survive npz without a unit side-channel
+        days_unit = np.datetime_data(cd.days.dtype)[0]
+        arrays["days"] = cd.days.astype(np.int64)
+        # savez UNcompressed: the strips are ~0.5 GB of near-incompressible
+        # floats at real shape; zlib would cost more than the ingest it skips
+        np.savez(prepared_dir / _DAILY_FILE, **arrays)
+        tmp = meta.with_suffix(f".tmp{os.getpid()}")  # per-writer tmp name
+        tmp.write_text(json.dumps({
+            "fingerprint": fingerprint,
+            "version": _VERSION,
+            "days_unit": days_unit,
+            "n_weeks": cd.n_weeks,
+            "n_months": cd.n_months,
+        }))
+        os.replace(tmp, meta)
+    except OSError as exc:  # read-only raw dir, disk full, ...
+        import warnings
+
+        warnings.warn(f"prepared-inputs checkpoint not written: {exc!r}",
+                      stacklevel=2)
+
+
+def load_prepared(
+    prepared_dir, fingerprint: str
+) -> Optional[Tuple[pd.DataFrame, CompactDaily]]:
+    """The checkpoint contents iff present and fingerprint-valid, else None."""
+    prepared_dir = Path(prepared_dir)
+    meta_path = prepared_dir / _META_FILE
+    try:
+        meta = json.loads(meta_path.read_text())
+    except (OSError, ValueError):
+        return None
+    if meta.get("version") != _VERSION or meta.get("fingerprint") != fingerprint:
+        return None
+    try:
+        merged = pd.read_parquet(prepared_dir / _MERGED_FILE)
+        with np.load(prepared_dir / _DAILY_FILE, allow_pickle=False) as z:
+            cd = CompactDaily(
+                row_values=z["row_values"],
+                row_pos=z["row_pos"],
+                offsets=z["offsets"],
+                ids=z["ids"],
+                mkt=z["mkt"],
+                mkt_present=z["mkt_present"],
+                days=z["days"].astype(f"datetime64[{meta['days_unit']}]"),
+                day_month_id=z["day_month_id"],
+                week_id=z["week_id"],
+                n_weeks=int(meta["n_weeks"]),
+                week_month_id=z["week_month_id"],
+                n_months=int(meta["n_months"]),
+            )
+    except (OSError, KeyError, ValueError) as exc:
+        import warnings
+
+        warnings.warn(
+            f"prepared-inputs checkpoint unreadable, rebuilding: {exc!r}",
+            stacklevel=2,
+        )
+        return None
+    return merged, cd

@@ -283,15 +283,19 @@ def daily_characteristics_compact_chunked(
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from fm_returnprediction_tpu.parallel.mesh import place_global
+
         strip_sharding = NamedSharding(mesh, P(None, axis_name))
         firm_sharding = NamedSharding(mesh, P(axis_name))
         rep = NamedSharding(mesh, P())
-        # device_put straight from numpy: each device fetches only its shard
+        # placement straight from numpy: each device fetches only its shard
         # from host memory (a jnp.asarray first would commit the full strip
-        # to device 0 and then reshard — double the transfer).
-        place_strip = lambda a: jax.device_put(a, strip_sharding)
-        place_firm = lambda a: jax.device_put(a, firm_sharding)
-        place_rep = lambda a: jax.device_put(np.asarray(a), rep)
+        # to device 0 and then reshard — double the transfer). place_global
+        # rather than device_put: the strips are NaN-padded, which the
+        # cross-process device_put value check cannot compare.
+        place_strip = lambda a: place_global(a, strip_sharding)
+        place_firm = lambda a: place_global(a, firm_sharding)
+        place_rep = lambda a: place_global(np.asarray(a), rep)
     else:
         place_strip = place_firm = place_rep = jnp.asarray
 

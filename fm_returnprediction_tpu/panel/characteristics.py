@@ -196,6 +196,7 @@ def get_factors(
     firm_chunk=None,
     timer=None,
     include_turnover=None,
+    compact_daily=None,
 ) -> Tuple[DensePanel, Dict[str, str]]:
     """Dense-panel equivalent of the reference's ``get_factors``
     (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
@@ -211,6 +212,12 @@ def get_factors(
     16th published-Table-1 characteristic the reference lacks; it requires a
     ``vol`` column in ``crsp_comp`` (the puller adds it, old caches may not
     have it).
+
+    ``compact_daily`` accepts prebuilt strips (``panel.daily.CompactDaily``,
+    e.g. from the prepared-inputs checkpoint, ``data.prepared``); the
+    ``crsp_d``/``crsp_index_d`` frames are then ignored and may be None.
+    Its month vocabulary must be the sorted unique ``jdate`` of
+    ``crsp_comp`` — the vocabulary ``long_to_dense`` derives here.
     """
     if mesh is not None and firm_chunk is not None:
         raise ValueError(
@@ -259,8 +266,16 @@ def get_factors(
         from fm_returnprediction_tpu.parallel import as_flat_mesh
 
         daily_mesh = as_flat_mesh(mesh, axis_name="firms")
-    with timer.stage("factors/daily_ingest"):
-        cd = build_compact_daily(crsp_d, crsp_index_d, panel.months, dtype=dtype)
+    if compact_daily is not None:
+        cd = compact_daily
+        if cd.n_months != len(panel.months):
+            raise ValueError(
+                f"compact_daily was built against {cd.n_months} months but "
+                f"the monthly panel has {len(panel.months)} — stale checkpoint?"
+            )
+    else:
+        with timer.stage("factors/daily_ingest"):
+            cd = build_compact_daily(crsp_d, crsp_index_d, panel.months, dtype=dtype)
     with timer.stage("factors/daily_kernels"):
         vol_np, beta_np = daily_characteristics_compact_chunked(
             cd.row_values, cd.row_pos, cd.offsets, cd.mkt, cd.mkt_present,

@@ -27,6 +27,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
     host_local_mesh,
     make_mesh,
     pad_to_multiple,
+    place_global,
     shard_panel,
 )
 from fm_returnprediction_tpu.parallel.multihost import (
@@ -51,5 +52,6 @@ __all__ = [
     "host_local_mesh",
     "make_mesh",
     "pad_to_multiple",
+    "place_global",
     "shard_panel",
 ]

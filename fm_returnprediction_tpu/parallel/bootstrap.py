@@ -171,16 +171,20 @@ def block_bootstrap_se(
         b = n_replicates
         keys = jax.random.split(key, b)
     else:
+        from fm_returnprediction_tpu.parallel.mesh import place_global
+
         d = mesh.shape[axis_name]
         b = -(-n_replicates // d) * d
-        keys = jax.device_put(
+        keys = place_global(
             jax.random.split(key, b), NamedSharding(mesh, P(axis_name))
         )
         # Replicate the (small) slope series across the mesh so the jitted
         # shard_map sees consistent placements even when slopes arrived
         # committed to a single device (e.g. as another jit's output).
-        slopes = jax.device_put(slopes, NamedSharding(mesh, P()))
-        slope_valid = jax.device_put(slope_valid, NamedSharding(mesh, P()))
+        # place_global, not device_put: slopes carry NaN months, which the
+        # cross-process device_put value check cannot compare.
+        slopes = place_global(slopes, NamedSharding(mesh, P()))
+        slope_valid = place_global(slope_valid, NamedSharding(mesh, P()))
 
     run = _jitted_bootstrap_moments(mesh, block_length, axis_name)
     s1, s2, pilot = run(keys, slopes, slope_valid)

@@ -33,7 +33,7 @@ from fm_returnprediction_tpu.ops.daily_kernels import (
     rolling_vol_252_monthly,
     weekly_rolling_beta_monthly,
 )
-from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple
+from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple, place_global
 
 __all__ = ["daily_characteristics_sharded"]
 
@@ -90,13 +90,13 @@ def daily_characteristics_sharded(
 
     strip = NamedSharding(mesh, P(None, axis_name))
     rep = NamedSharding(mesh, P())
-    ret_d = jax.device_put(ret_d, strip)
-    mask_d = jax.device_put(mask_d, strip)
-    mkt_d = jax.device_put(jnp.asarray(mkt_d), rep)
-    mkt_present = jax.device_put(jnp.asarray(mkt_present), rep)
-    month_id = jax.device_put(jnp.asarray(month_id), rep)
-    week_id = jax.device_put(jnp.asarray(week_id), rep)
-    week_month_id = jax.device_put(jnp.asarray(week_month_id), rep)
+    ret_d = place_global(ret_d, strip)          # NaN-padded: see place_global
+    mask_d = place_global(mask_d, strip)
+    mkt_d = place_global(jnp.asarray(mkt_d), rep)
+    mkt_present = place_global(jnp.asarray(mkt_present), rep)
+    month_id = place_global(jnp.asarray(month_id), rep)
+    week_id = place_global(jnp.asarray(week_id), rep)
+    week_month_id = place_global(jnp.asarray(week_month_id), rep)
 
     run = _jitted_daily(
         mesh, axis_name, int(n_months), int(n_weeks),

@@ -34,9 +34,40 @@ __all__ = [
     "default_mesh",
     "make_mesh",
     "pad_to_multiple",
+    "place_global",
     "shard_panel",
     "host_local_mesh",
 ]
+
+
+def place_global(a, sharding: NamedSharding) -> jax.Array:
+    """Place ``a`` with ``sharding``, working across process boundaries.
+
+    ``jax.device_put`` onto a sharding that spans processes runs a
+    same-value-everywhere assertion that compares host arrays with ``==`` —
+    which trips on NaN (NaN != NaN), and every panel this framework places
+    is NaN-padded. Discovered by the two-process test
+    (``tests/test_multiprocess.py``): the ``place=True`` paths crashed on
+    any real pod. For multi-process shardings, build the global array from
+    local shards with ``make_array_from_callback`` instead — no value
+    check, and each process touches only its addressable slice. The
+    single-process fast path keeps the plain ``device_put``.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(a, sharding)
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        # already a global array spanning processes (e.g. another jit's
+        # output): device_put reshards on-device with no host value check,
+        # and np.asarray would raise on the non-addressable shards anyway
+        return jax.device_put(a, sharding)
+    if not isinstance(a, np.ndarray):
+        try:
+            a = np.asarray(a)
+        except (TypeError, ValueError, RuntimeError):
+            # extended dtypes (typed PRNG keys) have no numpy view; they
+            # also carry no NaN, so the checked device_put path is safe
+            return jax.device_put(a, sharding)
+    return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
 
 
 def make_mesh(
@@ -100,9 +131,9 @@ def shard_panel(y, x, mask, mesh: Mesh, axis_name: str = "firms"):
     s2 = NamedSharding(mesh, P(None, axis_name))
     s3 = NamedSharding(mesh, P(None, axis_name, None))
     return (
-        jax.device_put(y, s2),
-        jax.device_put(x, s3),
-        jax.device_put(mask, s2),
+        place_global(y, s2),
+        place_global(x, s3),
+        place_global(mask, s2),
     )
 
 

@@ -46,7 +46,7 @@ from fm_returnprediction_tpu.ops.fama_macbeth import (
 )
 from fm_returnprediction_tpu.ops.ols import CSRegressionResult
 from fm_returnprediction_tpu.parallel.fm_sharded import cs_ols_kernel
-from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple
+from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple, place_global
 
 __all__ = [
     "initialize_multihost",
@@ -260,9 +260,9 @@ def fama_macbeth_hier(
         mask = pad_to_multiple(mask, axis=1, multiple=d, fill=False)
         s2 = NamedSharding(mesh, P(month_axis, firm_axis))
         s3 = NamedSharding(mesh, P(month_axis, firm_axis, None))
-        y = jax.device_put(y, s2)
-        x = jax.device_put(x, s3)
-        mask = jax.device_put(mask, s2)
+        y = place_global(y, s2)
+        x = place_global(x, s3)
+        mask = place_global(mask, s2)
     run = _jitted_fm_hier(
         mesh, month_axis, firm_axis, nw_lags, min_months, weight,
         min(n_refine, 1),

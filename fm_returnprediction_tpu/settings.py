@@ -97,6 +97,10 @@ d["DTYPE"] = _env("DTYPE", default="float32")
 # note). 1 = pull/require monthly volume and compute it. Default 0 keeps
 # strict reference-behavior parity (15 variables).
 d["INCLUDE_TURNOVER"] = int(_env("INCLUDE_TURNOVER", default="0"))
+# Prepared-inputs checkpoint (data.prepared): cache the merged monthly frame
+# + compact daily strips under <raw_dir>/_prepared so warm runs skip the
+# ~76 s host ingest at real shape. 0 disables reading AND writing.
+d["PREPARED_CACHE"] = int(_env("PREPARED_CACHE", default="1"))
 
 
 def config(*args, **kwargs):

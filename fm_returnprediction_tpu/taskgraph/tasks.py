@@ -102,7 +102,7 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     import jax
     import numpy as np
 
-    from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
+    from fm_returnprediction_tpu.pipeline import load_or_build_panel
     from fm_returnprediction_tpu.utils.timing import trace
 
     dtype = np.dtype(config("DTYPE"))
@@ -110,8 +110,11 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
         dtype = np.float32
     # FMRP_TRACE=<dir> wraps the compute tasks in a jax.profiler trace
     # (SURVEY §5 tracing prescription; round-2 VERDICT item 8).
+    # load_or_build_panel is checkpoint-aware (data.prepared), so a re-run
+    # whose task state was invalidated but whose raw files are unchanged
+    # still skips the host ingest.
     with trace(os.environ.get("FMRP_TRACE")):
-        panel, factors_dict = build_panel(load_raw_data(raw_dir), dtype=dtype)
+        panel, factors_dict = load_or_build_panel(raw_dir, dtype=dtype)
     panel.save(processed_dir / PANEL_FILE)
     with open(processed_dir / FACTORS_FILE, "w") as f:
         json.dump(factors_dict, f, indent=2)

@@ -1,0 +1,69 @@
+"""Worker for the two-process ``jax.distributed`` test (test_multiprocess.py).
+
+Each process pins CPU with 2 virtual local devices, brings up the
+distributed runtime through ``initialize_multihost`` (the production init
+path), builds the default months×firms mesh — which on 2 processes × 2
+local devices is the (2, 2) hierarchy with one mesh ROW per process, the
+pod layout — runs one ``fama_macbeth_hier`` step on a shared seeded panel,
+and checks it against the plain single-device ``fama_macbeth`` computed
+locally. Prints ``MP_OK <process_id>`` as the success marker the parent
+asserts on.
+
+Usage: python mp_worker.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+from fm_returnprediction_tpu.parallel.multihost import (  # noqa: E402
+    initialize_multihost,
+    make_mesh_2d,
+)
+
+got = initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=nprocs, process_id=pid
+)
+assert got == (pid, nprocs), f"process coords {got} != {(pid, nprocs)}"
+# idempotent second call must not raise and must return the same coords
+assert initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=nprocs, process_id=pid
+) == (pid, nprocs)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+assert jax.process_count() == nprocs
+assert len(jax.devices()) == 2 * nprocs, "global device set must span processes"
+
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth  # noqa: E402
+from fm_returnprediction_tpu.parallel import fama_macbeth_hier  # noqa: E402
+
+rng = np.random.default_rng(7)  # same seed everywhere: identical global data
+t, n, p = 18, 12, 3
+x = rng.standard_normal((t, n, p))
+y = x @ (0.1 * rng.standard_normal(p)) + 0.05 * rng.standard_normal((t, n))
+mask = rng.random((t, n)) > 0.2
+y = np.where(mask, y, np.nan)
+
+mesh = make_mesh_2d()  # month_shards defaults to process_count: 1 row/process
+assert mesh.shape == {"months": nprocs, "firms": 2}, mesh.shape
+row_procs = {d.process_index for d in mesh.devices[pid]}
+assert row_procs == {pid}, f"mesh row {pid} spans processes {row_procs}"
+
+cs, fm = fama_macbeth_hier(y, x, mask, mesh=mesh)
+_, ref = jax.jit(fama_macbeth)(y, x, mask)  # local single-device oracle
+
+np.testing.assert_allclose(
+    np.asarray(fm.coef), np.asarray(ref.coef), rtol=1e-8, atol=1e-10
+)
+np.testing.assert_allclose(
+    np.asarray(fm.tstat), np.asarray(ref.tstat), rtol=1e-8, atol=1e-10
+)
+assert cs.slopes.shape == (t, p)  # (T, P): month padding trimmed
+
+print(f"MP_OK {pid}", flush=True)

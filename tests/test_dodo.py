@@ -1,0 +1,46 @@
+"""The doit-compat shim (``dodo.py``): task discovery surface and dict
+contract, testable without doit installed (the shim only *exposes* the
+graph; doit itself is optional)."""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).parent.parent
+
+
+def _load_dodo():
+    spec = importlib.util.spec_from_file_location("dodo", _REPO / "dodo.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_task_creators_cover_the_graph():
+    dodo = _load_dodo()
+    creators = {n: f for n, f in vars(dodo).items() if n.startswith("task_")}
+    # the five core build stages must be exposed under their native names
+    for name in ("config", "pull_data", "build_panel", "reports", "latex"):
+        assert f"task_{name}" in creators, f"task_{name} missing"
+    for name, creator in creators.items():
+        d = creator()
+        assert callable(d["actions"][0]) or isinstance(d["actions"][0], str)
+        assert isinstance(d["file_dep"], list)
+        assert isinstance(d["targets"], list)
+        assert all(isinstance(p, str) for p in d["file_dep"] + d["targets"])
+        assert isinstance(d["doc"], str) and d["doc"], name
+
+
+def test_direct_run_points_at_native_runner():
+    if importlib.util.find_spec("doit") is not None:
+        import pytest
+
+        pytest.skip("doit installed: `python dodo.py` delegates to a real "
+                    "doit build instead of printing the pointer")
+    out = subprocess.run(
+        [sys.executable, str(_REPO / "dodo.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    assert "fm_returnprediction_tpu.taskgraph" in out.stdout

@@ -1,0 +1,56 @@
+"""REAL multi-process ``jax.distributed``: two local CPU processes.
+
+Round-3 verdict item 5: ``initialize_multihost``'s ``jax.distributed`` path
+had only ever run with one process. Here the parent spawns two fresh Python
+processes (``tests/mp_worker.py``) that rendezvous on a local coordinator,
+form the 4-device global topology (2 processes × 2 virtual CPU devices),
+build the production months×firms mesh with one row per process, and run a
+hierarchical Fama-MacBeth step whose collectives actually cross the process
+boundary (Gloo transport) — asserting agreement with the single-device
+solver inside each worker.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_WORKER = Path(__file__).parent / "mp_worker.py"
+_REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(300)
+def test_two_process_distributed_fm_hier():
+    port, nprocs = _free_port(), 2
+    env = {**os.environ, "PYTHONPATH": str(_REPO)}
+    # the parent's pytest env must not leak its 8-device flag into workers
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(i), str(nprocs), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung:\n" + "\n---\n".join(outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
+        assert f"MP_OK {i}" in out, f"worker {i} missing success marker:\n{out}"

@@ -1,0 +1,138 @@
+"""Prepared-inputs checkpoint (``data.prepared``): the warm-run host-ingest
+skip. Contracts under test:
+
+- save/load roundtrip preserves the merged monthly frame and every compact
+  daily strip exactly;
+- the fingerprint follows the make-style staleness rule (stable for
+  untouched raw files, changed on any size/mtime change, dtype-sensitive);
+- ``run_pipeline`` transparently writes the checkpoint on the first run and
+  loads it on the second — skipping load_raw_data/universe_filter/
+  daily_ingest — with BIT-IDENTICAL tables;
+- a corrupt or half-written checkpoint degrades to a rebuild, never an
+  error (meta-last write ordering);
+- ``PREPARED_CACHE=0`` disables the path entirely.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.prepared import (
+    PREPARED_DIRNAME,
+    load_prepared,
+    raw_fingerprint,
+    save_prepared,
+)
+from fm_returnprediction_tpu.data.synthetic import (
+    SyntheticConfig,
+    write_synthetic_cache,
+)
+from fm_returnprediction_tpu.pipeline import run_pipeline
+
+CFG = SyntheticConfig(n_firms=60, n_months=48)
+
+
+@pytest.fixture(scope="module")
+def raw_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("raw")
+    write_synthetic_cache(d, CFG)
+    return d
+
+
+def test_fingerprint_staleness_contract(raw_dir):
+    fp = raw_fingerprint(raw_dir, np.float64)
+    assert fp == raw_fingerprint(raw_dir, np.float64)  # stable
+    assert fp != raw_fingerprint(raw_dir, np.float32)  # dtype-sensitive
+
+    victim = next(raw_dir.glob("*.parquet"))
+    st = victim.stat()
+    os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    assert fp != raw_fingerprint(raw_dir, np.float64)  # mtime-sensitive
+    os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert fp == raw_fingerprint(raw_dir, np.float64)  # restored
+
+
+def test_roundtrip_and_corruption(raw_dir, tmp_path):
+    from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
+
+    capture = {}
+    build_panel(load_raw_data(raw_dir), capture=capture)
+    merged, cd = capture["merged"], capture["compact_daily"]
+
+    fp = raw_fingerprint(raw_dir, np.float64)
+    save_prepared(tmp_path, fp, merged, cd)
+
+    assert load_prepared(tmp_path, "not-the-fingerprint") is None
+    got = load_prepared(tmp_path, fp)
+    assert got is not None
+    merged2, cd2 = got
+    pd.testing.assert_frame_equal(
+        merged2.reset_index(drop=True), merged.reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(cd2.row_values, cd.row_values)
+    np.testing.assert_array_equal(cd2.row_pos, cd.row_pos)
+    np.testing.assert_array_equal(cd2.offsets, cd.offsets)
+    np.testing.assert_array_equal(cd2.ids, cd.ids)
+    np.testing.assert_array_equal(cd2.mkt, cd.mkt)
+    np.testing.assert_array_equal(cd2.mkt_present, cd.mkt_present)
+    np.testing.assert_array_equal(
+        cd2.days.astype("datetime64[s]"), cd.days.astype("datetime64[s]")
+    )
+    np.testing.assert_array_equal(cd2.day_month_id, cd.day_month_id)
+    np.testing.assert_array_equal(cd2.week_id, cd.week_id)
+    np.testing.assert_array_equal(cd2.week_month_id, cd.week_month_id)
+    assert (cd2.n_weeks, cd2.n_months) == (cd.n_weeks, cd.n_months)
+
+    # valid meta + missing payload (a torn checkpoint) → miss with a
+    # warning, never an exception
+    (tmp_path / "compact_daily.npz").unlink()
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert load_prepared(tmp_path, fp) is None
+
+
+def _tables(res):
+    return res.table_1.to_string() + res.table_2.to_string()
+
+
+def test_pipeline_warm_run_uses_checkpoint(raw_dir):
+    cold = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
+                        make_deciles=False, compile_pdf=False)
+    assert "save_prepared" in cold.timer.durations
+    assert (raw_dir / PREPARED_DIRNAME / "meta.json").exists()
+
+    warm = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
+                        make_deciles=False, compile_pdf=False)
+    assert "load_prepared" in warm.timer.durations
+    for skipped in ("load_raw_data", "panel/universe_filter",
+                    "panel/market_equity", "panel/ccm_merge",
+                    "factors/daily_ingest", "save_prepared"):
+        assert skipped not in warm.timer.durations, skipped
+    assert _tables(warm) == _tables(cold)  # bit-identical reporting
+
+    # staleness: re-pulling a raw file invalidates the checkpoint
+    victim = next(raw_dir.glob("*.parquet"))
+    st = victim.stat()
+    os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    try:
+        rebuilt = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
+                               make_deciles=False, compile_pdf=False)
+        assert "load_raw_data" in rebuilt.timer.durations
+        assert "save_prepared" in rebuilt.timer.durations
+        assert _tables(rebuilt) == _tables(cold)
+    finally:
+        os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns))
+
+
+def test_prepared_cache_setting_disables(raw_dir, monkeypatch):
+    from fm_returnprediction_tpu import settings
+
+    monkeypatch.setitem(settings.d, "PREPARED_CACHE", 0)
+    res = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
+                       make_deciles=False, compile_pdf=False)
+    assert "load_raw_data" in res.timer.durations
+    assert "load_prepared" not in res.timer.durations
+    assert "save_prepared" not in res.timer.durations
