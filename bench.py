@@ -182,6 +182,17 @@ def _bench_pipeline_real(fast: bool):
     write_benchscale_cache(raw_dir, n_permnos=n, n_months=t)
     gen = time.perf_counter() - t0
 
+    # Honest cold semantics: "cold" is what a first-time user pays, so the
+    # prepared-inputs checkpoint (data.prepared) must not carry over from a
+    # previous bench run — clear it; the cold run then ingests from raw AND
+    # writes the checkpoint, and the warm run exercises it (the production
+    # repeat-run path).
+    import shutil
+
+    from fm_returnprediction_tpu.data.prepared import PREPARED_DIRNAME
+
+    shutil.rmtree(os.path.join(raw_dir, PREPARED_DIRNAME), ignore_errors=True)
+
     cold, cold_stages = _run_pipeline_timed(raw_dir)
     out = {
         "real_pipeline_cold_s": round(cold, 4),
@@ -401,12 +412,15 @@ def main() -> None:
         os.environ.setdefault("FMRP_BENCH_REPLICATES", "500")
         os.environ.setdefault("FMRP_BENCH_MONTHS", "240")
         os.environ.setdefault("FMRP_BENCH_FIRMS", "2000")
-        # one full-scale pass is evidence enough on a host-only run; the
-        # budget skips the warm repeat and records cold + stage breakdown,
-        # and the standalone daily section is redundant with the real
-        # pipeline's daily stage numbers. The whole fallback run must fit
-        # the driver's bench window — a killed bench records NO artifact.
-        os.environ.setdefault("FMRP_BENCH_REAL_BUDGET_S", "300")
+        # The budget guards the warm repeat: it must comfortably fit the
+        # driver's bench window (a killed bench records NO artifact), but
+        # the warm run is the HEADLINE — it is the one that exercises the
+        # prepared-inputs checkpoint, the production repeat-run path — so
+        # the ceiling sits above the observed host-only cold (~250-290 s
+        # with the checkpoint write) rather than below it. The standalone
+        # daily section stays off (redundant with the real pipeline's daily
+        # stage numbers).
+        os.environ.setdefault("FMRP_BENCH_REAL_BUDGET_S", "450")
         os.environ.setdefault("FMRP_BENCH_DAILY", "0")
     sections = [_bench_pipeline, _bench_pipeline_real, _bench_kernel]
     if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":

@@ -60,13 +60,19 @@ def place_global(a, sharding: NamedSharding) -> jax.Array:
         # output): device_put reshards on-device with no host value check,
         # and np.asarray would raise on the non-addressable shards anyway
         return jax.device_put(a, sharding)
+    if isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+        # typed PRNG keys (the bootstrap's replicate keys) have no numpy
+        # view, and device_put onto a non-addressable sharding is rejected
+        # outright by this JAX version — place the uint32 key data instead
+        # (its trailing impl dims replicate) and re-wrap.
+        data = jax.random.key_data(a)
+        spec = P(*(tuple(sharding.spec) + (None,) * (data.ndim - a.ndim)))
+        placed = place_global(
+            np.asarray(data), NamedSharding(sharding.mesh, spec)
+        )
+        return jax.random.wrap_key_data(placed, impl=jax.random.key_impl(a))
     if not isinstance(a, np.ndarray):
-        try:
-            a = np.asarray(a)
-        except (TypeError, ValueError, RuntimeError):
-            # extended dtypes (typed PRNG keys) have no numpy view; they
-            # also carry no NaN, so the checked device_put path is safe
-            return jax.device_put(a, sharding)
+        a = np.asarray(a)
     return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
 
 

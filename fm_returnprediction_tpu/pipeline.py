@@ -58,8 +58,26 @@ __all__ = [
     "build_panel",
     "build_panel_prepared",
     "load_or_build_panel",
+    "resolve_dtype",
     "run_pipeline",
 ]
+
+
+def resolve_dtype() -> np.dtype:
+    """The configured compute dtype, degraded to float32 when x64 is off.
+
+    The ONE resolution rule for every entry point — the prepared-inputs
+    checkpoint is a single dtype-keyed slot per raw directory, so two
+    entry points resolving dtype differently would thrash it (full
+    re-ingest + ~0.5 GB rewrite per alternation)."""
+    from fm_returnprediction_tpu.settings import config
+
+    dtype = np.dtype(config("DTYPE"))
+    import jax
+
+    if dtype == np.float64 and not jax.config.jax_enable_x64:
+        return np.dtype(np.float32)  # x64 disabled: f32 end to end
+    return dtype
 
 RAW_FILE_NAMES = dict(FILE_NAMES)  # canonical mapping lives in data.synthetic
 
@@ -250,13 +268,7 @@ def run_pipeline(
     ``dtype=None`` resolves the DTYPE setting (float32 on TPU by default;
     float64 requires jax_enable_x64 and is the CPU parity configuration)."""
     if dtype is None:
-        from fm_returnprediction_tpu.settings import config
-
-        dtype = np.dtype(config("DTYPE"))
-        import jax
-
-        if dtype == np.float64 and not jax.config.jax_enable_x64:
-            dtype = np.float32  # x64 disabled: stay in f32 end to end
+        dtype = resolve_dtype()
     timer = StageTimer()
 
     if not synthetic:

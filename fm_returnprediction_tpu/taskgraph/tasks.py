@@ -49,6 +49,27 @@ def _raw_paths(raw_dir: Path) -> List[Path]:
 BACKEND_MARKER = "_data_backend.txt"
 
 
+def _is_primary() -> bool:
+    """True on process 0 (or single-process). Multi-host taskgraph runs
+    execute every task on every process — compute is replicated, but only
+    one process may write shared-filesystem artifacts (same gating as
+    ``run_pipeline``; concurrent multi-GB npz writes tear)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def _sync_processes(tag: str) -> None:
+    """Barrier after a primary-only write so other processes cannot read a
+    half-written artifact in the next task. No-op single-process."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def _backend_name(synthetic: bool) -> str:
     return "synthetic" if synthetic else "wrds"
 
@@ -99,15 +120,10 @@ def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
 def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     import os
 
-    import jax
-    import numpy as np
-
-    from fm_returnprediction_tpu.pipeline import load_or_build_panel
+    from fm_returnprediction_tpu.pipeline import load_or_build_panel, resolve_dtype
     from fm_returnprediction_tpu.utils.timing import trace
 
-    dtype = np.dtype(config("DTYPE"))
-    if dtype == np.float64 and not jax.config.jax_enable_x64:
-        dtype = np.float32
+    dtype = resolve_dtype()
     # FMRP_TRACE=<dir> wraps the compute tasks in a jax.profiler trace
     # (SURVEY §5 tracing prescription; round-2 VERDICT item 8).
     # load_or_build_panel is checkpoint-aware (data.prepared), so a re-run
@@ -115,9 +131,11 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     # still skips the host ingest.
     with trace(os.environ.get("FMRP_TRACE")):
         panel, factors_dict = load_or_build_panel(raw_dir, dtype=dtype)
-    panel.save(processed_dir / PANEL_FILE)
-    with open(processed_dir / FACTORS_FILE, "w") as f:
-        json.dump(factors_dict, f, indent=2)
+    if _is_primary():
+        panel.save(processed_dir / PANEL_FILE)
+        with open(processed_dir / FACTORS_FILE, "w") as f:
+            json.dump(factors_dict, f, indent=2)
+    _sync_processes("build_panel_saved")
 
 
 def _reports(processed_dir: Path, output_dir: Path) -> None:
@@ -151,10 +169,11 @@ def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
     table_2 = build_table_2(panel, masks, factors_dict, mesh=default_mesh())
     cs_cache = {name: figure_cs(panel, m) for name, m in masks.items()}
     figure_1 = create_figure_1(panel, masks, cs_cache=cs_cache)
-    save_data(table_1, table_2, figure_1, output_dir)
-    save_decile_table(
-        build_decile_table(panel, masks, cs_cache=cs_cache), output_dir
-    )
+    decile_table = build_decile_table(panel, masks, cs_cache=cs_cache)
+    if _is_primary():  # tables computed everywhere, written once
+        save_data(table_1, table_2, figure_1, output_dir)
+        save_decile_table(decile_table, output_dir)
+    _sync_processes("reports_saved")
 
 
 def _parity(raw_dir: Path, output_dir: Path) -> None:
@@ -180,6 +199,8 @@ def _latex(output_dir: Path) -> None:
         create_latex_document,
     )
 
+    if not _is_primary():  # one pdflatex, not one per host
+        return
     tex = create_latex_document(output_dir)
     if tex is not None:
         compile_latex_document(tex)

@@ -66,4 +66,18 @@ np.testing.assert_allclose(
 )
 assert cs.slopes.shape == (t, p)  # (T, P): month padding trimmed
 
+# Bootstrap across the process boundary: typed PRNG keys cannot take the
+# host-value-checked device_put route onto a non-addressable sharding (it
+# is rejected outright) — place_global's key_data/wrap_key_data path must
+# carry them. NaN months in the replicated slopes exercise the NaN-safe
+# placement too.
+from fm_returnprediction_tpu.parallel import as_flat_mesh, block_bootstrap_se  # noqa: E402
+
+slope_valid = cs.month_valid[:, None] & np.isfinite(np.asarray(cs.slopes))
+res = block_bootstrap_se(
+    cs.slopes, slope_valid, jax.random.key(0), n_replicates=8,
+    mesh=as_flat_mesh(mesh, axis_name="boot"),
+)
+assert np.isfinite(np.asarray(res.se)).all(), "non-finite bootstrap SEs"
+
 print(f"MP_OK {pid}", flush=True)

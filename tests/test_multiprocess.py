@@ -14,6 +14,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -43,14 +44,17 @@ def test_two_process_distributed_fm_hier():
         for i in range(nprocs)
     ]
     outs = []
+    deadline = time.monotonic() + 240  # shared: total wait, not per-worker
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
             outs.append(out)
     except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
         pytest.fail("distributed workers hung:\n" + "\n---\n".join(outs))
+    finally:
+        for p in procs:  # never leak workers holding the coordinator port
+            if p.poll() is None:
+                p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
         assert f"MP_OK {i}" in out, f"worker {i} missing success marker:\n{out}"
