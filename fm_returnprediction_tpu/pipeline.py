@@ -192,7 +192,7 @@ def build_panel_prepared(
 
 
 def load_or_build_panel(
-    raw_data_dir, dtype=np.float64, mesh=None, timer=None,
+    raw_data_dir, dtype=None, mesh=None, timer=None,
     include_turnover=None,
 ) -> tuple[DensePanel, Dict[str, str]]:
     """Checkpoint-aware panel build from a raw cache directory.
@@ -203,7 +203,14 @@ def load_or_build_panel(
     the current raw files, else ingest from raw parquet and write the
     checkpoint (process 0 only — concurrent hosts would interleave the
     payload files). Warm runs skip ~76 s of host ingest at real shape.
+
+    ``dtype=None`` resolves via ``resolve_dtype()`` HERE, inside the shared
+    entry, so every caller lands on the same dtype-keyed checkpoint slot —
+    a caller-side default would thrash it (full re-ingest + ~0.5 GB rewrite
+    per alternation).
     """
+    if dtype is None:
+        dtype = resolve_dtype()
     timer = timer or StageTimer()
     from fm_returnprediction_tpu.data.prepared import (
         PREPARED_DIRNAME,

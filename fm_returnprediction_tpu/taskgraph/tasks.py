@@ -83,6 +83,14 @@ def _backend_matches(raw_dir: Path, synthetic: bool) -> bool:
 
 
 def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
+    """Multi-host: process 0 writes the raw caches (one WRDS pull, no torn
+    parquet), everyone barriers before build_panel reads them."""
+    if _is_primary():
+        _pull_data_primary(raw_dir, synthetic, synthetic_config)
+    _sync_processes("pull_data_saved")
+
+
+def _pull_data_primary(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
     from fm_returnprediction_tpu.utils.cache import save_cache_data
 
     raw_dir.mkdir(parents=True, exist_ok=True)
@@ -120,17 +128,16 @@ def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
 def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     import os
 
-    from fm_returnprediction_tpu.pipeline import load_or_build_panel, resolve_dtype
+    from fm_returnprediction_tpu.pipeline import load_or_build_panel
     from fm_returnprediction_tpu.utils.timing import trace
 
-    dtype = resolve_dtype()
     # FMRP_TRACE=<dir> wraps the compute tasks in a jax.profiler trace
     # (SURVEY §5 tracing prescription; round-2 VERDICT item 8).
     # load_or_build_panel is checkpoint-aware (data.prepared), so a re-run
     # whose task state was invalidated but whose raw files are unchanged
-    # still skips the host ingest.
+    # still skips the host ingest; dtype resolves inside the shared entry.
     with trace(os.environ.get("FMRP_TRACE")):
-        panel, factors_dict = load_or_build_panel(raw_dir, dtype=dtype)
+        panel, factors_dict = load_or_build_panel(raw_dir)
     if _is_primary():
         panel.save(processed_dir / PANEL_FILE)
         with open(processed_dir / FACTORS_FILE, "w") as f:
@@ -183,7 +190,9 @@ def _parity(raw_dir: Path, output_dir: Path) -> None:
 
     output_dir.mkdir(parents=True, exist_ok=True)
     diff = run_parity_check(raw_dir, strict=False)
-    diff.to_csv(output_dir / "parity_report.csv", index=False)
+    if _is_primary():  # diff computed everywhere, report written once
+        diff.to_csv(output_dir / "parity_report.csv", index=False)
+    _sync_processes("parity_saved")
     bad = diff[~diff["ok"]]
     if len(bad):
         raise AssertionError(

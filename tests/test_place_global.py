@@ -1,0 +1,52 @@
+"""``parallel.mesh.place_global`` — the one placement primitive for every
+sharded path. Single-process behaviors here (the fully-addressable fast
+path and input-kind handling); the cross-process branches are exercised for
+real by ``tests/test_multiprocess.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fm_returnprediction_tpu.parallel import make_mesh, place_global
+
+
+def _mesh():
+    return make_mesh(axis_name="firms")
+
+
+def test_numpy_nan_payload_round_trips():
+    mesh = _mesh()
+    x = np.arange(32.0, dtype=np.float32).reshape(4, 8)
+    x[0, 0] = np.nan  # the padding case that broke cross-process device_put
+    placed = place_global(x, NamedSharding(mesh, P(None, "firms")))
+    assert placed.sharding.spec == P(None, "firms")
+    np.testing.assert_array_equal(np.asarray(placed), x)
+
+
+def test_jax_array_and_replicated_spec():
+    mesh = _mesh()
+    x = jnp.linspace(0, 1, 16)
+    placed = place_global(x, NamedSharding(mesh, P()))
+    assert placed.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(x))
+
+
+def test_typed_prng_keys_stay_usable():
+    mesh = _mesh()
+    keys = jax.random.split(jax.random.key(7), mesh.devices.size * 2)
+    placed = place_global(keys, NamedSharding(mesh, P("firms")))
+    assert jnp.issubdtype(placed.dtype, jax.dtypes.prng_key)
+    # identical stream: placement must not alter key material
+    want = jax.random.uniform(keys[3], (2,))
+    got = jax.random.uniform(placed[3], (2,))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_bool_mask_payload():
+    mesh = _mesh()
+    m = np.arange(24).reshape(3, 8) % 3 == 0
+    placed = place_global(m, NamedSharding(mesh, P(None, "firms")))
+    assert placed.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(placed), m)
