@@ -27,6 +27,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
     host_local_mesh,
     make_mesh,
     pad_to_multiple,
+    pipeline_mesh,
     place_global,
     shard_panel,
 )
@@ -52,6 +53,7 @@ __all__ = [
     "host_local_mesh",
     "make_mesh",
     "pad_to_multiple",
+    "pipeline_mesh",
     "place_global",
     "shard_panel",
 ]

@@ -34,6 +34,7 @@ __all__ = [
     "default_mesh",
     "make_mesh",
     "pad_to_multiple",
+    "pipeline_mesh",
     "place_global",
     "shard_panel",
     "host_local_mesh",
@@ -161,3 +162,19 @@ def default_mesh(axis_name: str = "firms"):
     # make_mesh raises when N exceeds the available devices — "exactly N"
     # is the contract, not a silent cap.
     return make_mesh(n_devices=n, axis_name=axis_name)
+
+
+def pipeline_mesh():
+    """The ONE mesh policy for pipeline-level entry points.
+
+    Multi-process (FMRP_MULTIHOST launcher): the months×firms 2-D hierarchy,
+    built unconditionally — MESH_DEVICES=1 must not leave every host running
+    a redundant full single-device copy. Single-process: ``default_mesh``'s
+    MESH_DEVICES opt-in. Both ``run_pipeline`` and the task graph's report
+    stage draw from here so a pod run shards consistently across stages.
+    """
+    if jax.process_count() > 1:
+        from fm_returnprediction_tpu.parallel.multihost import make_mesh_2d
+
+        return make_mesh_2d()
+    return default_mesh()

@@ -294,27 +294,17 @@ def run_pipeline(
     if use_mesh or use_mesh is None:
         import jax
 
-        from fm_returnprediction_tpu.parallel import default_mesh, make_mesh
+        from fm_returnprediction_tpu.parallel import make_mesh, pipeline_mesh
 
-        if jax.process_count() > 1:
-            # Multi-host run (FMRP_MULTIHOST launcher): use the months×firms
-            # hierarchy so firm-axis collectives stay on ICI and DCN carries
-            # only the per-FM slope gather (parallel.multihost docstring).
-            # Built unconditionally — MESH_DEVICES=1 must not leave every
-            # host running a redundant full single-device pipeline copy.
-            # Table 2 routes a 2-D mesh through fama_macbeth_hier and the
-            # daily stage flattens it back to one firm axis.
-            from fm_returnprediction_tpu.parallel import make_mesh_2d
-
-            mesh = make_mesh_2d()
-        else:
-            mesh = default_mesh()  # opt-in via MESH_DEVICES (None when 1)
-            if use_mesh and mesh is None:
-                if len(jax.devices()) <= 1:
-                    raise RuntimeError(
-                        "use_mesh=True but only one device is available"
-                    )
-                mesh = make_mesh(axis_name="firms")
+        # The shared policy (parallel.mesh.pipeline_mesh): months×firms
+        # hierarchy on multi-process runs — Table 2 routes a 2-D mesh
+        # through fama_macbeth_hier, the daily stage flattens it back to
+        # one firm axis — else the MESH_DEVICES opt-in.
+        mesh = pipeline_mesh()
+        if use_mesh and mesh is None:
+            if len(jax.devices()) <= 1:
+                raise RuntimeError("use_mesh=True but only one device is available")
+            mesh = make_mesh(axis_name="firms")
 
     if synthetic:
         with timer.stage("load_raw_data"):

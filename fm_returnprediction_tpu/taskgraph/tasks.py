@@ -70,6 +70,24 @@ def _sync_processes(tag: str) -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+def _primary_writes(tag: str, fn) -> None:
+    """Run ``fn`` on process 0, then barrier everyone.
+
+    The primary's exception is re-raised AFTER the barrier: raising before
+    it would leave the other processes blocked in ``sync_global_devices``
+    forever (a failed WRDS pull must fail the pod, not deadlock it).
+    Non-primaries then fail fast downstream on the missing artifact."""
+    err = None
+    if _is_primary():
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            err = exc
+    _sync_processes(tag)
+    if err is not None:
+        raise err
+
+
 def _backend_name(synthetic: bool) -> str:
     return "synthetic" if synthetic else "wrds"
 
@@ -85,9 +103,10 @@ def _backend_matches(raw_dir: Path, synthetic: bool) -> bool:
 def _pull_data(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
     """Multi-host: process 0 writes the raw caches (one WRDS pull, no torn
     parquet), everyone barriers before build_panel reads them."""
-    if _is_primary():
-        _pull_data_primary(raw_dir, synthetic, synthetic_config)
-    _sync_processes("pull_data_saved")
+    _primary_writes(
+        "pull_data_saved",
+        lambda: _pull_data_primary(raw_dir, synthetic, synthetic_config),
+    )
 
 
 def _pull_data_primary(raw_dir: Path, synthetic: bool, synthetic_config=None) -> None:
@@ -138,11 +157,13 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     # still skips the host ingest; dtype resolves inside the shared entry.
     with trace(os.environ.get("FMRP_TRACE")):
         panel, factors_dict = load_or_build_panel(raw_dir)
-    if _is_primary():
+
+    def save():
         panel.save(processed_dir / PANEL_FILE)
         with open(processed_dir / FACTORS_FILE, "w") as f:
             json.dump(factors_dict, f, indent=2)
-    _sync_processes("build_panel_saved")
+
+    _primary_writes("build_panel_saved", save)
 
 
 def _reports(processed_dir: Path, output_dir: Path) -> None:
@@ -171,16 +192,20 @@ def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
     table_1 = build_table_1(panel, masks, factors_dict)
-    from fm_returnprediction_tpu.parallel import default_mesh
+    from fm_returnprediction_tpu.parallel import pipeline_mesh
 
-    table_2 = build_table_2(panel, masks, factors_dict, mesh=default_mesh())
+    # same mesh policy as run_pipeline: 2-D hierarchy on a pod, MESH_DEVICES
+    # opt-in single-process
+    table_2 = build_table_2(panel, masks, factors_dict, mesh=pipeline_mesh())
     cs_cache = {name: figure_cs(panel, m) for name, m in masks.items()}
     figure_1 = create_figure_1(panel, masks, cs_cache=cs_cache)
     decile_table = build_decile_table(panel, masks, cs_cache=cs_cache)
-    if _is_primary():  # tables computed everywhere, written once
+
+    def save():  # tables computed everywhere, written once
         save_data(table_1, table_2, figure_1, output_dir)
         save_decile_table(decile_table, output_dir)
-    _sync_processes("reports_saved")
+
+    _primary_writes("reports_saved", save)
 
 
 def _parity(raw_dir: Path, output_dir: Path) -> None:
@@ -190,9 +215,11 @@ def _parity(raw_dir: Path, output_dir: Path) -> None:
 
     output_dir.mkdir(parents=True, exist_ok=True)
     diff = run_parity_check(raw_dir, strict=False)
-    if _is_primary():  # diff computed everywhere, report written once
-        diff.to_csv(output_dir / "parity_report.csv", index=False)
-    _sync_processes("parity_saved")
+    # diff computed everywhere, report written once
+    _primary_writes(
+        "parity_saved",
+        lambda: diff.to_csv(output_dir / "parity_report.csv", index=False),
+    )
     bad = diff[~diff["ok"]]
     if len(bad):
         raise AssertionError(
