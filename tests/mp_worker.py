@@ -22,7 +22,6 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
 from fm_returnprediction_tpu.parallel.multihost import (  # noqa: E402
     initialize_multihost,
-    make_mesh_2d,
 )
 
 got = initialize_multihost(
@@ -50,7 +49,13 @@ y = x @ (0.1 * rng.standard_normal(p)) + 0.05 * rng.standard_normal((t, n))
 mask = rng.random((t, n)) > 0.2
 y = np.where(mask, y, np.nan)
 
-mesh = make_mesh_2d()  # month_shards defaults to process_count: 1 row/process
+# The production mesh policy: with process_count>1 this must dispatch to
+# the months×firms hierarchy (one row per process) regardless of
+# MESH_DEVICES — the branch only a real multi-process run can exercise.
+from fm_returnprediction_tpu.parallel import pipeline_mesh  # noqa: E402
+
+mesh = pipeline_mesh()
+assert mesh is not None and mesh.axis_names == ("months", "firms"), mesh
 assert mesh.shape == {"months": nprocs, "firms": 2}, mesh.shape
 row_procs = {d.process_index for d in mesh.devices[pid]}
 assert row_procs == {pid}, f"mesh row {pid} spans processes {row_procs}"

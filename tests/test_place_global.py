@@ -50,3 +50,17 @@ def test_bool_mask_payload():
     placed = place_global(m, NamedSharding(mesh, P(None, "firms")))
     assert placed.dtype == jnp.bool_
     np.testing.assert_array_equal(np.asarray(placed), m)
+
+
+def test_pipeline_mesh_policy(monkeypatch):
+    """Single-process: MESH_DEVICES opt-in (None at the default of 1).
+    The multi-process branch (months×firms hierarchy regardless of
+    MESH_DEVICES) is exercised by tests/test_multiprocess.py."""
+    from fm_returnprediction_tpu import settings
+    from fm_returnprediction_tpu.parallel import pipeline_mesh
+
+    monkeypatch.setitem(settings.d, "MESH_DEVICES", 1)
+    assert pipeline_mesh() is None
+    monkeypatch.setitem(settings.d, "MESH_DEVICES", 8)
+    mesh = pipeline_mesh()
+    assert mesh is not None and mesh.devices.size == 8
