@@ -18,7 +18,7 @@ calendar-contiguous.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence
+from typing import List, Sequence
 
 import numpy as np
 import pandas as pd
@@ -59,27 +59,6 @@ class DensePanel:
     def var(self, name: str) -> np.ndarray:
         """The (T, N) slice for one variable."""
         return self.values[:, :, self.var_index(name)]
-
-    def with_vars(self, new_vars: Dict[str, np.ndarray]) -> "DensePanel":
-        """Return a panel extended (or overwritten) with (T, N) variables."""
-        names = list(self.var_names)
-        columns = [self.values[:, :, k] for k in range(len(names))]
-        for name, arr in new_vars.items():
-            arr = np.asarray(arr)
-            if arr.shape != self.mask.shape:
-                raise ValueError(f"{name}: expected {self.mask.shape}, got {arr.shape}")
-            if name in names:
-                columns[names.index(name)] = arr
-            else:
-                names.append(name)
-                columns.append(arr)
-        return DensePanel(
-            values=np.stack(columns, axis=-1),
-            mask=self.mask,
-            months=self.months,
-            ids=self.ids,
-            var_names=names,
-        )
 
     def select(self, names: Sequence[str]) -> np.ndarray:
         """The (T, N, len(names)) sub-array in the given variable order."""
