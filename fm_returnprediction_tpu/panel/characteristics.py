@@ -255,14 +255,6 @@ def get_factors(
         df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
         panel = long_to_dense(df, "jdate", "permno", base_columns, dtype=dtype)
 
-    with timer.stage("factors/monthly_characteristics"):
-        var_index = tuple((name, panel.var_index(name)) for name in base_columns)
-        # ONE base-panel push; the same device arrays feed the monthly
-        # characteristics AND the device-side enrichment below.
-        values_dev = jnp.asarray(panel.values)
-        mask_dev = jnp.asarray(panel.mask)
-        monthly = compute_monthly_characteristics(values_dev, mask_dev, var_index)
-
     # Compacted ingest on BOTH the single-device and mesh paths: the dense
     # (D, N) daily grid is never materialized on host or device (round-2
     # VERDICT item 5). With a mesh, each strip's firm axis shards over the
@@ -296,6 +288,19 @@ def get_factors(
             mesh=daily_mesh,
         )
         daily_ids = cd.ids
+
+    # Monthly characteristics AFTER the daily stage: the daily chunk-size
+    # heuristic budgets a fixed fraction of device memory
+    # (ops.daily_chunked.auto_firm_chunk), so the base panel and monthly
+    # outputs (~2.3 GB at real shape) must not sit resident on the device
+    # while the strips stream through.
+    with timer.stage("factors/monthly_characteristics"):
+        var_index = tuple((name, panel.var_index(name)) for name in base_columns)
+        # ONE base-panel push; the same device arrays feed the monthly
+        # characteristics AND the device-side enrichment below.
+        values_dev = jnp.asarray(panel.values)
+        mask_dev = jnp.asarray(panel.mask)
+        monthly = compute_monthly_characteristics(values_dev, mask_dev, var_index)
 
     with timer.stage("factors/merge_winsorize"):
         # Align daily-firm columns onto the monthly panel's permno vocabulary

@@ -49,6 +49,7 @@ from fm_returnprediction_tpu.parallel.fm_sharded import cs_ols_kernel
 from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple, place_global
 
 __all__ = [
+    "distributed_client_active",
     "initialize_multihost",
     "make_mesh_2d",
     "as_flat_mesh",
@@ -56,7 +57,7 @@ __all__ = [
 ]
 
 
-def _distributed_client_active() -> bool:
+def distributed_client_active() -> bool:
     """True when the JAX distributed runtime is already initialized.
 
     Probes the distributed client directly instead of ``process_count()``:
@@ -109,7 +110,7 @@ def initialize_multihost(
         # accelerator runtimes at CLI startup even for pure --list
         # invocations. Single-process is the documented answer.
         return 0, 1
-    if not _distributed_client_active():
+    if not distributed_client_active():
         if explicit:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -265,24 +265,76 @@ class TaskRunner:
             visit(name)
         return order
 
+    @staticmethod
+    def _consensus(flag: bool, reduce) -> bool:
+        """Cross-process reduction of a local boolean; identity when the
+        distributed runtime is not up.
+
+        Two uses keep the engine's collective sequence aligned on pods:
+
+        - STALENESS (``reduce=np.any``): the skip decision is per-process
+          (local state DB, local clocks), but multi-host task actions
+          contain cross-process BARRIERS (``tasks._primary_writes``) — if
+          one process skips a task another runs, the runner deadlocks
+          inside the action. If ANY process finds a task stale, everyone
+          runs it (writes are process-0-gated, so redundant runs are
+          compute-only).
+        - SUCCESS (``reduce=np.all``): a one-sided failure must stop all
+          processes together — the failed process makes no further
+          collective calls, so survivors marching into the next staleness
+          allgather would hang there, masking the real traceback.
+
+        The single-process probe is ``distributed_client_active`` —
+        NOT ``jax.process_count()``, which would initialize the XLA
+        backends (pinning the platform, dialing remote runtimes) on the
+        very first skip check of a plain local run.
+        """
+        from fm_returnprediction_tpu.parallel.multihost import (
+            distributed_client_active,
+        )
+
+        if not distributed_client_active():
+            return flag
+        import jax
+
+        if jax.process_count() == 1:
+            return flag
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            _np.asarray([1 if flag else 0], _np.int32)
+        )
+        return bool(reduce(_np.asarray(flags)))
+
     def run(self, names: Optional[Sequence[str]] = None, force: bool = False) -> bool:
         """Run ``names`` (default: all tasks) and their deps. Returns True
         if everything succeeded."""
+        import numpy as _np
+
         order = self._toposort(list(names or self.tasks))
         for name in order:
             task = self.tasks[name]
-            if not force and self.is_up_to_date(task):
+            stale = force or not self.is_up_to_date(task)
+            if not self._consensus(stale, _np.any):
                 self.reporter.skip(task)
                 continue
             self.reporter.start(task)
             start = time.perf_counter()
+            err = None
             try:
                 for action in task.actions:
                     if isinstance(action, str):
                         subprocess.run(action, shell=True, check=True)
                     else:
                         action()
-            except Exception as err:  # noqa: BLE001 — report and halt
+            except Exception as exc:  # noqa: BLE001 — report and halt
+                err = exc
+            if not self._consensus(err is None, _np.all):
+                if err is None:  # a PEER failed; this process's task was fine
+                    err = RuntimeError(
+                        "task failed on another process (see its log)"
+                    )
                 self.reporter.fail(task, err)
                 # Mark stale but PRESERVE the last successful timing — the
                 # timing log is the wall-clock record, not the failure log.

@@ -1,0 +1,98 @@
+"""Worker for the two-process TASKGRAPH test (test_multiprocess.py).
+
+Drives the five-task pipeline DAG with 2 real `jax.distributed` processes
+sharing one filesystem (the pod scenario):
+
+- phase 1: both processes run the DAG from empty state — process 0 must
+  write every artifact exactly once (``_primary_writes``), the barriers
+  must release process 1 only after each write, and both must finish.
+- phase 2: ASYMMETRIC staleness — process 0 keeps its state DB (all tasks
+  locally up to date), process 1 starts a fresh DB (all tasks stale).
+  Without the runner's cross-process consensus this deadlocks: process 1
+  enters an action barrier process 0 never reaches. With consensus, both
+  re-run everything and succeed.
+
+Usage: python mp_taskgraph_worker.py <pid> <nprocs> <port> <workdir>
+"""
+
+import os
+import sys
+from pathlib import Path
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+workdir = Path(sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+from fm_returnprediction_tpu.parallel.multihost import (  # noqa: E402
+    initialize_multihost,
+)
+
+initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=nprocs, process_id=pid
+)
+
+from jax.experimental import multihost_utils  # noqa: E402
+
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig  # noqa: E402
+from fm_returnprediction_tpu.taskgraph.engine import (  # noqa: E402
+    PlainReporter,
+    TaskRunner,
+)
+from fm_returnprediction_tpu.taskgraph.tasks import build_tasks  # noqa: E402
+
+raw, processed, out = workdir / "raw", workdir / "processed", workdir / "out"
+for d in (raw, processed, out):
+    d.mkdir(parents=True, exist_ok=True)
+
+
+def make_tasks():
+    tasks = build_tasks(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=30, n_months=30),
+        raw_dir=raw,
+        processed_dir=processed,
+        output_dir=out,
+    )
+    # drop the config task's global-dir action; dirs are created above
+    tasks = [t for t in tasks if t.name != "config"]
+    for t in tasks:
+        t.task_dep = [d for d in t.task_dep if d != "config"]
+    return tasks
+
+
+db = workdir / f"state_p{pid}.sqlite"
+with TaskRunner(make_tasks(), db_path=db, reporter=PlainReporter()) as r:
+    assert r.run(), "phase-1 DAG run failed"
+assert (out / "table_1.pkl").exists() and (processed / "lewellen_panel.npz").exists()
+
+multihost_utils.sync_global_devices("phase2_setup")
+if pid == 1:  # asymmetric staleness: process 1 forgets everything
+    db.unlink()
+multihost_utils.sync_global_devices("phase2_go")
+
+with TaskRunner(make_tasks(), db_path=db, reporter=PlainReporter()) as r2:
+    assert r2.run(), "phase-2 (asymmetric staleness) run failed"
+assert (out / "table_1.pkl").exists()
+
+# phase 3: ONE-SIDED failure must stop BOTH processes symmetrically (the
+# engine's per-task success consensus) — without it, process 0 would march
+# into the next collective and hang while process 1 holds the traceback.
+multihost_utils.sync_global_devices("phase3_go")
+from fm_returnprediction_tpu.taskgraph.engine import Task  # noqa: E402
+
+
+def flaky():
+    if pid == 1:
+        raise RuntimeError("injected one-sided failure")
+
+
+with TaskRunner(
+    [Task("flaky", [flaky]), Task("after", [lambda: None], task_dep=["flaky"])],
+    db_path=workdir / f"state3_p{pid}.sqlite", reporter=PlainReporter(),
+) as r3:
+    assert r3.run() is False, "one-sided failure must fail the run everywhere"
+
+print(f"TG_OK {pid}", flush=True)

@@ -20,6 +20,7 @@ from pathlib import Path
 import pytest
 
 _WORKER = Path(__file__).parent / "mp_worker.py"
+_TG_WORKER = Path(__file__).parent / "mp_taskgraph_worker.py"
 _REPO = Path(__file__).parent.parent
 
 
@@ -29,22 +30,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(300)
-def test_two_process_distributed_fm_hier():
+def _run_worker_pair(worker: Path, extra_args, marker: str, budget_s: float):
     port, nprocs = _free_port(), 2
     env = {**os.environ, "PYTHONPATH": str(_REPO)}
     # the parent's pytest env must not leak its 8-device flag into workers
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, str(_WORKER), str(i), str(nprocs), str(port)],
+            [sys.executable, str(worker), str(i), str(nprocs), str(port),
+             *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
         for i in range(nprocs)
     ]
     outs = []
-    deadline = time.monotonic() + 240  # shared: total wait, not per-worker
+    deadline = time.monotonic() + budget_s  # shared: total wait, not per-worker
     try:
         for p in procs:
             out, _ = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
@@ -57,4 +58,20 @@ def test_two_process_distributed_fm_hier():
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
-        assert f"MP_OK {i}" in out, f"worker {i} missing success marker:\n{out}"
+        assert f"{marker} {i}" in out, f"worker {i} missing marker:\n{out}"
+
+
+@pytest.mark.timeout(300)
+def test_two_process_distributed_fm_hier():
+    _run_worker_pair(_WORKER, [], "MP_OK", budget_s=240)
+
+
+@pytest.mark.timeout(420)
+def test_two_process_taskgraph_dag(tmp_path):
+    """The full five-task DAG across 2 real processes sharing a filesystem:
+    process-0-only writes with barriers, then an ASYMMETRIC-staleness rerun
+    (one fresh state DB, one warm) that deadlocks without the runner's
+    cross-process stale consensus."""
+    _run_worker_pair(
+        _TG_WORKER, [str(tmp_path)], "TG_OK", budget_s=360
+    )
