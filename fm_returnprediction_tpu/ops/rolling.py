@@ -30,6 +30,7 @@ import jax.numpy as jnp
 __all__ = [
     "windowed_sum",
     "windowed_count",
+    "finalize_std",
     "rolling_sum",
     "rolling_mean",
     "rolling_std",
@@ -90,6 +91,21 @@ def _pallas_default() -> bool:
     return False
 
 
+def finalize_std(s1, s2, count, min_periods: int) -> jnp.ndarray:
+    """Windowed moments → pandas rolling std (ddof=1) with gating.
+
+    The ONE home for the finalization semantics (count>=2 rule, clamped
+    variance, min_periods gate): the single-device path here and the
+    time-sharded path (``parallel.time_sharded``) both call it, so their
+    promised exact parity holds by construction.
+    """
+    cf = count.astype(s1.dtype)
+    denom = jnp.maximum(cf - 1.0, 1.0)
+    var = jnp.maximum(s2 - s1 * s1 / jnp.maximum(cf, 1.0), 0.0) / denom
+    out = jnp.sqrt(var)
+    return _gate(jnp.where(count >= 2, out, jnp.nan), count, min_periods)
+
+
 def rolling_std(
     x: jnp.ndarray, window: int, min_periods: int, use_pallas: bool | None = None
 ) -> jnp.ndarray:
@@ -114,13 +130,9 @@ def rolling_std(
     finite = jnp.isfinite(x)
     xz = jnp.where(finite, x, 0.0)
     count = windowed_count(finite, window)
-    cf = count.astype(xz.dtype)
     s1 = windowed_sum(xz, window)
     s2 = windowed_sum(xz * xz, window)
-    denom = jnp.maximum(cf - 1.0, 1.0)
-    var = jnp.maximum(s2 - s1 * s1 / jnp.maximum(cf, 1.0), 0.0) / denom
-    out = jnp.sqrt(var)
-    return _gate(jnp.where(count >= 2, out, jnp.nan), count, min_periods)
+    return finalize_std(s1, s2, count, min_periods)
 
 
 def rolling_prod(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:

@@ -31,6 +31,11 @@ from fm_returnprediction_tpu.parallel.mesh import (
     place_global,
     shard_panel,
 )
+from fm_returnprediction_tpu.parallel.time_sharded import (
+    rolling_moments_time_sharded,
+    rolling_std_time_sharded,
+    rolling_sum_time_sharded,
+)
 from fm_returnprediction_tpu.parallel.multihost import (
     as_flat_mesh,
     fama_macbeth_hier,
@@ -55,5 +60,8 @@ __all__ = [
     "pad_to_multiple",
     "pipeline_mesh",
     "place_global",
+    "rolling_moments_time_sharded",
+    "rolling_std_time_sharded",
+    "rolling_sum_time_sharded",
     "shard_panel",
 ]

@@ -1,0 +1,158 @@
+"""Sequence/context parallelism: rolling reductions over a TIME-sharded axis.
+
+The firm-sharded daily kernels (``parallel.daily_sharded``) scale the panel
+by splitting the embarrassingly parallel firm axis. This module covers the
+opposite regime — the long-context one, where the SEQUENCE is the large
+axis (minute bars, decades of daily data, few series): the time axis itself
+shards across devices, and the trailing-window reductions of ``ops.rolling``
+run with two collectives per call, the block-wise-exchange pattern of
+ring-attention-style context parallelism:
+
+1. **distributed prefix-sum** — each shard cumsums its local block of the
+   masked moments (Σx, Σx², Σ1{finite}); an ``all_gather`` of the p per-shard
+   totals (3·N floats each — tiny) gives every shard the exclusive prefix
+   offset that turns local cumsums into global ones;
+2. **halo exchange** — the trailing-window difference ``c[t] − c[t−w]``
+   needs the previous shard's last ``window`` cumsum rows for a shard's
+   first ``window`` outputs; one ``ppermute`` shifts exactly that boundary
+   block forward along the mesh axis (device 0 receives zeros — which IS
+   the correct shifted-cumsum value for global ``t < window``).
+
+Communication per call: ``p·3·N`` floats gathered + ``window·3·N`` floats
+permuted — independent of the sequence length D, so the pattern scales to
+arbitrarily long sequences exactly like ring attention's per-block exchange
+(the public scaling-book recipe: shard the long axis, exchange only the
+boundary state). Window semantics match ``ops.rolling`` (pandas
+``rolling(window, min_periods)``: NaNs occupy positions but are excluded;
+NaN until ``min_periods`` finite entries) to float rounding — the windowed
+sums are the same cumsum differences, just computed from shard-local
+pieces.
+
+``window`` must fit within one shard (``window <= D_padded / p``); the real
+shapes satisfy this by an order of magnitude (252-day window vs ~1,576-day
+shards on 8 devices), and a multi-hop halo for pathological cases would buy
+generality nothing here — the constraint raises instead.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fm_returnprediction_tpu.parallel.mesh import (
+    make_mesh,
+    pad_to_multiple,
+    place_global,
+)
+
+__all__ = [
+    "rolling_moments_time_sharded",
+    "rolling_sum_time_sharded",
+    "rolling_std_time_sharded",
+]
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_rolling(mesh: Mesh, axis_name: str, window: int, stat: str,
+                    min_periods: int):
+    """One compiled time-sharded rolling program per (mesh, config)."""
+    p = mesh.shape[axis_name]
+
+    def kernel(x_l):
+        finite = jnp.isfinite(x_l)
+        xz = jnp.where(finite, x_l, 0.0)
+        m = jnp.stack([xz, xz * xz, finite.astype(xz.dtype)], axis=-1)
+        c_local = jnp.cumsum(m, axis=0)                    # (D/p, N, 3)
+
+        # distributed prefix-sum: exclusive offset from the p shard totals
+        totals = jax.lax.all_gather(c_local[-1], axis_name)  # (p, N, 3)
+        idx = jax.lax.axis_index(axis_name)
+        before = jnp.arange(p)[:, None, None] < idx
+        offset = jnp.sum(jnp.where(before, totals, 0.0), axis=0)
+        c = c_local + offset[None]                         # global cumsum
+
+        # halo exchange: previous shard's last `window` global-cumsum rows.
+        # Device 0 has no source and receives zeros — the correct c[t-w]
+        # for global t < window (the series-start truncation).
+        halo = jax.lax.ppermute(
+            c[-window:], axis_name, [(i, i + 1) for i in range(p - 1)]
+        )
+        c_lag = jnp.concatenate([halo, c], axis=0)[: x_l.shape[0]]
+
+        s = c - c_lag                                      # windowed moments
+        s1, s2, cnt = s[..., 0], s[..., 1], s[..., 2]
+        if stat == "moments":
+            return s1, s2, cnt
+        count = cnt  # float count; integral-valued by construction
+        if stat == "sum":
+            return jnp.where(count >= min_periods, s1, jnp.nan)
+        # std: the SHARED finalization — parity with the single-device
+        # kernel holds by construction, not by transcription
+        from fm_returnprediction_tpu.ops.rolling import finalize_std
+
+        return finalize_std(s1, s2, count, min_periods)
+
+    out_specs = (
+        (P(axis_name, None),) * 3 if stat == "moments" else P(axis_name, None)
+    )
+    return jax.jit(
+        jax.shard_map(
+            kernel, mesh=mesh, in_specs=P(axis_name, None), out_specs=out_specs
+        )
+    )
+
+
+def _prepare(x, window: int, mesh: Optional[Mesh], axis_name: str):
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    p = mesh.shape[axis_name]
+    t = x.shape[0]
+    x = pad_to_multiple(jnp.asarray(x), axis=0, multiple=p, fill=jnp.nan)
+    shard_len = x.shape[0] // p
+    if window > shard_len:
+        raise ValueError(
+            f"window={window} exceeds the per-shard sequence length "
+            f"{shard_len} ({x.shape[0]} rows over {p} '{axis_name}' shards); "
+            "the single-hop halo carries at most one shard of history"
+        )
+    x = place_global(x, NamedSharding(mesh, P(axis_name, None)))
+    return x, t, mesh
+
+
+def rolling_moments_time_sharded(
+    x, window: int, mesh: Optional[Mesh] = None, axis_name: str = "time",
+):
+    """Trailing-window (Σx, Σx², count) with the TIME axis sharded.
+
+    ``x``: (D, N); returns three (D, N) arrays, time-sharded on the mesh.
+    Trailing NaN padding (ragged D) never leaks: trailing windows only look
+    backward, and padded rows are trimmed from the result.
+    """
+    x, t, mesh = _prepare(x, window, mesh, axis_name)
+    run = _jitted_rolling(mesh, axis_name, int(window), "moments", 0)
+    s1, s2, cnt = run(x)
+    return s1[:t], s2[:t], cnt[:t]
+
+
+def rolling_sum_time_sharded(
+    x, window: int, min_periods: int, mesh: Optional[Mesh] = None,
+    axis_name: str = "time",
+):
+    """``ops.rolling.rolling_sum`` with the time axis sharded across devices."""
+    x, t, mesh = _prepare(x, window, mesh, axis_name)
+    run = _jitted_rolling(mesh, axis_name, int(window), "sum", int(min_periods))
+    return run(x)[:t]
+
+
+def rolling_std_time_sharded(
+    x, window: int, min_periods: int, mesh: Optional[Mesh] = None,
+    axis_name: str = "time",
+):
+    """``ops.rolling.rolling_std`` (ddof=1) with the time axis sharded."""
+    x, t, mesh = _prepare(x, window, mesh, axis_name)
+    run = _jitted_rolling(mesh, axis_name, int(window), "std", int(min_periods))
+    return run(x)[:t]

@@ -1,0 +1,96 @@
+"""Time-sharded (sequence-parallel) rolling kernels vs the single-device
+``ops.rolling`` oracles: exact window semantics across shard boundaries,
+the ppermute halo actually present in the compiled program, ragged-length
+padding, and the single-hop window constraint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.ops.rolling import rolling_std, rolling_sum
+from fm_returnprediction_tpu.parallel import make_mesh
+from fm_returnprediction_tpu.parallel.time_sharded import (
+    _jitted_rolling,
+    rolling_moments_time_sharded,
+    rolling_std_time_sharded,
+    rolling_sum_time_sharded,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(31)
+    d, n = 160, 24  # 8 shards x 20 rows; window 16 crosses every boundary
+    x = rng.standard_normal((d, n))
+    x[rng.random((d, n)) < 0.12] = np.nan
+    return x
+
+
+def _mesh():
+    return make_mesh(axis_name="time")
+
+
+def test_matches_single_device_sum_and_std(data):
+    mesh = _mesh()
+    for mp in (1, 5, 16):
+        want = np.asarray(rolling_sum(jnp.asarray(data), 16, mp))
+        got = np.asarray(rolling_sum_time_sharded(data, 16, mp, mesh=mesh))
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12,
+                                   equal_nan=True)
+        want = np.asarray(rolling_std(jnp.asarray(data), 16, mp))
+        got = np.asarray(rolling_std_time_sharded(data, 16, mp, mesh=mesh))
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True)
+
+
+def test_moments_and_sharding(data):
+    mesh = _mesh()
+    s1, s2, cnt = rolling_moments_time_sharded(data, 16, mesh=mesh)
+    assert s1.sharding.spec[0] == "time"
+    finite = np.isfinite(data)
+    xz = np.where(finite, data, 0.0)
+    # independent numpy oracle for the windowed count and sum at a boundary
+    # row (row 20 = first row of shard 1: its window spans the shard seam)
+    row = 20
+    lo = max(0, row - 15)
+    np.testing.assert_allclose(
+        np.asarray(cnt)[row], finite[lo:row + 1].sum(axis=0), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1)[row], xz[lo:row + 1].sum(axis=0), rtol=1e-12, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(s2)[row], (xz[lo:row + 1] ** 2).sum(axis=0),
+        rtol=1e-12, atol=1e-12,
+    )
+
+
+def test_ragged_length_pads_and_trims(data):
+    mesh = _mesh()
+    ragged = data[:150]  # 150 % 8 != 0 → NaN-padded to 152? (19*8) then trimmed
+    want = np.asarray(rolling_std(jnp.asarray(ragged), 12, 4))
+    got = np.asarray(rolling_std_time_sharded(ragged, 12, 4, mesh=mesh))
+    assert got.shape == ragged.shape
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_window_must_fit_one_shard(data):
+    with pytest.raises(ValueError, match="halo"):
+        rolling_std_time_sharded(data, 24, 4, mesh=_mesh())  # 24 > 160/8
+
+
+def test_compiled_program_contains_the_halo_permute(data):
+    """The sequence-parallel exchange must be REAL: the partitioned program
+    contains a collective-permute (the halo) and an all-gather (the prefix
+    offsets) — the inverse of the firm-sharded daily kernels' zero-collective
+    assertion."""
+    mesh = _mesh()
+    run = _jitted_rolling(mesh, "time", 16, "std", 4)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arg = jax.ShapeDtypeStruct((160, 24), jnp.float64,
+                               sharding=NamedSharding(mesh, P("time", None)))
+    hlo = run.lower(arg).compile().as_text()
+    assert "collective-permute" in hlo, "halo exchange missing"
+    assert "all-gather" in hlo or "all-reduce" in hlo, "prefix gather missing"
