@@ -30,6 +30,8 @@ import jax.numpy as jnp
 __all__ = [
     "windowed_sum",
     "windowed_count",
+    "finalize_sum",
+    "finalize_mean",
     "finalize_std",
     "rolling_sum",
     "rolling_mean",
@@ -61,16 +63,14 @@ def rolling_sum(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
     """pandas ``.rolling(window, min_periods).sum()`` on axis 0."""
     finite = jnp.isfinite(x)
     total = windowed_sum(jnp.where(finite, x, 0.0), window)
-    return _gate(total, windowed_count(finite, window), min_periods)
+    return finalize_sum(total, windowed_count(finite, window), min_periods)
 
 
 def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
     """pandas ``.rolling(window, min_periods).mean()`` on axis 0."""
     finite = jnp.isfinite(x)
     total = windowed_sum(jnp.where(finite, x, 0.0), window)
-    count = windowed_count(finite, window)
-    mean = total / jnp.maximum(count, 1).astype(total.dtype)
-    return _gate(mean, count, min_periods)
+    return finalize_mean(total, windowed_count(finite, window), min_periods)
 
 
 def _pallas_default() -> bool:
@@ -91,13 +91,25 @@ def _pallas_default() -> bool:
     return False
 
 
+def finalize_sum(s1, count, min_periods: int) -> jnp.ndarray:
+    """Windowed sum + count → gated rolling sum (shared finalization)."""
+    return _gate(s1, count, min_periods)
+
+
+def finalize_mean(s1, count, min_periods: int) -> jnp.ndarray:
+    """Windowed sum + count → gated rolling mean (shared finalization)."""
+    mean = s1 / jnp.maximum(count, 1).astype(s1.dtype)
+    return _gate(mean, count, min_periods)
+
+
 def finalize_std(s1, s2, count, min_periods: int) -> jnp.ndarray:
     """Windowed moments → pandas rolling std (ddof=1) with gating.
 
-    The ONE home for the finalization semantics (count>=2 rule, clamped
-    variance, min_periods gate): the single-device path here and the
-    time-sharded path (``parallel.time_sharded``) both call it, so their
-    promised exact parity holds by construction.
+    With ``finalize_sum``/``finalize_mean``, the ONE home for the
+    finalization semantics (count>=2 rule, clamped variance, min_periods
+    gates): the single-device paths here and the time-sharded paths
+    (``parallel.time_sharded``) all call these, so their promised exact
+    parity holds by construction, not by transcription.
     """
     cf = count.astype(s1.dtype)
     denom = jnp.maximum(cf - 1.0, 1.0)

@@ -32,6 +32,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
     shard_panel,
 )
 from fm_returnprediction_tpu.parallel.time_sharded import (
+    rolling_mean_time_sharded,
     rolling_moments_time_sharded,
     rolling_std_time_sharded,
     rolling_sum_time_sharded,
@@ -60,6 +61,7 @@ __all__ = [
     "pad_to_multiple",
     "pipeline_mesh",
     "place_global",
+    "rolling_mean_time_sharded",
     "rolling_moments_time_sharded",
     "rolling_std_time_sharded",
     "rolling_sum_time_sharded",

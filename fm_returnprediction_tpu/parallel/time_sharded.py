@@ -18,8 +18,12 @@ ring-attention-style context parallelism:
    block forward along the mesh axis (device 0 receives zeros — which IS
    the correct shifted-cumsum value for global ``t < window``).
 
-Communication per call: ``p·3·N`` floats gathered + ``window·3·N`` floats
-permuted — independent of the sequence length D, so the pattern scales to
+Communication per call: ``p·3·N`` values gathered + ``window·3·N`` values
+permuted (two float moment channels plus an EXACT int32 count channel — a
+float count loses integer exactness past 2^24 cumulative rows in f32,
+flipping the ``min_periods`` gates on exactly the long sequences this
+module exists for) — independent of the sequence length D, so the pattern
+scales to
 arbitrarily long sequences exactly like ring attention's per-block exchange
 (the public scaling-book recipe: shard the long axis, exchange only the
 boundary state). Window semantics match ``ops.rolling`` (pandas
@@ -52,6 +56,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
 __all__ = [
     "rolling_moments_time_sharded",
     "rolling_sum_time_sharded",
+    "rolling_mean_time_sharded",
     "rolling_std_time_sharded",
 ]
 
@@ -62,38 +67,50 @@ def _jitted_rolling(mesh: Mesh, axis_name: str, window: int, stat: str,
     """One compiled time-sharded rolling program per (mesh, config)."""
     p = mesh.shape[axis_name]
 
-    def kernel(x_l):
-        finite = jnp.isfinite(x_l)
-        xz = jnp.where(finite, x_l, 0.0)
-        m = jnp.stack([xz, xz * xz, finite.astype(xz.dtype)], axis=-1)
-        c_local = jnp.cumsum(m, axis=0)                    # (D/p, N, 3)
-
-        # distributed prefix-sum: exclusive offset from the p shard totals
-        totals = jax.lax.all_gather(c_local[-1], axis_name)  # (p, N, 3)
+    def _windowed(c_local):
+        """Local cumsum → global windowed difference: the distributed
+        prefix-sum (all_gather of shard totals → exclusive offset) plus the
+        single-``ppermute`` halo of the previous shard's last ``window``
+        global-cumsum rows. Device 0 has no halo source and receives zeros
+        — the correct ``c[t−w]`` for global ``t < window`` (series-start
+        truncation). Applied identically to the float moments and the exact
+        int32 count channel."""
+        totals = jax.lax.all_gather(c_local[-1], axis_name)  # (p, ...)
         idx = jax.lax.axis_index(axis_name)
-        before = jnp.arange(p)[:, None, None] < idx
-        offset = jnp.sum(jnp.where(before, totals, 0.0), axis=0)
-        c = c_local + offset[None]                         # global cumsum
-
-        # halo exchange: previous shard's last `window` global-cumsum rows.
-        # Device 0 has no source and receives zeros — the correct c[t-w]
-        # for global t < window (the series-start truncation).
+        shape = (p,) + (1,) * (c_local.ndim - 1)
+        before = jnp.arange(p).reshape(shape) < idx
+        offset = jnp.sum(jnp.where(before, totals, 0), axis=0)
+        c = c_local + offset[None]
         halo = jax.lax.ppermute(
             c[-window:], axis_name, [(i, i + 1) for i in range(p - 1)]
         )
-        c_lag = jnp.concatenate([halo, c], axis=0)[: x_l.shape[0]]
+        c_lag = jnp.concatenate([halo, c], axis=0)[: c_local.shape[0]]
+        return c - c_lag
 
-        s = c - c_lag                                      # windowed moments
-        s1, s2, cnt = s[..., 0], s[..., 1], s[..., 2]
+    def kernel(x_l):
+        finite = jnp.isfinite(x_l)
+        xz = jnp.where(finite, x_l, 0.0)
+        s = _windowed(jnp.cumsum(jnp.stack([xz, xz * xz], -1), axis=0))
+        # count rides its own int32 cumsum: a float count channel loses
+        # integer exactness once the cumulative count passes 2^24 in f32,
+        # flipping the min_periods/ddof gates on exactly the long sequences
+        # this module exists for
+        count = _windowed(jnp.cumsum(finite.astype(jnp.int32), axis=0))
+        s1, s2 = s[..., 0], s[..., 1]
         if stat == "moments":
-            return s1, s2, cnt
-        count = cnt  # float count; integral-valued by construction
-        if stat == "sum":
-            return jnp.where(count >= min_periods, s1, jnp.nan)
-        # std: the SHARED finalization — parity with the single-device
-        # kernel holds by construction, not by transcription
-        from fm_returnprediction_tpu.ops.rolling import finalize_std
+            return s1, s2, count
+        # SHARED finalizations — parity with the single-device kernels
+        # holds by construction, not by transcription
+        from fm_returnprediction_tpu.ops.rolling import (
+            finalize_mean,
+            finalize_std,
+            finalize_sum,
+        )
 
+        if stat == "sum":
+            return finalize_sum(s1, count, min_periods)
+        if stat == "mean":
+            return finalize_mean(s1, count, min_periods)
         return finalize_std(s1, s2, count, min_periods)
 
     out_specs = (
@@ -145,6 +162,16 @@ def rolling_sum_time_sharded(
     """``ops.rolling.rolling_sum`` with the time axis sharded across devices."""
     x, t, mesh = _prepare(x, window, mesh, axis_name)
     run = _jitted_rolling(mesh, axis_name, int(window), "sum", int(min_periods))
+    return run(x)[:t]
+
+
+def rolling_mean_time_sharded(
+    x, window: int, min_periods: int, mesh: Optional[Mesh] = None,
+    axis_name: str = "time",
+):
+    """``ops.rolling.rolling_mean`` with the time axis sharded."""
+    x, t, mesh = _prepare(x, window, mesh, axis_name)
+    run = _jitted_rolling(mesh, axis_name, int(window), "mean", int(min_periods))
     return run(x)[:t]
 
 

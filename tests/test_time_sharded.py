@@ -8,10 +8,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fm_returnprediction_tpu.ops.rolling import rolling_std, rolling_sum
+from fm_returnprediction_tpu.ops.rolling import (
+    rolling_mean,
+    rolling_std,
+    rolling_sum,
+)
 from fm_returnprediction_tpu.parallel import make_mesh
 from fm_returnprediction_tpu.parallel.time_sharded import (
     _jitted_rolling,
+    rolling_mean_time_sharded,
     rolling_moments_time_sharded,
     rolling_std_time_sharded,
     rolling_sum_time_sharded,
@@ -41,6 +46,10 @@ def test_matches_single_device_sum_and_std(data):
         want = np.asarray(rolling_std(jnp.asarray(data), 16, mp))
         got = np.asarray(rolling_std_time_sharded(data, 16, mp, mesh=mesh))
         np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True)
+        want = np.asarray(rolling_mean(jnp.asarray(data), 16, mp))
+        got = np.asarray(rolling_mean_time_sharded(data, 16, mp, mesh=mesh))
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12,
                                    equal_nan=True)
 
 
