@@ -67,36 +67,50 @@ def _jitted_rolling(mesh: Mesh, axis_name: str, window: int, stat: str,
     """One compiled time-sharded rolling program per (mesh, config)."""
     p = mesh.shape[axis_name]
 
-    def _windowed(c_local):
-        """Local cumsum → global windowed difference: the distributed
-        prefix-sum (all_gather of shard totals → exclusive offset) plus the
-        single-``ppermute`` halo of the previous shard's last ``window``
-        global-cumsum rows. Device 0 has no halo source and receives zeros
-        — the correct ``c[t−w]`` for global ``t < window`` (series-start
-        truncation). Applied identically to the float moments and the exact
-        int32 count channel."""
-        totals = jax.lax.all_gather(c_local[-1], axis_name)  # (p, ...)
+    def _windowed(cs):
+        """Local cumsums (a PYTREE: float moments + exact int32 count) →
+        global windowed differences, with ONE collective round: the
+        distributed prefix-sum (all_gather of shard totals → exclusive
+        offset) plus the single-``ppermute`` halo of the previous shard's
+        last ``window`` global-cumsum rows. Device 0 has no halo source and
+        receives zeros — the correct ``c[t−w]`` for global ``t < window``
+        (series-start truncation). Both collectives take the whole pytree,
+        so the count channel's exactness costs no extra exchange round."""
         idx = jax.lax.axis_index(axis_name)
-        shape = (p,) + (1,) * (c_local.ndim - 1)
-        before = jnp.arange(p).reshape(shape) < idx
-        offset = jnp.sum(jnp.where(before, totals, 0), axis=0)
-        c = c_local + offset[None]
+        totals = jax.lax.all_gather(jax.tree.map(lambda c: c[-1], cs), axis_name)
+
+        def to_global(c, tot):  # tot: (p, ...) shard totals per leaf
+            before = jnp.arange(p).reshape((p,) + (1,) * (tot.ndim - 1)) < idx
+            return c + jnp.sum(jnp.where(before, tot, 0), axis=0)[None]
+
+        c = jax.tree.map(to_global, cs, totals)
         halo = jax.lax.ppermute(
-            c[-window:], axis_name, [(i, i + 1) for i in range(p - 1)]
+            jax.tree.map(lambda g: g[-window:], c), axis_name,
+            [(i, i + 1) for i in range(p - 1)],
         )
-        c_lag = jnp.concatenate([halo, c], axis=0)[: c_local.shape[0]]
-        return c - c_lag
+
+        def diff(g, h):
+            return g - jnp.concatenate([h, g], axis=0)[: g.shape[0]]
+
+        return jax.tree.map(diff, c, halo)
 
     def kernel(x_l):
         finite = jnp.isfinite(x_l)
         xz = jnp.where(finite, x_l, 0.0)
-        s = _windowed(jnp.cumsum(jnp.stack([xz, xz * xz], -1), axis=0))
+        # the x² channel exists only for the stats that consume it — sum and
+        # mean skip its cumsum and its share of the exchanged boundary state
+        need_s2 = stat in ("moments", "std")
+        chans = [xz, xz * xz] if need_s2 else [xz]
         # count rides its own int32 cumsum: a float count channel loses
         # integer exactness once the cumulative count passes 2^24 in f32,
         # flipping the min_periods/ddof gates on exactly the long sequences
         # this module exists for
-        count = _windowed(jnp.cumsum(finite.astype(jnp.int32), axis=0))
-        s1, s2 = s[..., 0], s[..., 1]
+        s, count = _windowed((
+            jnp.cumsum(jnp.stack(chans, -1), axis=0),
+            jnp.cumsum(finite.astype(jnp.int32), axis=0),
+        ))
+        s1 = s[..., 0]
+        s2 = s[..., 1] if need_s2 else None
         if stat == "moments":
             return s1, s2, count
         # SHARED finalizations — parity with the single-device kernels
