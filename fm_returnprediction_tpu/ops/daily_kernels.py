@@ -46,6 +46,7 @@ __all__ = [
     "last_obs_per_month",
     "beta_from_weekly_sums",
     "rolling_vol_252_monthly",
+    "weekly_partial_sums",
     "weekly_rolling_beta_monthly",
 ]
 
@@ -157,6 +158,26 @@ def weekly_rolling_beta_monthly(
         monthly panel vocabulary, ``n_months`` for out-of-panel months.
     Returns (n_months, N) betas, NaN where no valid window start in month.
     """
+    sums = weekly_partial_sums(
+        ret_d, mask_d, mkt_d, week_id, n_weeks, mkt_present=mkt_present
+    )
+    return beta_from_weekly_sums(
+        *sums, week_month_id, n_months, window_weeks,
+    )
+
+
+def weekly_partial_sums(
+    ret_d, mask_d, mkt_d, week_id, n_weeks: int, mkt_present=None
+):
+    """Daily rows → the six weekly partial-sum arrays (n_weeks, N).
+
+    The ingest-side half of the beta kernel, factored out so every layout
+    shares it by construction: the single-device path above, and the
+    time-sharded path (``parallel.time_sharded``) where each shard
+    aggregates ITS days into the global week segments and one ``psum``
+    merges the partials — segment sums are linear, so partial-per-shard +
+    sum-over-shards equals the single-device aggregation exactly.
+    """
     if mkt_present is None:
         mkt_present = jnp.isfinite(mkt_d)
     present = mask_d & mkt_present[:, None]          # row exists in the join
@@ -173,11 +194,7 @@ def weekly_rolling_beta_monthly(
     w_rm2 = seg(log_rm * log_rm)
     w_cnt = seg(present.astype(log_ri.dtype))        # pl.count(): all rows
     w_rm_cnt = seg(rm_valid.astype(log_ri.dtype))    # rows with market data
-
-    return beta_from_weekly_sums(
-        w_ri, w_rm, w_rirm, w_rm2, w_cnt, w_rm_cnt,
-        week_month_id, n_months, window_weeks,
-    )
+    return w_ri, w_rm, w_rirm, w_rm2, w_cnt, w_rm_cnt
 
 
 def beta_from_weekly_sums(

@@ -36,6 +36,7 @@ from fm_returnprediction_tpu.parallel.time_sharded import (
     rolling_moments_time_sharded,
     rolling_std_time_sharded,
     rolling_sum_time_sharded,
+    weekly_rolling_beta_time_sharded,
 )
 from fm_returnprediction_tpu.parallel.multihost import (
     as_flat_mesh,
@@ -65,5 +66,6 @@ __all__ = [
     "rolling_moments_time_sharded",
     "rolling_std_time_sharded",
     "rolling_sum_time_sharded",
+    "weekly_rolling_beta_time_sharded",
     "shard_panel",
 ]

@@ -58,6 +58,7 @@ __all__ = [
     "rolling_sum_time_sharded",
     "rolling_mean_time_sharded",
     "rolling_std_time_sharded",
+    "weekly_rolling_beta_time_sharded",
 ]
 
 
@@ -197,3 +198,101 @@ def rolling_std_time_sharded(
     x, t, mesh = _prepare(x, window, mesh, axis_name)
     run = _jitted_rolling(mesh, axis_name, int(window), "std", int(min_periods))
     return run(x)[:t]
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_beta(mesh: Mesh, axis_name: str, n_weeks: int, n_months: int,
+                 window_weeks: int):
+    """One compiled time-sharded weekly-beta program per (mesh, config)."""
+    from fm_returnprediction_tpu.ops.daily_kernels import (
+        beta_from_weekly_sums,
+        weekly_partial_sums,
+    )
+
+    def kernel(ret_l, mask_l, mkt_l, mkt_present_l, week_id_l, week_month_id):
+        # Each shard aggregates ITS days into the GLOBAL week segments
+        # (week ids are global indices); segment sums are linear, so one
+        # psum of the six (n_weeks, N) partials reproduces the
+        # single-device aggregation exactly. Weeks straddling a shard seam
+        # need no halo — their partial rows simply come from two shards.
+        sums = weekly_partial_sums(
+            ret_l, mask_l, mkt_l, week_id_l, n_weeks,
+            mkt_present=mkt_present_l,
+        )
+        sums = jax.lax.psum(sums, axis_name)
+        # the windowing/validity/labeling half runs replicated: it is
+        # O(n_weeks·N), ~1/5 of the daily volume
+        return beta_from_weekly_sums(
+            *sums, week_month_id, n_months, window_weeks
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(
+                P(axis_name, None), P(axis_name, None), P(axis_name),
+                P(axis_name), P(axis_name), P(),
+            ),
+            out_specs=P(),
+        )
+    )
+
+
+def weekly_rolling_beta_time_sharded(
+    ret_d,
+    mask_d,
+    mkt_d,
+    week_id,
+    n_weeks: int,
+    week_month_id,
+    n_months: int,
+    window_weeks: int = 156,
+    mkt_present=None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "time",
+):
+    """``ops.daily_kernels.weekly_rolling_beta_monthly`` with the DAY axis
+    sharded across devices — the long-context layout for the reference's
+    heaviest kernel (SURVEY §3.5).
+
+    The daily-volume work (masked log returns, per-week segment sums) runs
+    shard-local; one ``psum`` of the six (n_weeks, N) weekly partials is
+    the only communication, and the weekly windowing half runs replicated.
+    Returns a fully replicated (n_months, N) array equal to the
+    single-device kernel to rounding.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    p = mesh.shape[axis_name]
+    t = ret_d.shape[0]
+    ret_d = pad_to_multiple(jnp.asarray(ret_d), axis=0, multiple=p, fill=jnp.nan)
+    mask_d = pad_to_multiple(jnp.asarray(mask_d), axis=0, multiple=p, fill=False)
+    mkt_d = pad_to_multiple(jnp.asarray(mkt_d), axis=0, multiple=p, fill=jnp.nan)
+    if mkt_present is None:
+        # mkt_d is already NaN-padded, and isfinite(NaN) is False — the
+        # padding conventions compose with no extra slice/repad
+        mkt_present = jnp.isfinite(mkt_d)
+    else:
+        mkt_present = pad_to_multiple(
+            jnp.asarray(mkt_present), axis=0, multiple=p, fill=False
+        )
+    # padded rows carry mask/mkt_present False → every scattered value is 0,
+    # so any in-range week id is safe for them
+    week_id = pad_to_multiple(
+        jnp.asarray(week_id).astype(jnp.int32), axis=0, multiple=p, fill=0
+    )
+
+    row = NamedSharding(mesh, P(axis_name))
+    strip = NamedSharding(mesh, P(axis_name, None))
+    rep = NamedSharding(mesh, P())
+    run = _jitted_beta(mesh, axis_name, int(n_weeks), int(n_months),
+                       int(window_weeks))
+    return run(
+        place_global(ret_d, strip),
+        place_global(mask_d, strip),
+        place_global(mkt_d, row),
+        place_global(mkt_present, row),
+        place_global(week_id, row),
+        place_global(jnp.asarray(week_month_id).astype(jnp.int32), rep),
+    )
