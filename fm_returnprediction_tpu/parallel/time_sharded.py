@@ -36,6 +36,15 @@ pieces.
 shapes satisfy this by an order of magnitude (252-day window vs ~1,576-day
 shards on 8 devices), and a multi-hop halo for pathological cases would buy
 generality nothing here — the constraint raises instead.
+
+Scope boundary (deliberate): the COMPACTION-based monthly vol
+(``ops.daily_kernels.rolling_vol_252_monthly``) has no time-sharded
+variant. Its window counts each firm's PRESENT rows — compaction is a
+global, data-dependent permutation along exactly the axis this module
+shards, so a faithful port would ship per-firm variable halos for no
+production need (the pipeline's panel has N≫p; it firm-shards). The
+time-sharded family covers the calendar-window semantics (sum/mean/std/
+moments) plus the weekly beta, whose segment sums are permutation-free.
 """
 
 from __future__ import annotations
