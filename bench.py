@@ -438,7 +438,22 @@ def main() -> None:
             try:
                 extra.update(section(fast))
             except Exception as exc:  # noqa: BLE001 - recorded, not hidden
+                import traceback
+
                 extra[f"{section.__name__}_error"] = repr(exc)[:300]
+                # the deepest in-repo frames name the pipeline stage that
+                # failed (r04 run 1: a remote-compile 500 in the real-shape
+                # section was unattributable from the exception repr alone)
+                repo_root = os.path.dirname(os.path.abspath(__file__))
+                tb = traceback.extract_tb(exc.__traceback__)
+                frames = [
+                    f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
+                    for f in tb
+                    if f.filename.startswith(repo_root)
+                    or "fm_returnprediction" in f.filename
+                ] or [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
+                      for f in tb]
+                extra[f"{section.__name__}_error_frames"] = frames[-6:]
 
     budget = 60.0
     if "real_pipeline_warm_s" in extra:
