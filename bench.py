@@ -44,9 +44,14 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 import numpy as np
+
+# The live CPU-rescue child, if any — published by _real_cpu_rescue so the
+# deadline watchdog can kill it before hard-exiting the parent.
+_RESCUE_PROC = None
 
 
 def _make_panel(t, n, p, dtype=np.float32, seed=2014):
@@ -193,7 +198,22 @@ def _bench_pipeline_real(fast: bool):
 
     shutil.rmtree(os.path.join(raw_dir, PREPARED_DIRNAME), ignore_errors=True)
 
-    cold, cold_stages = _run_pipeline_timed(raw_dir)
+    try:
+        cold, cold_stages = _run_pipeline_timed(raw_dir)
+    except Exception as exc:  # noqa: BLE001 - backend fault → disclosed rescue
+        # Observed r04 run 1: a remote-compile failure killed the real-shape
+        # section mid-run and the round recorded NO real-shape number while
+        # the host was perfectly able to produce a disclosed CPU one. After
+        # a backend fault the in-process JAX client is wedged, so the rescue
+        # runs in a FRESH subprocess, CPU-pinned and with the relay-dialing
+        # sitecustomize dropped from PYTHONPATH (it blocks interpreter
+        # start-up when the tunnel grant is down).
+        rescue = _real_cpu_rescue(raw_dir, budget)
+        rescue["real_pipeline_gen_s"] = round(gen, 2)
+        rescue["real_pipeline_shape"] = f"T{t}_N{n}"
+        rescue["real_pipeline_accel_error"] = repr(exc)[:300]
+        rescue["real_pipeline_accel_error_frames"] = _error_frames(exc)
+        return rescue
     out = {
         "real_pipeline_cold_s": round(cold, 4),
         "real_pipeline_gen_s": round(gen, 2),
@@ -206,12 +226,114 @@ def _bench_pipeline_real(fast: bool):
     # ingest + checkpoint write the warm run then skips
     out["real_pipeline_cold_stage_s"] = cold_stages
     if cold <= budget:
-        warm, stages = _run_pipeline_timed(raw_dir)
+        try:
+            warm, stages = _run_pipeline_timed(raw_dir)
+        except Exception as exc:  # noqa: BLE001 - keep the completed cold
+            # a fault in the warm repeat must not throw away the completed
+            # full-scale cold measurement (the invariant stated above); the
+            # cold number is a genuine accelerator result, so no CPU rescue
+            # — the headline falls back to it
+            out["real_pipeline_warm_error"] = repr(exc)[:300]
+            out["real_pipeline_warm_error_frames"] = _error_frames(exc)
+            return out
         out["real_pipeline_warm_s"] = round(warm, 4)
         out["real_pipeline_stage_s"] = stages
     else:
         out["real_pipeline_warm_skipped"] = f"cold {cold:.0f}s > budget {budget:.0f}s"
     return out
+
+
+def _error_frames(exc: BaseException) -> list:
+    """Deepest repo-local traceback frames (fall back to the raw tail).
+
+    The ONE home for failure attribution — used by ``main``'s section
+    handler and the real-section rescue alike (r04 run 1: a remote-compile
+    500 was unattributable from the exception repr alone)."""
+    import traceback
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    tb = traceback.extract_tb(exc.__traceback__)
+    frames = [
+        f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
+        for f in tb
+        if f.filename.startswith(repo_root)
+        or "fm_returnprediction" in f.filename
+    ] or [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}" for f in tb]
+    return frames[-6:]
+
+
+def _child_env(repo_root: str) -> dict:
+    """Env for a CPU-pinned child: drop relay-dialing sitecustomize dirs
+    from PYTHONPATH (same idiom as tests/test_graft_entry.py) but keep any
+    other entries the deployment needs, and put the repo root first."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    parts = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root, *parts])
+    return env
+
+
+def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
+    """Disclosed CPU re-run of the real-shape pipeline after a backend fault.
+
+    One run in a fresh CPU-pinned subprocess (the in-process client is
+    wedged after a backend fault). The result is keyed warm vs cold by
+    whether the child actually hit the prepared-inputs checkpoint, and
+    labelled ``real_pipeline_device: cpu-fallback``; ``main`` additionally
+    renames the headline metric so the artifact can never pass a host
+    number off as an accelerator one."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    # no import-time side channel: pass raw_dir via argv
+    child = (
+        "import json, sys, bench\n"
+        "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
+        "print('RESCUE ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+    )
+    global _RESCUE_PROC
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child, raw_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_child_env(repo_root), cwd=repo_root,
+        )
+        # published so the deadline watchdog can kill the child before
+        # hard-exiting — an orphaned full-scale CPU run would otherwise
+        # burn the host for up to `budget` seconds into the next round
+        _RESCUE_PROC = proc
+        try:
+            stdout, stderr = proc.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return {"real_pipeline_rescue_error":
+                    f"rescue exceeded budget {budget:.0f}s"}
+        finally:
+            _RESCUE_PROC = None
+        line = [l for l in stdout.splitlines() if l.startswith("RESCUE ")]
+        if proc.returncode != 0 or not line:
+            return {"real_pipeline_rescue_error": (stderr or stdout)[-300:]}
+        got = json.loads(line[-1][len("RESCUE "):])
+    except Exception as exc:  # noqa: BLE001 - rescue is best-effort
+        return {"real_pipeline_rescue_error": repr(exc)[:300]}
+    # warm only if the child really took the checkpoint path — a fault
+    # before save_prepared leaves no checkpoint and the child pays the full
+    # cold ingest, which must not masquerade as the repeat-run number. The
+    # timer records the load_prepared ATTEMPT even on a miss, so the
+    # discriminator is the raw ingest's absence, not the attempt's presence.
+    warm_like = "load_raw_data" not in got["stages"]
+    kind = "warm" if warm_like else "cold"
+    stage_key = ("real_pipeline_stage_s" if warm_like
+                 else "real_pipeline_cold_stage_s")
+    return {
+        f"real_pipeline_{kind}_s": round(got["wall"], 4),
+        stage_key: {k: round(v, 3) for k, v in got["stages"].items()},
+        "real_pipeline_device": "cpu-fallback",
+    }
 
 
 def _bench_daily_fullscale(fast: bool):
@@ -357,9 +479,6 @@ def _devices_or_die(timeout_s: int = 150):
         # probe and the parent's own init, which then hangs in the same
         # uninterruptible C call. A watchdog thread prints the artifact and
         # hard-exits if the parent init misses its own deadline.
-        import os as _os
-        import threading
-
         done = threading.Event()
 
         def _watchdog():
@@ -370,7 +489,7 @@ def _devices_or_die(timeout_s: int = 150):
                     "extra": {"backend_init_error":
                               f"in-process init exceeded {timeout_s}s"},
                 }), flush=True)
-                _os._exit(0)
+                os._exit(0)
 
         threading.Thread(target=_watchdog, daemon=True).start()
         import jax
@@ -391,6 +510,62 @@ def _devices_or_die(timeout_s: int = 150):
             "extra": {"backend_init_error": reason},
         }))
         raise SystemExit(0)
+
+
+_EMIT_LOCK = threading.Lock()
+
+
+def _emit_line(extra: dict) -> None:
+    """Compute the headline and print the ONE JSON line — at most once.
+
+    Shared by the normal end-of-run path and the global watchdog: a wedged
+    in-process JAX client can hang a later section forever inside a C call
+    (observed r04 run 1: the backend died mid-run), and an emitted
+    partial artifact beats a killed process that recorded nothing. The
+    once-guard makes the watchdog and the main path race-safe. The PRINT
+    happens under the lock too: the watchdog hard-exits the process right
+    after its own (possibly no-op) call returns, and must not be able to
+    truncate a competing emit mid-write."""
+    with _EMIT_LOCK:
+        if getattr(_emit_line, "_done", False):
+            return
+        _emit_line._done = True
+
+        budget = 60.0
+        # a rescued real-shape number is a HOST number: the metric name
+        # itself must say so — a consumer reading only metric/value/device
+        # must not be able to record it as an accelerator result
+        fell_back = extra.get("real_pipeline_device") == "cpu-fallback"
+        disclose = "_cpu_fallback" if fell_back else ""
+        if "real_pipeline_warm_s" in extra:
+            warm = extra["real_pipeline_warm_s"]
+            metric = (f"e2e_pipeline_{extra['real_pipeline_shape']}"
+                      f"_warm{disclose}_wall_s")
+        elif "real_pipeline_cold_s" in extra:
+            warm = extra["real_pipeline_cold_s"]
+            metric = (f"e2e_pipeline_{extra['real_pipeline_shape']}"
+                      f"_cold{disclose}_wall_s")
+        elif "pipeline_warm_s" in extra:
+            warm = extra["pipeline_warm_s"]
+            metric = f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s"
+        else:  # every pipeline section errored — emit a parseable line
+            print(json.dumps({"metric": "bench_failed", "value": -1.0,
+                              "unit": "s", "vs_baseline": 0.0,
+                              "extra": extra}),
+                  flush=True)
+            return
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": warm,
+                    "unit": "s",
+                    "vs_baseline": round(budget / warm, 2),
+                    "extra": extra,
+                }
+            ),
+            flush=True,
+        )
 
 
 def main() -> None:
@@ -429,6 +604,33 @@ def main() -> None:
         sections.append(_bench_daily_fullscale)
     sections.append(_bench_pallas)
 
+    # Global deadline: a section hanging in an uninterruptible C call (a
+    # backend that died mid-run) must cost only the REMAINING sections, not
+    # the whole artifact — the watchdog emits whatever has been measured so
+    # far and hard-exits. The section try/except cannot do this: it never
+    # regains control from a hung call.
+    deadline = float(os.environ.get("FMRP_BENCH_DEADLINE_S", 3000))
+    bench_done = threading.Event()
+
+    def _watchdog():
+        if not bench_done.wait(deadline):
+            try:
+                # dict(extra) is a single atomic C-level copy under the
+                # GIL — safe against the main thread's section updates
+                _emit_line({**extra, "bench_deadline_exceeded_s": deadline})
+                # a still-running CPU rescue child must not outlive the
+                # bench into the next round's measurements
+                child = _RESCUE_PROC
+                if child is not None:
+                    child.kill()
+            finally:
+                # serialize with a competing emit so the hard exit cannot
+                # truncate a JSON line mid-write
+                with _EMIT_LOCK:
+                    os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     # FMRP_TRACE=<dir> wraps the whole bench in a jax.profiler trace
     # (round-2 VERDICT item 8) — open with TensorBoard/xprof.
     with trace(os.environ.get("FMRP_TRACE")):
@@ -438,48 +640,11 @@ def main() -> None:
             try:
                 extra.update(section(fast))
             except Exception as exc:  # noqa: BLE001 - recorded, not hidden
-                import traceback
-
                 extra[f"{section.__name__}_error"] = repr(exc)[:300]
-                # the deepest in-repo frames name the pipeline stage that
-                # failed (r04 run 1: a remote-compile 500 in the real-shape
-                # section was unattributable from the exception repr alone)
-                repo_root = os.path.dirname(os.path.abspath(__file__))
-                tb = traceback.extract_tb(exc.__traceback__)
-                frames = [
-                    f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
-                    for f in tb
-                    if f.filename.startswith(repo_root)
-                    or "fm_returnprediction" in f.filename
-                ] or [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
-                      for f in tb]
-                extra[f"{section.__name__}_error_frames"] = frames[-6:]
+                extra[f"{section.__name__}_error_frames"] = _error_frames(exc)
 
-    budget = 60.0
-    if "real_pipeline_warm_s" in extra:
-        warm = extra["real_pipeline_warm_s"]
-        metric = f"e2e_pipeline_{extra['real_pipeline_shape']}_warm_wall_s"
-    elif "real_pipeline_cold_s" in extra:
-        warm = extra["real_pipeline_cold_s"]
-        metric = f"e2e_pipeline_{extra['real_pipeline_shape']}_cold_wall_s"
-    elif "pipeline_warm_s" in extra:
-        warm = extra["pipeline_warm_s"]
-        metric = f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s"
-    else:  # every pipeline section errored — still emit a parseable line
-        print(json.dumps({"metric": "bench_failed", "value": -1.0,
-                          "unit": "s", "vs_baseline": 0.0, "extra": extra}))
-        return
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": warm,
-                "unit": "s",
-                "vs_baseline": round(budget / warm, 2),
-                "extra": extra,
-            }
-        )
-    )
+    bench_done.set()
+    _emit_line(extra)
 
 
 if __name__ == "__main__":
