@@ -76,19 +76,25 @@ def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
 def _pallas_default() -> bool:
     """Whether ``rolling_std`` dispatches to the fused pallas kernel.
 
-    Opt-in via ``FMRP_PALLAS=1`` (or forced off with ``0``). Default OFF:
-    the round-2 three-output kernel was advertised as a win but measured
-    0.95× vs XLA on hardware; the rebuilt fully fused kernel (one HBM
-    read, one write — ``ops.pallas_kernels``) should beat the cumsum path,
-    but "should" is not a recorded artifact. ``bench.py`` measures the
-    pallas-vs-XLA ratio on every TPU round regardless of this default —
-    the default flips on when a recorded BENCH artifact shows > 1×."""
+    Default ON on TPU: the rebuilt fully fused kernel (one HBM read, one
+    write — ``ops.pallas_kernels``) measured **2.81×** over the XLA cumsum
+    path on hardware (``BENCH_r04_self.json``: ``rolling_std_pallas_ms``
+    8.337 vs ``rolling_std_xla_ms`` 23.389 on a (12608, 4096) f32 strip,
+    TPU v5e).
+    The round-2 three-output version measured 0.95× and was rebuilt; the
+    default stayed off until this recorded artifact existed. Off
+    elsewhere — the kernel is TPU-only by construction and interpret mode
+    is a correctness harness, not a fast path. ``FMRP_PALLAS=1/0``
+    overrides either way; ``bench.py`` keeps measuring both paths every
+    TPU round so a regression shows up in the artifact."""
     import os
 
     flag = os.environ.get("FMRP_PALLAS")
     if flag is not None:
         return flag.strip().lower() in ("1", "true", "yes", "on")
-    return False
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
 
 
 def finalize_sum(s1, count, min_periods: int) -> jnp.ndarray:
@@ -123,15 +129,14 @@ def rolling_std(
 ) -> jnp.ndarray:
     """pandas ``.rolling(window, min_periods).std()`` (ddof=1) on axis 0.
 
-    With ``use_pallas`` (or ``FMRP_PALLAS=1``) this dispatches to the fully
-    fused pallas kernel (``ops.pallas_kernels.rolling_std_fused``): one HBM
-    read of ``x`` and one write of the finished std, vs the several
-    masked/squared/counted intermediates plus windowed differencing of the
-    XLA cumsum path. The default stays on XLA until a recorded BENCH
-    artifact shows the fused kernel > 1× on TPU (the round-2 three-output
-    version measured 0.95× — BENCH_r02 — which is why the kernel now fuses
-    the differencing and finalization too; ``bench.py`` measures both paths
-    every TPU round).
+    On TPU this dispatches to the fully fused pallas kernel by default
+    (``ops.pallas_kernels.rolling_std_fused``): one HBM read of ``x`` and
+    one write of the finished std, vs the several masked/squared/counted
+    intermediates plus windowed differencing of the XLA cumsum path —
+    measured 2.81× on hardware (BENCH_r04_self.json; the round-2 three-output
+    version measured 0.95× and was rebuilt to fuse the differencing and
+    finalization too). ``use_pallas``/``FMRP_PALLAS`` override; other
+    platforms stay on the XLA path.
     """
     if use_pallas is None:
         use_pallas = x.ndim == 2 and _pallas_default()

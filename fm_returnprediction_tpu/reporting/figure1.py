@@ -24,6 +24,7 @@ from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
 from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
 from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
 from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.reporting.fusion import fuse_over_subsets
 
 __all__ = ["figure_cs", "rolling_slopes", "create_figure_1", "subset_sweep"]
 
@@ -48,11 +49,35 @@ class SubsetSweepEntry(NamedTuple):
     # trusting `deciles` (build_decile_table does)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("window", "min_periods", "n_deciles", "min_obs",
-                     "make_deciles"),
-)
+def _subset_one(y, x, mask, window, min_periods, n_deciles, min_obs,
+                make_deciles):
+    """One subset's figure OLS + rolling means (+ forecast deciles); the
+    body both the fused vmap and the per-subset split route compile."""
+    from fm_returnprediction_tpu.models.forecast import (
+        decile_sorts,
+        rolling_er_forecast,
+    )
+
+    cs = monthly_cs_ols(y, x, mask)
+    rolled = rolling_over_valid_rows(
+        cs.slopes, cs.month_valid, window, min_periods
+    )
+    if not make_deciles:
+        return cs, rolled, None
+    fr = rolling_er_forecast(
+        y, x, mask, window=window, min_periods=min_periods, cs=cs
+    )
+    dec = decile_sorts(
+        fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
+    )
+    return cs, rolled, dec
+
+
+_SWEEP_STATICS = ("window", "min_periods", "n_deciles", "min_obs",
+                  "make_deciles")
+
+
+@functools.partial(jax.jit, static_argnames=_SWEEP_STATICS)
 def _subset_sweep_device(y, x, masks, window, min_periods, n_deciles,
                          min_obs, make_deciles):
     """Figure OLS + rolling means (+ forecast deciles) for EVERY subset in
@@ -60,28 +85,20 @@ def _subset_sweep_device(y, x, masks, window, min_periods, n_deciles,
     figure/decile reporting family, instead of per-subset dispatches plus
     a dozen scalar pulls each (which dominate on remote TPU backends).
     The big (T, N) forecast intermediates stay on device; only per-month
-    and per-decile summaries leave."""
-    from fm_returnprediction_tpu.models.forecast import (
-        decile_sorts,
-        rolling_er_forecast,
-    )
+    and per-decile summaries leave. At real shape the subset vmap
+    multiplies the program past what the TPU compiler handles — callers
+    gate on ``reporting.fusion.fuse_over_subsets`` and fall back to
+    ``_subset_one_device`` per subset."""
+    return jax.vmap(
+        lambda m: _subset_one(y, x, m, window, min_periods, n_deciles,
+                              min_obs, make_deciles)
+    )(masks)
 
-    def one(mask):
-        cs = monthly_cs_ols(y, x, mask)
-        rolled = rolling_over_valid_rows(
-            cs.slopes, cs.month_valid, window, min_periods
-        )
-        if not make_deciles:
-            return cs, rolled, None
-        fr = rolling_er_forecast(
-            y, x, mask, window=window, min_periods=min_periods, cs=cs
-        )
-        dec = decile_sorts(
-            fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
-        )
-        return cs, rolled, dec
 
-    return jax.vmap(one)(masks)
+_subset_one_device = functools.partial(jax.jit,
+                                       static_argnames=_SWEEP_STATICS)(
+    _subset_one
+)
 
 
 def subset_sweep(
@@ -104,12 +121,26 @@ def subset_sweep(
     y = jnp.asarray(panel.var(return_col))
     x = jnp.asarray(panel.select(xvars))
     stacked = jnp.stack([jnp.asarray(subset_masks[n]) for n in names])
-    out = jax.device_get(
-        _subset_sweep_device(
-            y, x, stacked, window, min_periods, n_deciles, min_obs,
-            make_deciles,
+    t, n = y.shape
+    if fuse_over_subsets(len(names), t, n, x.shape[-1], x.dtype.itemsize):
+        out = jax.device_get(
+            _subset_sweep_device(
+                y, x, stacked, window, min_periods, n_deciles, min_obs,
+                make_deciles,
+            )
         )
-    )
+    else:
+        # Real-shape route (fusion module docstring): one program per
+        # subset — identical shapes, so all subsets share one compile —
+        # with the device results stacked host-side after a single pull.
+        per = jax.device_get([
+            _subset_one_device(
+                y, x, stacked[i], window, min_periods, n_deciles, min_obs,
+                make_deciles,
+            )
+            for i in range(len(names))
+        ])
+        out = jax.tree.map(lambda *leaves: np.stack(leaves), *per)
     cs_all, rolled_all, dec_all = out
     params = (window, min_periods, n_deciles, min_obs)
     return {

@@ -1,0 +1,51 @@
+"""Dispatch-fusion policy for the subset-vmapped reporting programs.
+
+Table 2 (``table2._fm_sweep``) and the figure/decile family
+(``figure1._subset_sweep_device``) each fuse their per-subset computations
+into ONE compiled program by vmapping over a stacked mask tensor — on a
+remote/tunneled TPU backend the per-dispatch round trip dominates at small
+shapes, so fewer dispatches win. At REAL CRSP shape the same fusion is the
+wrong trade: the subset vmap multiplies the batched tall-skinny QR
+footprint (~2.5 GB of augmented design for Table 2 at T600×N22k) and the
+fused program reproducibly crashed the TPU compile helper (round-4 bench,
+``real_pipeline_accel_error_frames: table2.py:build_table_2``) while the
+SAME cells compile and run fine as separate per-cell programs (~33 s
+compile each, shape-cached across subsets).
+
+The policy is a single byte threshold on the stacked augmented-design
+footprint ``n_subsets · T · N · (P + 2) · itemsize`` — the tensor the
+subset vmap actually multiplies. Below it, fuse (small-shape dispatch
+latency wins); above it, split into per-cell dispatches whose results are
+still pulled with one ``device_get``. ``FMRP_FUSE_SUBSETS_MB`` overrides
+the default budget; 0 forces the split everywhere (used by the parity
+tests to exercise both routes).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["fuse_over_subsets", "stacked_design_bytes"]
+
+# 512 MB keeps every shape that has ever compiled fused (toy T600×N800 ≈
+# 92 MB; the largest test shapes are far smaller) and splits the real
+# T600×N22k shape (≈ 2.5 GB for Table 2, ≈ 1.3 GB for the figure family),
+# whose fused programs crashed or timed out the TPU remote compiler.
+_DEFAULT_BUDGET_MB = 512.0
+
+
+def stacked_design_bytes(n_subsets: int, t: int, n: int, p: int,
+                         itemsize: int) -> int:
+    """Bytes of the subset-stacked augmented design ``[1 | X | y]`` — the
+    dominant tensor the per-subset vmap multiplies (intercept + P
+    predictors + regressand columns, masked per subset)."""
+    return n_subsets * t * n * (p + 2) * itemsize
+
+
+def fuse_over_subsets(n_subsets: int, t: int, n: int, p: int,
+                      itemsize: int) -> bool:
+    """True → run the fused subset-vmapped program; False → per-cell."""
+    budget_mb = float(os.environ.get("FMRP_FUSE_SUBSETS_MB",
+                                     _DEFAULT_BUDGET_MB))
+    return stacked_design_bytes(n_subsets, t, n, p, itemsize) \
+        <= budget_mb * 2**20

@@ -28,6 +28,7 @@ from fm_returnprediction_tpu.models.lewellen import MODELS, ModelSpec
 from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
 from fm_returnprediction_tpu.panel.dense import DensePanel
 from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
+from fm_returnprediction_tpu.reporting.fusion import fuse_over_subsets
 
 __all__ = ["build_table_2", "run_model_fm"]
 
@@ -53,7 +54,11 @@ def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver, min_months, weight):
     round-trip latency dominated the whole reporting stage. Here the model
     loop is static (different predictor counts → different shapes), subsets
     vmap over a stacked mask tensor, and the caller pulls the full summary
-    pytree with one ``jax.device_get``.
+    pytree with one ``jax.device_get``. Used below the ``reporting.fusion``
+    footprint budget only: at real CRSP shape the subset vmap multiplies
+    the batched tall-QR program past what the TPU compiler survives
+    (round-4 bench artifact), so ``build_table_2`` splits into per-cell
+    dispatches there.
     """
     out = []
     for idx in idxs:  # static: one branch per model, inlined by trace
@@ -104,9 +109,10 @@ def run_model_fm(
     device-resident precomputed tensors so sweep callers can push the
     predictor union once and slice per model on device. ``build_table_2``
     routes through this function on the mesh path; its single-device path
-    uses the fused ``_fm_sweep`` program instead (one dispatch for all 9
-    cells) with the same ``TABLE2_*`` hyperparameters, so results are
-    identical."""
+    uses the fused ``_fm_sweep`` program (one dispatch for all 9 cells)
+    below the ``reporting.fusion`` budget and per-cell ``fama_macbeth``
+    dispatches above it, with the same ``TABLE2_*`` hyperparameters either
+    way, so results are identical."""
     if y is None:
         y = jnp.asarray(panel.var(return_col))
     if x is None:
@@ -177,16 +183,40 @@ def build_table_2(
             for model in models
         )
         stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
-        summaries = jax.device_get(
-            _fm_sweep(y, x_all, stacked, idxs,
-                      nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER,
-                      min_months=TABLE2_MIN_MONTHS, weight=TABLE2_WEIGHT)
-        )
-        cells = {
-            (mi, name): jax.tree.map(lambda leaf, _si=si: leaf[_si], summaries[mi])
-            for mi in range(len(models))
-            for si, name in enumerate(subset_names)
-        }
+        t, n = y.shape
+        p_max = max((len(i) for i in idxs), default=0)
+        if fuse_over_subsets(len(subset_names), t, n, p_max,
+                             x_all.dtype.itemsize):
+            summaries = jax.device_get(
+                _fm_sweep(y, x_all, stacked, idxs,
+                          nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER,
+                          min_months=TABLE2_MIN_MONTHS, weight=TABLE2_WEIGHT)
+            )
+            cells = {
+                (mi, name): jax.tree.map(
+                    lambda leaf, _si=si: leaf[_si], summaries[mi]
+                )
+                for mi in range(len(models))
+                for si, name in enumerate(subset_names)
+            }
+        else:
+            # Real-shape route: the fused program's subset vmap multiplies
+            # the batched tall-QR footprint past what the TPU compiler
+            # handles (fusion module docstring). Per-cell dispatches reuse
+            # one compiled program per model shape (subsets share it via
+            # the jit cache) and the whole cell dict still leaves the
+            # device in a single transfer.
+            device_cells = {}
+            for mi in range(len(models)):
+                x = x_all[:, :, jnp.asarray(idxs[mi])]
+                for si, name in enumerate(subset_names):
+                    _, fm = fama_macbeth(
+                        y, x, stacked[si], nw_lags=TABLE2_NW_LAGS,
+                        min_months=TABLE2_MIN_MONTHS, weight=TABLE2_WEIGHT,
+                        solver=TABLE2_SOLVER,
+                    )
+                    device_cells[(mi, name)] = fm
+            cells = jax.device_get(device_cells)
     else:
         # The firm axis is sharded: one shard_map program per model (the
         # sweep's vmap-over-subsets would replicate the mask axis through

@@ -1,6 +1,7 @@
 """End-to-end reporting layer vs pandas oracles: subsets, Table 1, Table 2,
 Figure 1 rolling slopes — on the same synthetic universe."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
@@ -163,3 +164,32 @@ def test_figure1_rolling_slopes_match_oracle(world):
         np.testing.assert_allclose(
             np.where(both_nan, 0, g), np.where(both_nan, 0, w), rtol=1e-6, atol=1e-10
         )
+
+
+def test_fusion_split_routes_match_fused(world, monkeypatch):
+    """The large-shape per-cell/per-subset routes (reporting.fusion budget
+    exceeded — the real-shape TPU compile fix) produce results identical to
+    the fused subset-vmapped programs."""
+    from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
+
+    panel, factors, masks, _ = world
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "1048576")  # force fused
+    fused_t2 = build_table_2(panel, masks, factors)
+    fused_sweep = subset_sweep(panel, masks, list(masks))
+
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")  # force the split route
+    split_t2 = build_table_2(panel, masks, factors)
+    split_sweep = subset_sweep(panel, masks, list(masks))
+
+    pd.testing.assert_frame_equal(fused_t2, split_t2)
+    assert list(fused_sweep) == list(split_sweep)
+    for name in fused_sweep:
+        f, s = fused_sweep[name], split_sweep[name]
+        np.testing.assert_array_equal(f.rolled, s.rolled)
+        for leaf_f, leaf_s in zip(jax.tree.leaves(f.cs), jax.tree.leaves(s.cs)):
+            np.testing.assert_array_equal(leaf_f, leaf_s)
+        for leaf_f, leaf_s in zip(
+            jax.tree.leaves(f.deciles), jax.tree.leaves(s.deciles)
+        ):
+            np.testing.assert_array_equal(leaf_f, leaf_s)
+        assert f.decile_params == s.decile_params
