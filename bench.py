@@ -599,10 +599,20 @@ def main() -> None:
         # stage numbers).
         os.environ.setdefault("FMRP_BENCH_REAL_BUDGET_S", "450")
         os.environ.setdefault("FMRP_BENCH_DAILY", "0")
-    sections = [_bench_pipeline, _bench_pipeline_real, _bench_kernel]
+    # Every section has an off switch so a short accelerator window can be
+    # spent on exactly the missing measurement (the tunnel comes and goes;
+    # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS = 0. Default: all on.
+    sections = []
+    if os.environ.get("FMRP_BENCH_PIPE", "1") == "1":
+        sections.append(_bench_pipeline)
+    sections.append(_bench_pipeline_real)  # _REAL=0 handled in-section
+    if os.environ.get("FMRP_BENCH_KERNEL", "1") == "1":
+        sections.append(_bench_kernel)
     if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":
         sections.append(_bench_daily_fullscale)
-    sections.append(_bench_pallas)
+    if os.environ.get("FMRP_BENCH_PALLAS", "1") == "1":
+        sections.append(_bench_pallas)
 
     # Global deadline: a section hanging in an uninterruptible C call (a
     # backend that died mid-run) must cost only the REMAINING sections, not
