@@ -3,31 +3,39 @@
 At real 1964-2013 CRSP shape, ~98 s of the end-to-end wall-clock is
 host-side pandas/parquet work a TPU cannot touch: reading the 77M-row daily
 parquet, the common-stock/exchange universe filter, the monthly relational
-transforms, and the long→compact daily ingest (BENCH_r03
-``real_pipeline_stage_s``). All of it is a pure function of the five raw
-cache files, so the pipeline checkpoints its two host products:
+transforms, the long→compact daily ingest, and the long→dense monthly
+scatter (BENCH_r03/r04 ``real_pipeline_stage_s``). All of it is a pure
+function of the five raw cache files (plus the compute dtype and the
+INCLUDE_TURNOVER column set), so the pipeline checkpoints its two host
+products:
 
-- ``monthly_merged.parquet`` — the merged CRSP×Compustat monthly frame
-  (post universe filter, market equity, book equity, CCM merge): the input
-  to ``panel.characteristics.get_factors``;
-- ``compact_daily.npz``     — the per-firm compacted daily strips + the
+- ``dense_base.npz``    — the scattered dense monthly base panel
+  (``panel.dense.DensePanel`` over BASE_COLUMNS + is_nyse): the direct
+  input to the device characteristic engine. v1 stored the merged long
+  frame instead and re-scattered it every warm run (~11 s at real shape);
+  the dense base is the same information one stage later, host-numpy at
+  capture time (no device pull to save it), and loads in the time the
+  parquet read alone used to take.
+- ``compact_daily.npz`` — the per-firm compacted daily strips + the
   shared calendar vectors (``panel.daily.CompactDaily``): the input to the
   daily vol/beta kernels.
 
 A warm run loads these two files (IO-bound, seconds) instead of redoing the
-ingest (~76 s of the ~98 s), which is the difference between the <60 s
-north-star budget being reachable and not. This extends the reference's
-cache-as-checkpoint role (``/root/reference/src/utils.py:183-218`` caches
-raw pulls; every transform recomputes each run) one stage further, the same
-way the task graph's dense-panel npz does between build and report stages.
+ingest, which is the difference between the <60 s north-star budget being
+reachable and not. This extends the reference's cache-as-checkpoint role
+(``/root/reference/src/utils.py:183-218`` caches raw pulls; every transform
+recomputes each run) one stage further, the same way the task graph's
+dense-panel npz does between build and report stages.
 
 Validity is a fingerprint over the raw files' (name, size, mtime) plus the
-compute dtype and a layout version — the make-style staleness contract: any
-re-pull or re-generation of the raw caches invalidates the checkpoint. One
-slot per raw directory (``<raw_dir>/_prepared/``), overwritten in place;
-``meta.json`` is written last (tmp + rename), so a crashed writer leaves a
-stale fingerprint, never a half-valid checkpoint. Set ``PREPARED_CACHE=0``
-to disable both reading and writing.
+compute dtype, a caller salt (the resolved INCLUDE_TURNOVER flag — it
+changes the base column set), and a layout version — the make-style
+staleness contract: any re-pull or re-generation of the raw caches
+invalidates the checkpoint. One slot per raw directory
+(``<raw_dir>/_prepared/``), overwritten in place; ``meta.json`` is written
+last (tmp + rename), so a crashed writer leaves a stale fingerprint, never
+a half-valid checkpoint. Set ``PREPARED_CACHE=0`` to disable both reading
+and writing.
 """
 
 from __future__ import annotations
@@ -40,9 +48,9 @@ from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
-import pandas as pd
 
 from fm_returnprediction_tpu.panel.daily import CompactDaily
+from fm_returnprediction_tpu.panel.dense import DensePanel
 
 __all__ = [
     "PREPARED_DIRNAME",
@@ -54,10 +62,12 @@ __all__ = [
 
 PREPARED_DIRNAME = "_prepared"
 # Bump when the prepared LAYOUT or the ingest semantics feeding it change —
-# an old checkpoint must not satisfy a new pipeline.
-_VERSION = 1
+# an old checkpoint must not satisfy a new pipeline. v2: dense base panel
+# replaced the merged long frame (long_to_dense moved inside the
+# checkpoint boundary).
+_VERSION = 2
 
-_MERGED_FILE = "monthly_merged.parquet"
+_BASE_FILE = "dense_base.npz"
 _DAILY_FILE = "compact_daily.npz"
 _META_FILE = "meta.json"
 
@@ -69,18 +79,20 @@ def prepared_enabled() -> bool:
     return bool(int(config("PREPARED_CACHE")))
 
 
-def raw_fingerprint(raw_dir, dtype) -> str:
+def raw_fingerprint(raw_dir, dtype, salt: str = "") -> str:
     """Staleness key for the checkpoint under ``raw_dir``.
 
     Hashes each raw cache file's (name, size, mtime_ns) — the make
     contract: content re-reads would cost a large fraction of what the
-    checkpoint saves. ``dtype`` is in the key because the compact strips are
-    materialized in the compute dtype.
+    checkpoint saves. ``dtype`` is in the key because the payload arrays
+    are materialized in the compute dtype; ``salt`` carries caller
+    settings that change the payload layout (the resolved INCLUDE_TURNOVER
+    flag, which adds a base column).
     """
     from fm_returnprediction_tpu.pipeline import RAW_FILE_NAMES
 
     h = hashlib.sha256()
-    h.update(f"v{_VERSION}|{np.dtype(dtype).str}".encode())
+    h.update(f"v{_VERSION}|{np.dtype(dtype).str}|{salt}".encode())
     for name in sorted(RAW_FILE_NAMES.values()):
         path = Path(raw_dir) / name
         st = path.stat()  # missing raw file: let the error surface here
@@ -89,17 +101,34 @@ def raw_fingerprint(raw_dir, dtype) -> str:
 
 
 def save_prepared(
-    prepared_dir, fingerprint: str, merged: pd.DataFrame, cd: CompactDaily
+    prepared_dir, fingerprint: str, base: DensePanel, cd: CompactDaily
 ) -> None:
     """Write the checkpoint; meta (with the fingerprint) goes LAST so a
     partial write is indistinguishable from a stale one. Failures degrade to
-    a warning — the checkpoint is an accelerant, never a correctness gate."""
+    a warning — the checkpoint is an accelerant, never a correctness gate.
+
+    Both payloads are savez UNcompressed: they are hundreds of MB of
+    near-incompressible floats at real shape, and zlib would cost more
+    than the ingest the checkpoint skips."""
     prepared_dir = Path(prepared_dir)
     try:
         prepared_dir.mkdir(parents=True, exist_ok=True)
         meta = prepared_dir / _META_FILE
         meta.unlink(missing_ok=True)  # invalidate before touching payloads
-        merged.to_parquet(prepared_dir / _MERGED_FILE, index=False)
+        # drop the v1 payload a version upgrade orphans (~0.2 GB at real
+        # shape); nothing references it once meta is v2
+        (prepared_dir / "monthly_merged.parquet").unlink(missing_ok=True)
+        months_unit = np.datetime_data(base.months.dtype)[0]
+        np.savez(
+            prepared_dir / _BASE_FILE,
+            values=np.asarray(base.values),
+            mask=np.asarray(base.mask),
+            months=base.months.astype(np.int64),
+            ids=np.asarray(base.ids),
+            # fixed-width unicode, NOT object dtype: loadable with
+            # allow_pickle off (no pickle surface in a shared artifact)
+            var_names=np.asarray(base.var_names, dtype=np.str_),
+        )
         arrays = {
             f.name: getattr(cd, f.name)
             for f in dataclasses.fields(cd)
@@ -108,13 +137,12 @@ def save_prepared(
         # datetime64 won't survive npz without a unit side-channel
         days_unit = np.datetime_data(cd.days.dtype)[0]
         arrays["days"] = cd.days.astype(np.int64)
-        # savez UNcompressed: the strips are ~0.5 GB of near-incompressible
-        # floats at real shape; zlib would cost more than the ingest it skips
         np.savez(prepared_dir / _DAILY_FILE, **arrays)
         tmp = meta.with_suffix(f".tmp{os.getpid()}")  # per-writer tmp name
         tmp.write_text(json.dumps({
             "fingerprint": fingerprint,
             "version": _VERSION,
+            "months_unit": months_unit,
             "days_unit": days_unit,
             "n_weeks": cd.n_weeks,
             "n_months": cd.n_months,
@@ -129,7 +157,7 @@ def save_prepared(
 
 def load_prepared(
     prepared_dir, fingerprint: str
-) -> Optional[Tuple[pd.DataFrame, CompactDaily]]:
+) -> Optional[Tuple[DensePanel, CompactDaily]]:
     """The checkpoint contents iff present and fingerprint-valid, else None."""
     prepared_dir = Path(prepared_dir)
     meta_path = prepared_dir / _META_FILE
@@ -140,7 +168,16 @@ def load_prepared(
     if meta.get("version") != _VERSION or meta.get("fingerprint") != fingerprint:
         return None
     try:
-        merged = pd.read_parquet(prepared_dir / _MERGED_FILE)
+        with np.load(prepared_dir / _BASE_FILE, allow_pickle=False) as z:
+            base = DensePanel(
+                values=z["values"],
+                mask=z["mask"],
+                months=z["months"].astype(
+                    f"datetime64[{meta['months_unit']}]"
+                ),
+                ids=z["ids"],
+                var_names=[str(v) for v in z["var_names"]],
+            )
         with np.load(prepared_dir / _DAILY_FILE, allow_pickle=False) as z:
             cd = CompactDaily(
                 row_values=z["row_values"],
@@ -164,4 +201,4 @@ def load_prepared(
             stacklevel=2,
         )
         return None
-    return merged, cd
+    return base, cd

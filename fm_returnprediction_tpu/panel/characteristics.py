@@ -207,6 +207,8 @@ def get_factors(
     timer=None,
     include_turnover=None,
     compact_daily=None,
+    dense_base=None,
+    capture=None,
 ) -> Tuple[DensePanel, Dict[str, str]]:
     """Dense-panel equivalent of the reference's ``get_factors``
     (``src/calc_Lewellen_2014.py:531-574``): computes all 15 characteristics
@@ -228,6 +230,14 @@ def get_factors(
     ``crsp_d``/``crsp_index_d`` frames are then ignored and may be None.
     Its month vocabulary must be the sorted unique ``jdate`` of
     ``crsp_comp`` — the vocabulary ``long_to_dense`` derives here.
+
+    ``dense_base`` accepts the prebuilt dense base panel (the
+    ``long_to_dense`` product over BASE_COLUMNS + is_nyse, also from the
+    prepared checkpoint); ``crsp_comp`` is then ignored and may be None.
+    Its column set must match the resolved ``include_turnover`` — the
+    checkpoint fingerprints the flag (``data.prepared.raw_fingerprint``).
+    ``capture``, when a dict, receives ``dense_base`` (the host-numpy base
+    panel) for the checkpoint writer.
     """
     if mesh is not None and firm_chunk is not None:
         raise ValueError(
@@ -241,7 +251,9 @@ def get_factors(
     base_columns = list(BASE_COLUMNS)
     factors_dict = dict(FACTORS_DICT)
     if include_turnover:
-        if "vol" not in crsp_comp.columns:
+        source = dense_base.var_names if dense_base is not None \
+            else crsp_comp.columns
+        if "vol" not in source:
             raise KeyError(
                 "INCLUDE_TURNOVER=1 needs a 'vol' column in the monthly "
                 "panel; re-pull CRSP monthly data (the cache may predate "
@@ -250,10 +262,16 @@ def get_factors(
         base_columns.append("vol")
         factors_dict[TURNOVER_LABEL] = TURNOVER_COLUMN
     timer = timer or StageTimer()
-    with timer.stage("factors/long_to_dense"):
-        df = crsp_comp.copy()
-        df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
-        panel = long_to_dense(df, "jdate", "permno", base_columns, dtype=dtype)
+    if dense_base is not None:
+        panel = dense_base
+    else:
+        with timer.stage("factors/long_to_dense"):
+            df = crsp_comp.copy()
+            df["is_nyse"] = (df["primaryexch"] == "N").astype(float)
+            panel = long_to_dense(df, "jdate", "permno", base_columns,
+                                  dtype=dtype)
+    if capture is not None:
+        capture["dense_base"] = panel
 
     # Compacted ingest on BOTH the single-device and mesh paths: the dense
     # (D, N) daily grid is never materialized on host or device (round-2

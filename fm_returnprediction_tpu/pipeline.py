@@ -139,8 +139,9 @@ def build_panel(
     pandas layer vs the device kernels (round-2 VERDICT item 3).
 
     ``capture``, when a dict, receives the two host-ingest products —
-    ``merged`` (monthly frame) and ``compact_daily`` (daily strips) — for
-    the prepared-inputs checkpoint (``data.prepared``);
+    ``dense_base`` (the scattered dense monthly base panel, filled in by
+    ``get_factors``) and ``compact_daily`` (daily strips) — for the
+    prepared-inputs checkpoint (``data.prepared``);
     ``build_panel_prepared`` is the matching warm-path entry."""
     timer = timer or StageTimer()
     with timer.stage("panel/universe_filter"):
@@ -170,24 +171,25 @@ def build_panel(
             data["crsp_d"], data["crsp_index_d"], months, dtype=dtype
         )
     if capture is not None:
-        capture["merged"] = merged
         capture["compact_daily"] = cd
     return get_factors(
         merged, None, None, dtype=dtype, mesh=mesh,
         timer=timer, include_turnover=include_turnover, compact_daily=cd,
+        capture=capture,
     )
 
 
 def build_panel_prepared(
-    merged: pd.DataFrame, compact_daily, dtype=np.float64, mesh=None,
+    dense_base: DensePanel, compact_daily, dtype=np.float64, mesh=None,
     timer=None, include_turnover=None,
 ) -> tuple[DensePanel, Dict[str, str]]:
     """Warm-path panel build from the prepared-inputs checkpoint: the
-    merged monthly frame and compact daily strips skip straight to the
-    dense build + device stages (``data.prepared`` docstring)."""
+    dense base panel and compact daily strips skip straight to the
+    device stages (``data.prepared`` docstring)."""
     return get_factors(
-        merged, None, None, dtype=dtype, mesh=mesh, timer=timer,
+        None, None, None, dtype=dtype, mesh=mesh, timer=timer,
         include_turnover=include_turnover, compact_daily=compact_daily,
+        dense_base=dense_base,
     )
 
 
@@ -211,6 +213,10 @@ def load_or_build_panel(
     """
     if dtype is None:
         dtype = resolve_dtype()
+    if include_turnover is None:
+        from fm_returnprediction_tpu.settings import config
+
+        include_turnover = bool(int(config("INCLUDE_TURNOVER")))
     timer = timer or StageTimer()
     from fm_returnprediction_tpu.data.prepared import (
         PREPARED_DIRNAME,
@@ -223,15 +229,19 @@ def load_or_build_panel(
     prepared = prepared_dir = fingerprint = None
     if prepared_enabled():
         prepared_dir = Path(raw_data_dir) / PREPARED_DIRNAME
-        fingerprint = raw_fingerprint(raw_data_dir, dtype)
+        # the turnover flag changes the base column set, so it is part of
+        # the checkpoint key (resolved HERE so key and payload agree)
+        fingerprint = raw_fingerprint(
+            raw_data_dir, dtype, salt=f"turnover={int(include_turnover)}"
+        )
         with timer.stage("load_prepared"):
             prepared = load_prepared(prepared_dir, fingerprint)
     if prepared is not None:
-        merged, cd = prepared
+        base, cd = prepared
         del prepared
         with timer.stage("build_panel"):
             return build_panel_prepared(
-                merged, cd, dtype=dtype, mesh=mesh, timer=timer,
+                base, cd, dtype=dtype, mesh=mesh, timer=timer,
                 include_turnover=include_turnover,
             )
     with timer.stage("load_raw_data"):
@@ -248,7 +258,7 @@ def load_or_build_panel(
         if write_prepared:
             with timer.stage("save_prepared"):
                 save_prepared(prepared_dir, fingerprint,
-                              capture["merged"], capture["compact_daily"])
+                              capture["dense_base"], capture["compact_daily"])
     # The raw frames (the 77M-row daily table in particular) and the
     # captured ingest products are dead once the panel exists; releasing
     # them cuts several GB of allocator pressure before the reporting

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["fuse_over_subsets", "stacked_design_bytes"]
+__all__ = ["fuse_budget_bytes", "fuse_over_subsets", "stacked_design_bytes"]
 
 # 512 MB keeps every shape that has ever compiled fused (toy T600×N800 ≈
 # 92 MB; the largest test shapes are far smaller) and splits the real
@@ -42,10 +42,18 @@ def stacked_design_bytes(n_subsets: int, t: int, n: int, p: int,
     return n_subsets * t * n * (p + 2) * itemsize
 
 
+def fuse_budget_bytes() -> float:
+    """The fusion byte budget (``FMRP_FUSE_SUBSETS_MB`` override).
+
+    Callers whose dominant vmapped temporary is not an augmented OLS
+    design (Table 1's three same-shape ``(S, T, N, K)`` broadcasts, say)
+    compare their own footprint estimate against this same budget."""
+    return float(os.environ.get("FMRP_FUSE_SUBSETS_MB",
+                                _DEFAULT_BUDGET_MB)) * 2**20
+
+
 def fuse_over_subsets(n_subsets: int, t: int, n: int, p: int,
                       itemsize: int) -> bool:
     """True → run the fused subset-vmapped program; False → per-cell."""
-    budget_mb = float(os.environ.get("FMRP_FUSE_SUBSETS_MB",
-                                     _DEFAULT_BUDGET_MB))
     return stacked_design_bytes(n_subsets, t, n, p, itemsize) \
-        <= budget_mb * 2**20
+        <= fuse_budget_bytes()

@@ -25,6 +25,7 @@ import numpy as np
 import pandas as pd
 
 from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.reporting.fusion import fuse_budget_bytes
 
 __all__ = ["build_table_1", "table1_stats"]
 
@@ -70,14 +71,30 @@ def build_table_1(
 ) -> pd.DataFrame:
     """Assemble the reference-layout Table 1 DataFrame.
 
-    All subsets run in one vmapped dispatch and one host pull — per-subset
-    round trips are what a remote TPU backend charges for."""
+    Below the ``reporting.fusion`` footprint budget all subsets run in one
+    vmapped dispatch and one host pull — per-subset round trips are what a
+    remote TPU backend charges for. Above it (real shape), one dispatch
+    per subset: the subset vmap triples the (T, N, K) broadcast
+    temporaries, which on the CPU fallback thrashes cache and on TPU
+    inflates the program for no fusion win at these sizes."""
     var_cols = [panel.var_index(col) for col in variables_dict.values()]
     values = jnp.asarray(panel.values[:, :, var_cols])
-    stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
-    avg, std, n = jax.device_get(
-        jax.vmap(lambda m: table1_stats(values, m))(stacked)
-    )
+    t, n_firms, k = values.shape
+    # table1_stats holds ~3 same-shape (T, N, K) temporaries (valid, x,
+    # centered), so the fused footprint is ~3 subset-stacked copies — not
+    # the augmented-design model stacked_design_bytes prices.
+    fused_bytes = 3 * len(subset_masks) * t * n_firms * k * values.dtype.itemsize
+    if fused_bytes <= fuse_budget_bytes():
+        stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
+        avg, std, n = jax.device_get(
+            jax.vmap(lambda m: table1_stats(values, m))(stacked)
+        )
+    else:
+        per = jax.device_get([
+            table1_stats(values, jnp.asarray(m))
+            for m in subset_masks.values()
+        ])
+        avg, std, n = (np.stack(leaf) for leaf in zip(*per))
 
     partials = []
     for si, subset_name in enumerate(subset_masks):

@@ -1,13 +1,14 @@
 """Prepared-inputs checkpoint (``data.prepared``): the warm-run host-ingest
 skip. Contracts under test:
 
-- save/load roundtrip preserves the merged monthly frame and every compact
+- save/load roundtrip preserves the dense base panel and every compact
   daily strip exactly;
 - the fingerprint follows the make-style staleness rule (stable for
-  untouched raw files, changed on any size/mtime change, dtype-sensitive);
+  untouched raw files, changed on any size/mtime change, dtype- and
+  salt-sensitive);
 - ``run_pipeline`` transparently writes the checkpoint on the first run and
   loads it on the second — skipping load_raw_data/universe_filter/
-  daily_ingest — with BIT-IDENTICAL tables;
+  daily_ingest/long_to_dense — with BIT-IDENTICAL tables;
 - a corrupt or half-written checkpoint degrades to a rebuild, never an
   error (meta-last write ordering);
 - ``PREPARED_CACHE=0`` disables the path entirely.
@@ -18,7 +19,6 @@ import os
 import time
 
 import numpy as np
-import pandas as pd
 import pytest
 
 from fm_returnprediction_tpu.data.prepared import (
@@ -47,6 +47,8 @@ def test_fingerprint_staleness_contract(raw_dir):
     fp = raw_fingerprint(raw_dir, np.float64)
     assert fp == raw_fingerprint(raw_dir, np.float64)  # stable
     assert fp != raw_fingerprint(raw_dir, np.float32)  # dtype-sensitive
+    # salt-sensitive: the turnover flag changes the base column set
+    assert fp != raw_fingerprint(raw_dir, np.float64, salt="turnover=1")
 
     victim = next(raw_dir.glob("*.parquet"))
     st = victim.stat()
@@ -61,18 +63,20 @@ def test_roundtrip_and_corruption(raw_dir, tmp_path):
 
     capture = {}
     build_panel(load_raw_data(raw_dir), capture=capture)
-    merged, cd = capture["merged"], capture["compact_daily"]
+    base, cd = capture["dense_base"], capture["compact_daily"]
 
     fp = raw_fingerprint(raw_dir, np.float64)
-    save_prepared(tmp_path, fp, merged, cd)
+    save_prepared(tmp_path, fp, base, cd)
 
     assert load_prepared(tmp_path, "not-the-fingerprint") is None
     got = load_prepared(tmp_path, fp)
     assert got is not None
-    merged2, cd2 = got
-    pd.testing.assert_frame_equal(
-        merged2.reset_index(drop=True), merged.reset_index(drop=True)
-    )
+    base2, cd2 = got
+    np.testing.assert_array_equal(base2.values, np.asarray(base.values))
+    np.testing.assert_array_equal(base2.mask, np.asarray(base.mask))
+    np.testing.assert_array_equal(base2.months, base.months)
+    np.testing.assert_array_equal(base2.ids, base.ids)
+    assert base2.var_names == base.var_names
     np.testing.assert_array_equal(cd2.row_values, cd.row_values)
     np.testing.assert_array_equal(cd2.row_pos, cd.row_pos)
     np.testing.assert_array_equal(cd2.offsets, cd.offsets)
@@ -109,7 +113,8 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     assert "load_prepared" in warm.timer.durations
     for skipped in ("load_raw_data", "panel/universe_filter",
                     "panel/market_equity", "panel/ccm_merge",
-                    "factors/daily_ingest", "save_prepared"):
+                    "factors/daily_ingest", "factors/long_to_dense",
+                    "save_prepared"):
         assert skipped not in warm.timer.durations, skipped
     assert _tables(warm) == _tables(cold)  # bit-identical reporting
 

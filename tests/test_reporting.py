@@ -174,13 +174,16 @@ def test_fusion_split_routes_match_fused(world, monkeypatch):
 
     panel, factors, masks, _ = world
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "1048576")  # force fused
+    fused_t1 = build_table_1(panel, masks, factors)
     fused_t2 = build_table_2(panel, masks, factors)
     fused_sweep = subset_sweep(panel, masks, list(masks))
 
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")  # force the split route
+    split_t1 = build_table_1(panel, masks, factors)
     split_t2 = build_table_2(panel, masks, factors)
     split_sweep = subset_sweep(panel, masks, list(masks))
 
+    pd.testing.assert_frame_equal(fused_t1, split_t1)
     pd.testing.assert_frame_equal(fused_t2, split_t2)
     assert list(fused_sweep) == list(split_sweep)
     for name in fused_sweep:
