@@ -98,6 +98,27 @@ def test_roundtrip_and_corruption(raw_dir, tmp_path):
         assert load_prepared(tmp_path, fp) is None
 
 
+def test_v1_checkpoint_upgrade(raw_dir, tmp_path):
+    """A v1-layout slot (older meta version + the merged-frame payload) is
+    a clean miss, and the next save removes the orphaned v1 payload."""
+    from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
+
+    v1_payload = tmp_path / "monthly_merged.parquet"
+    v1_payload.write_bytes(b"stale v1 payload")
+    fp = raw_fingerprint(raw_dir, np.float64)
+    (tmp_path / "meta.json").write_text(
+        json.dumps({"fingerprint": fp, "version": 1})
+    )
+    assert load_prepared(tmp_path, fp) is None  # version mismatch → miss
+
+    capture = {}
+    build_panel(load_raw_data(raw_dir), capture=capture)
+    save_prepared(tmp_path, fp, capture["dense_base"],
+                  capture["compact_daily"])
+    assert not v1_payload.exists()
+    assert load_prepared(tmp_path, fp) is not None
+
+
 def _tables(res):
     return res.table_1.to_string() + res.table_2.to_string()
 
