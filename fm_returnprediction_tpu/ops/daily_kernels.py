@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from fm_returnprediction_tpu.ops.compaction import compact, make_compaction, scatter_back
-from fm_returnprediction_tpu.ops.rolling import rolling_std, windowed_count, windowed_sum
+from fm_returnprediction_tpu.ops.rolling import rolling_std, windowed_sum
 
 __all__ = [
     "last_obs_per_month",

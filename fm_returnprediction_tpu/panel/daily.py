@@ -14,7 +14,7 @@ import numpy as np
 import pandas as pd
 from pandas.tseries.offsets import MonthEnd
 
-from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
+from fm_returnprediction_tpu.panel.dense import long_to_dense
 
 __all__ = [
     "DailyPanel",

@@ -23,7 +23,6 @@ asserted by the test suite via compiled-HLO inspection).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

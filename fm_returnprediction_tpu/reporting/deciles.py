@@ -17,7 +17,6 @@ import pandas as pd
 
 from fm_returnprediction_tpu.models.forecast import decile_sorts, rolling_er_forecast
 from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
-from fm_returnprediction_tpu.reporting.figure1 import figure_cs
 from fm_returnprediction_tpu.panel.dense import DensePanel
 from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from fm_returnprediction_tpu.settings import config, create_dirs
 from fm_returnprediction_tpu.taskgraph.engine import Task
