@@ -1,0 +1,44 @@
+"""Unit contract of ``reporting.fusion`` — the policy that decides between
+the fused subset-vmapped reporting programs and per-cell dispatches (the
+real-shape TPU compile fix). The end-to-end bit-identity of the two routes
+is covered in ``test_reporting.py::test_fusion_split_routes_match_fused``;
+here: the footprint model, the budget boundary, and the env override.
+"""
+
+from fm_returnprediction_tpu.reporting.fusion import (
+    fuse_budget_bytes,
+    fuse_over_subsets,
+    stacked_design_bytes,
+)
+
+
+def test_footprint_model():
+    # n_subsets * t * n * (p + 2) * itemsize, exactly
+    assert stacked_design_bytes(3, 600, 22000, 14, 4) == 3 * 600 * 22000 * 16 * 4
+
+
+def test_default_budget_splits_real_shape_and_fuses_toy():
+    # real CRSP shape (~2.5 GB) must split; the toy bench shape (~92 MB)
+    # and every test shape must fuse — the two regimes the default budget
+    # was chosen to separate
+    assert not fuse_over_subsets(3, 600, 22000, 14, 4)
+    assert fuse_over_subsets(3, 600, 800, 14, 4)
+    assert fuse_over_subsets(3, 84, 40, 14, 8)
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")
+    assert fuse_budget_bytes() == 0
+    assert not fuse_over_subsets(1, 1, 1, 1, 4)  # any footprint > 0 splits
+
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "1048576")  # 1 TiB
+    assert fuse_over_subsets(3, 600, 22000, 14, 4)
+
+
+def test_budget_boundary_is_inclusive(monkeypatch):
+    bytes_needed = stacked_design_bytes(2, 10, 100, 3, 4)
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", str(bytes_needed / 2**20))
+    assert fuse_over_subsets(2, 10, 100, 3, 4)  # == budget → fuse
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB",
+                       str((bytes_needed - 1) / 2**20))
+    assert not fuse_over_subsets(2, 10, 100, 3, 4)
