@@ -49,9 +49,11 @@ import time
 
 import numpy as np
 
-# The live CPU-rescue child, if any — published by _real_cpu_rescue so the
-# deadline watchdog can kill it before hard-exiting the parent.
-_RESCUE_PROC = None
+# The live full-scale child pipeline, if any (CPU rescue or mesh8) —
+# published so the deadline watchdog can kill it before hard-exiting the
+# parent: an orphaned real-shape run would burn the host into the next
+# round's measurements.
+_CHILD_PROC = None
 
 
 def _make_panel(t, n, p, dtype=np.float32, seed=2014):
@@ -121,9 +123,17 @@ def _bench_kernel(fast: bool):
 
 
 def _run_pipeline_timed(raw_dir):
-    """One pipeline run → (wall seconds, per-stage seconds)."""
-    from fm_returnprediction_tpu.pipeline import run_pipeline
+    """One pipeline run → (wall seconds, per-stage seconds).
 
+    Enables the persistent compilation cache HERE, not only in ``main``:
+    this helper is also the entry the CPU-rescue and mesh8 CHILD processes
+    call, and cross-process compile reuse (the per-cell reporting
+    programs) only happens if every process points at the same
+    ``_cache/jax``."""
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.settings import enable_compilation_cache
+
+    enable_compilation_cache()
     t0 = time.perf_counter()
     res = run_pipeline(
         raw_data_dir=raw_dir, make_figure=True,
@@ -180,6 +190,13 @@ def _bench_pipeline_real(fast: bool):
     # parse BEFORE the expensive runs: a malformed value must fail fast,
     # not throw away a completed full-scale cold measurement
     budget = float(os.environ.get("FMRP_BENCH_REAL_BUDGET_S", 1500))
+    # Honest stage attribution: JAX dispatch is async, so without barriers
+    # whichever stage first pulls to host absorbs every queued upstream
+    # device computation (r4's artifact charged Table 1 47 s at real shape;
+    # its true warm compute is ~5 s). The barriers cost ~a round trip per
+    # coarse stage — disclosed here rather than silently skewing the
+    # breakdown (utils.timing.stage_sync).
+    os.environ.setdefault("FMRP_SYNC_STAGES", "1")
     raw_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "_cache", f"benchscale_T{t}_N{n}"
     )
@@ -218,6 +235,8 @@ def _bench_pipeline_real(fast: bool):
         "real_pipeline_cold_s": round(cold, 4),
         "real_pipeline_gen_s": round(gen, 2),
         "real_pipeline_shape": f"T{t}_N{n}",
+        "real_pipeline_sync_stages":
+            os.environ.get("FMRP_SYNC_STAGES") == "1",
     }
     # Soft budget: on a slow interconnect a second full-scale run can blow
     # the driver's bench window — better a recorded cold number + breakdown
@@ -294,7 +313,7 @@ def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
         "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
         "print('RESCUE ' + json.dumps({'wall': wall, 'stages': stages}))\n"
     )
-    global _RESCUE_PROC
+    global _CHILD_PROC
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", child, raw_dir],
@@ -304,7 +323,7 @@ def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
         # published so the deadline watchdog can kill the child before
         # hard-exiting — an orphaned full-scale CPU run would otherwise
         # burn the host for up to `budget` seconds into the next round
-        _RESCUE_PROC = proc
+        _CHILD_PROC = proc
         try:
             stdout, stderr = proc.communicate(timeout=budget)
         except subprocess.TimeoutExpired:
@@ -313,7 +332,7 @@ def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
             return {"real_pipeline_rescue_error":
                     f"rescue exceeded budget {budget:.0f}s"}
         finally:
-            _RESCUE_PROC = None
+            _CHILD_PROC = None
         line = [l for l in stdout.splitlines() if l.startswith("RESCUE ")]
         if proc.returncode != 0 or not line:
             return {"real_pipeline_rescue_error": (stderr or stdout)[-300:]}
@@ -377,17 +396,37 @@ def _bench_daily_fullscale(fast: bool):
     t0 = time.perf_counter()
     daily_characteristics_compact_chunked(**args)
     warm = time.perf_counter() - t0
-    return {
+    out = {
         "daily_fullscale_cold_s": round(cold, 4),
         "daily_fullscale_warm_s": round(warm, 4),
         "daily_fullscale_rows": r,
         "daily_fullscale_rows_per_s": int(r / warm),
         "daily_shape": f"D{d_days}_N{n_firms}",
     }
+    # In-situ pallas contribution (TPU only, where pallas is the default):
+    # the same stage with the XLA cumsum vol path isolates what the fused
+    # rolling-std kernel buys INSIDE the production chunked pipeline —
+    # the number the weekly-beta-kernel decision needs (a beta pallas
+    # variant only pays if the vol kernel's in-situ win is material).
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        daily_characteristics_compact_chunked(**args, use_pallas=False)
+        t0 = time.perf_counter()
+        daily_characteristics_compact_chunked(**args, use_pallas=False)
+        out["daily_fullscale_warm_xla_s"] = round(time.perf_counter() - t0, 4)
+    return out
 
 
 def _bench_pallas(fast: bool):
-    """Fused pallas rolling-moments kernel vs the XLA cumsum path (TPU only)."""
+    """Fused pallas rolling-moments kernel vs the XLA cumsum path (TPU only).
+
+    Two shapes each round (round-4 VERDICT item 6: the default flipped ON
+    from ONE shape's measurement): the original wide strip and the ACTUAL
+    chunked production strip — D=12608 days × the ``auto_firm_chunk``
+    width (``ops/daily_chunked.py``: ``(1<<25)//12608 // 128*128`` = 2560
+    columns), the shape ``daily_characteristics_compact_chunked`` really
+    dispatches at real scale."""
     import jax
     import jax.numpy as jnp
 
@@ -396,33 +435,129 @@ def _bench_pallas(fast: bool):
 
     from fm_returnprediction_tpu.ops.rolling import rolling_std
 
-    d, n = (1024, 512) if fast else (12608, 4096)
-    x = jnp.asarray(
-        (np.random.default_rng(0).standard_normal((d, n)) * 0.02).astype(np.float32)
+    shapes = ([(1024, 512)] if fast
+              else [(12608, 4096), (12608, 2560)])  # wide strip, prod strip
+    out = {}
+    rng = np.random.default_rng(0)
+    for d, n in shapes:
+        x = jnp.asarray((rng.standard_normal((d, n)) * 0.02).astype(np.float32))
+
+        def run(use_pallas, x=x):
+            # The timed region syncs by pulling a SCALAR device-side
+            # reduction: pulling the full (D, N) result would time the
+            # tunnel/PCIe transfer of ~200 MB, not the kernel (the r2
+            # bench's 0.95x was polluted exactly this way). jnp.sum depends
+            # on every output element, so the scalar pull is a true
+            # execution barrier.
+            f = jax.jit(
+                lambda v: jnp.nansum(
+                    rolling_std(v, 252, 100, use_pallas=use_pallas)
+                )
+            )
+            float(f(x))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(10):
+                s = f(x)
+            float(s)
+            return (time.perf_counter() - t0) / 10 * 1000
+
+        xla_ms = run(False)
+        pallas_ms = run(True)
+        suffix = "" if (d, n) == shapes[0] else f"_{d}x{n}"
+        out.update({
+            f"rolling_std_pallas_ms{suffix}": round(pallas_ms, 3),
+            f"rolling_std_xla_ms{suffix}": round(xla_ms, 3),
+            f"rolling_std_pallas_speedup{suffix}": round(xla_ms / pallas_ms, 2),
+        })
+    return out
+
+
+def _jax_cache_stats() -> dict:
+    """Entry count + bytes of the persistent XLA compilation cache
+    (``_cache/jax``) — the artifact-side evidence for whether the split
+    reporting routes' per-cell programs survive across processes/rounds
+    (round-4 VERDICT item 4)."""
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_cache", "jax"
     )
-
-    def run(use_pallas):
-        # The timed region syncs by pulling a SCALAR device-side reduction:
-        # pulling the full (D, N) result would time the tunnel/PCIe transfer
-        # of ~200 MB, not the kernel (the r2 bench's 0.95x was polluted
-        # exactly this way). jnp.sum depends on every output element, so the
-        # scalar pull is a true execution barrier.
-        f = jax.jit(
-            lambda v: jnp.nansum(rolling_std(v, 252, 100, use_pallas=use_pallas))
+    try:
+        names = os.listdir(cache_dir)
+        total = sum(
+            os.path.getsize(os.path.join(cache_dir, f))
+            for f in names
+            if os.path.isfile(os.path.join(cache_dir, f))
         )
-        float(f(x))  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(10):
-            s = f(x)
-        float(s)
-        return (time.perf_counter() - t0) / 10 * 1000
+        return {"entries": len(names), "bytes": total}
+    except OSError:
+        return {"entries": 0, "bytes": 0}
 
-    xla_ms = run(False)
-    pallas_ms = run(True)
+
+def _bench_mesh8(fast: bool):
+    """Full real-shape pipeline over a VIRTUAL 8-device CPU mesh — the
+    multi-chip perf story as a durable artifact (round-4 VERDICT item 7:
+    narrated in architecture.md but recorded in no ``BENCH_r*.json``).
+
+    Runs in a fresh subprocess: ``xla_force_host_platform_device_count``
+    must be set before backend init, and the parent may hold a TPU
+    client. Default ON only when the round has a working accelerator (on
+    a CPU-fallback round the host is the sole compute and a second
+    real-shape run could blow the driver's bench window);
+    ``FMRP_BENCH_MESH8=1/0`` overrides either way."""
+    import subprocess
+    import sys
+
+    if fast or os.environ.get("FMRP_BENCH_MESH8", "0") == "0":
+        return {}
+    t = int(os.environ.get("FMRP_BENCH_REAL_MONTHS", 600))
+    n = int(os.environ.get("FMRP_BENCH_REAL_FIRMS", 22000))
+    budget = float(os.environ.get("FMRP_BENCH_MESH8_BUDGET_S", 900))
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    raw_dir = os.path.join(repo_root, "_cache", f"benchscale_T{t}_N{n}")
+    if not os.path.isdir(raw_dir):
+        return {"mesh8_skipped": "no benchscale cache (real section ran?)"}
+
+    env = _child_env(repo_root)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["MESH_DEVICES"] = "8"
+    child = (
+        "import json, sys, bench\n"
+        "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
+        "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+    )
+    global _CHILD_PROC
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child, raw_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo_root,
+        )
+        # published so the deadline watchdog kills this full-scale child
+        # too (same invariant as the CPU rescue: an orphaned real-shape
+        # run must not outlive the bench into the next round)
+        _CHILD_PROC = proc
+        try:
+            stdout, stderr = proc.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return {"mesh8_error": f"exceeded budget {budget:.0f}s"}
+        finally:
+            _CHILD_PROC = None
+    except Exception as exc:  # noqa: BLE001 - section is best-effort
+        return {"mesh8_error": repr(exc)[:300]}
+    lines = [l for l in stdout.splitlines() if l.startswith("MESH8 ")]
+    if proc.returncode != 0 or not lines:
+        return {"mesh8_error": (stderr or stdout)[-300:]}
+    got = json.loads(lines[-1][len("MESH8 "):])
     return {
-        "rolling_std_pallas_ms": round(pallas_ms, 3),
-        "rolling_std_xla_ms": round(xla_ms, 3),
-        "rolling_std_pallas_speedup": round(xla_ms / pallas_ms, 2),
+        "mesh8_pipeline_wall_s": round(got["wall"], 4),
+        "mesh8_pipeline_stage_s": {
+            k: round(v, 3) for k, v in got["stages"].items()
+        },
+        "mesh8_shape": f"T{t}_N{n}",
+        "mesh8_device": "cpu-virtual-8",
     }
 
 
@@ -579,7 +714,18 @@ def main() -> None:
     extra = {
         "device": devices[0].platform,
         "n_devices": len(devices),
+        # before/after pair quantifies what this run ADDED to the
+        # persistent XLA compilation cache — the cross-process compile
+        # bill evidence for the split per-cell reporting programs
+        "jax_cache_before": _jax_cache_stats(),
     }
+    if devices[0].platform == "tpu":
+        # a TPU round also records the virtual-mesh multi-chip pipeline
+        # (a CPU-subprocess measurement — cheap relative to the TPU
+        # window, durable in the artifact); on CPU-only rounds the host
+        # is the sole compute and a second real-shape run could blow the
+        # driver's bench window, so it stays opt-in there
+        os.environ.setdefault("FMRP_BENCH_MESH8", "1")
     if accel_down is not None:
         # Accelerator outage, CPU fallback: disclose it, and shrink the
         # kernel section (a 10k-replicate bootstrap sweep is a TPU shape —
@@ -602,7 +748,8 @@ def main() -> None:
     # Every section has an off switch so a short accelerator window can be
     # spent on exactly the missing measurement (the tunnel comes and goes;
     # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
-    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS = 0. Default: all on.
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _MESH8 = 0. Default: all
+    # on except _MESH8, which defaults on only with a live accelerator.
     sections = []
     if os.environ.get("FMRP_BENCH_PIPE", "1") == "1":
         sections.append(_bench_pipeline)
@@ -613,6 +760,7 @@ def main() -> None:
         sections.append(_bench_daily_fullscale)
     if os.environ.get("FMRP_BENCH_PALLAS", "1") == "1":
         sections.append(_bench_pallas)
+    sections.append(_bench_mesh8)  # _MESH8 gate handled in-section
 
     # Global deadline: a section hanging in an uninterruptible C call (a
     # backend that died mid-run) must cost only the REMAINING sections, not
@@ -630,7 +778,7 @@ def main() -> None:
                 _emit_line({**extra, "bench_deadline_exceeded_s": deadline})
                 # a still-running CPU rescue child must not outlive the
                 # bench into the next round's measurements
-                child = _RESCUE_PROC
+                child = _CHILD_PROC
                 if child is not None:
                     child.kill()
             finally:
@@ -654,6 +802,7 @@ def main() -> None:
                 extra[f"{section.__name__}_error_frames"] = _error_frames(exc)
 
     bench_done.set()
+    extra["jax_cache_after"] = _jax_cache_stats()
     _emit_line(extra)
 
 

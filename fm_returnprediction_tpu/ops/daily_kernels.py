@@ -103,7 +103,7 @@ def rolling_vol_252_monthly(
     if use_pallas is None:
         from fm_returnprediction_tpu.ops.rolling import _pallas_default
 
-        use_pallas = _pallas_default()
+        use_pallas = _pallas_default(ret_d)
     return _rolling_vol_252_monthly(
         ret_d, mask_d, month_id, n_months, window, min_periods, use_pallas
     )

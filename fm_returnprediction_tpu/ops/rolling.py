@@ -73,7 +73,7 @@ def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
     return finalize_mean(total, windowed_count(finite, window), min_periods)
 
 
-def _pallas_default() -> bool:
+def _pallas_default(x=None) -> bool:
     """Whether ``rolling_std`` dispatches to the fused pallas kernel.
 
     Default ON on TPU: the rebuilt fully fused kernel (one HBM read, one
@@ -86,7 +86,14 @@ def _pallas_default() -> bool:
     elsewhere — the kernel is TPU-only by construction and interpret mode
     is a correctness harness, not a fast path. ``FMRP_PALLAS=1/0``
     overrides either way; ``bench.py`` keeps measuring both paths every
-    TPU round so a regression shows up in the artifact."""
+    TPU round so a regression shows up in the artifact.
+
+    The platform is read from ``x``'s committed placement when it has one
+    — a process with a TPU backend can still run host-side parity checks
+    on CPU-placed arrays (``jax.default_device`` / ``device_put``), and
+    those must not dispatch the TPU-only kernel. Traced values and bare
+    numpy inputs fall back to the default backend, which is where they
+    will land."""
     import os
 
     flag = os.environ.get("FMRP_PALLAS")
@@ -94,6 +101,13 @@ def _pallas_default() -> bool:
         return flag.strip().lower() in ("1", "true", "yes", "on")
     import jax
 
+    devices = None
+    if x is not None:
+        sharding = getattr(x, "sharding", None)  # absent on tracers/numpy
+        if sharding is not None:
+            devices = getattr(sharding, "_device_assignment", None)
+    if devices:
+        return devices[0].platform == "tpu"
     return jax.devices()[0].platform == "tpu"
 
 
@@ -139,7 +153,7 @@ def rolling_std(
     platforms stay on the XLA path.
     """
     if use_pallas is None:
-        use_pallas = x.ndim == 2 and _pallas_default()
+        use_pallas = x.ndim == 2 and _pallas_default(x)
     if use_pallas:
         from fm_returnprediction_tpu.ops.pallas_kernels import rolling_std_fused
 

@@ -48,7 +48,7 @@ from fm_returnprediction_tpu.ops.quantiles import winsorize_cs
 from fm_returnprediction_tpu.ops.rolling import rolling_mean, rolling_prod, rolling_sum
 from fm_returnprediction_tpu.panel.daily import build_compact_daily
 from fm_returnprediction_tpu.panel.dense import DensePanel, long_to_dense
-from fm_returnprediction_tpu.utils.timing import StageTimer
+from fm_returnprediction_tpu.utils.timing import StageTimer, stage_sync
 
 __all__ = [
     "FACTORS_DICT",
@@ -319,6 +319,7 @@ def get_factors(
         values_dev = jnp.asarray(panel.values)
         mask_dev = jnp.asarray(panel.mask)
         monthly = compute_monthly_characteristics(values_dev, mask_dev, var_index)
+        stage_sync(monthly)
 
     with timer.stage("factors/merge_winsorize"):
         # Align daily-firm columns onto the monthly panel's permno vocabulary
@@ -358,4 +359,5 @@ def get_factors(
             ids=panel.ids,
             var_names=var_names,
         )
+        stage_sync(values_dev)
     return final, factors_dict

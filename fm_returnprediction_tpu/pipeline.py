@@ -50,7 +50,7 @@ from fm_returnprediction_tpu.reporting.latex import (
 from fm_returnprediction_tpu.reporting.table1 import build_table_1
 from fm_returnprediction_tpu.reporting.table2 import build_table_2
 from fm_returnprediction_tpu.utils.cache import load_cache_data
-from fm_returnprediction_tpu.utils.timing import StageTimer
+from fm_returnprediction_tpu.utils.timing import StageTimer, stage_sync
 
 __all__ = [
     "PipelineResult",
@@ -240,10 +240,12 @@ def load_or_build_panel(
         base, cd = prepared
         del prepared
         with timer.stage("build_panel"):
-            return build_panel_prepared(
+            panel, factors_dict = build_panel_prepared(
                 base, cd, dtype=dtype, mesh=mesh, timer=timer,
                 include_turnover=include_turnover,
             )
+            stage_sync(panel.values)
+        return panel, factors_dict
     with timer.stage("load_raw_data"):
         data = load_raw_data(raw_data_dir)
     import jax
@@ -255,6 +257,7 @@ def load_or_build_panel(
             data, dtype=dtype, mesh=mesh, timer=timer,
             include_turnover=include_turnover, capture=capture,
         )
+        stage_sync(panel.values)
         if write_prepared:
             with timer.stage("save_prepared"):
                 save_prepared(prepared_dir, fingerprint,
@@ -323,6 +326,7 @@ def run_pipeline(
             panel, factors_dict = build_panel(
                 data, dtype=dtype, mesh=mesh, timer=timer
             )
+            stage_sync(panel.values)
         # The raw frames are dead once the panel exists; releasing them cuts
         # allocator pressure before the reporting stages' large temporaries.
         del data
@@ -333,6 +337,7 @@ def run_pipeline(
 
     with timer.stage("subset_masks"):
         subset_masks = compute_subset_masks(panel)
+        stage_sync(subset_masks)
 
     with timer.stage("table_1"):
         table_1 = build_table_1(panel, subset_masks, factors_dict)

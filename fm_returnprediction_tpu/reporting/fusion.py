@@ -24,6 +24,7 @@ tests to exercise both routes).
 from __future__ import annotations
 
 import os
+import warnings
 
 __all__ = ["fuse_budget_bytes", "fuse_over_subsets", "stacked_design_bytes"]
 
@@ -38,7 +39,12 @@ def stacked_design_bytes(n_subsets: int, t: int, n: int, p: int,
                          itemsize: int) -> int:
     """Bytes of the subset-stacked augmented design ``[1 | X | y]`` — the
     dominant tensor the per-subset vmap multiplies (intercept + P
-    predictors + regressand columns, masked per subset)."""
+    predictors + regressand columns, masked per subset).
+
+    This estimate is PER PROGRAM, not per model: a fused sweep that
+    compiles several models into one program must sum this over the
+    models' designs (Table 2 prices ``Σ(p_i + 2)``), or the program the
+    compiler sees is a multiple of the budgeted figure."""
     return n_subsets * t * n * (p + 2) * itemsize
 
 
@@ -46,10 +52,26 @@ def fuse_budget_bytes() -> float:
     """The fusion byte budget (``FMRP_FUSE_SUBSETS_MB`` override).
 
     Callers whose dominant vmapped temporary is not an augmented OLS
-    design (Table 1's three same-shape ``(S, T, N, K)`` broadcasts, say)
-    compare their own footprint estimate against this same budget."""
-    return float(os.environ.get("FMRP_FUSE_SUBSETS_MB",
-                                _DEFAULT_BUDGET_MB)) * 2**20
+    design compare their own footprint estimate against this same
+    budget. A malformed override warns and falls back to the default
+    (matching ``FMRP_PALLAS``'s forgiving parse) instead of raising deep
+    inside a table build; negative values clamp to 0 — which, like an
+    explicit 0, forces the split route everywhere."""
+    raw = os.environ.get("FMRP_FUSE_SUBSETS_MB")
+    if raw is None:
+        mb = _DEFAULT_BUDGET_MB
+    else:
+        try:
+            mb = max(float(raw), 0.0)
+        except ValueError:
+            warnings.warn(
+                f"FMRP_FUSE_SUBSETS_MB={raw!r} is not a number; using the "
+                f"default {_DEFAULT_BUDGET_MB:g} MB",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            mb = _DEFAULT_BUDGET_MB
+    return mb * 2**20
 
 
 def fuse_over_subsets(n_subsets: int, t: int, n: int, p: int,

@@ -21,13 +21,11 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pandas as pd
 
 from fm_returnprediction_tpu.panel.dense import DensePanel
-from fm_returnprediction_tpu.reporting.fusion import fuse_budget_bytes
 
-__all__ = ["build_table_1", "table1_stats"]
+__all__ = ["build_table_1", "table1_stats", "table1_stats_multi"]
 
 
 @jax.jit
@@ -35,6 +33,10 @@ def table1_stats(values: jnp.ndarray, subset_mask: jnp.ndarray):
     """Per-variable stats under one subset mask.
 
     values: (T, N, K); subset_mask: (T, N) → (avg (K,), std (K,), n (K,)).
+
+    Kept as the two-pass numerical reference for ``table1_stats_multi``
+    (the production route) — the differential in ``tests/test_reporting.py``
+    pins the shifted one-pass variance against this form.
     """
     valid = subset_mask[:, :, None] & jnp.isfinite(values)
     x = jnp.where(valid, values, 0.0)
@@ -64,6 +66,82 @@ def table1_stats(values: jnp.ndarray, subset_mask: jnp.ndarray):
     return avg, std, n_distinct
 
 
+@jax.jit
+def table1_stats_multi(values: jnp.ndarray, stacked_masks: jnp.ndarray):
+    """All subsets' stats in ONE traversal of the (T, N, K) panel.
+
+    values: (T, N, K); stacked_masks: (S, T, N) → (avg, std, n), each (S, K).
+
+    The per-subset reductions over the firm axis are contractions —
+    ``count_s = Σ_n mask_s·finite``, ``Σ_n mask_s·d``, ``Σ_n mask_s·d²`` —
+    so all S subsets come out of three batched GEMMs
+    (``einsum('stn,tnk->stk')``) that read the big panel tensors once
+    each. Nothing subset-expanded of shape (S, T, N, K) ever exists: on
+    TPU the contractions land on the MXU and the program size is
+    subset-count-independent; on the CPU fallback this replaced an
+    S-fold re-traversal (Table 1 was the largest real-shape stage at
+    47.3 s warm — BENCH_r04.json).
+
+    Variance uses the pivot-shifted one-pass form: with per-month pivot
+    ``c`` = mean over ALL finite firms (subset-independent, so it costs
+    one traversal total) and ``d = x − c``,
+    ``Σ_s (x − m_s)² = Σ_s d² − cnt_s·(m_s − c)²``. The raw one-pass
+    ``Σx² − n·mean²`` cancels catastrophically for near-constant
+    cross-sections; with the pivot inside one cross-sectional std of
+    every subset mean, the shift term is O(var) and the relative error
+    stays at a small multiple of machine eps — equivalent in practice to
+    the two-pass form ``table1_stats`` uses (asserted against it in
+    ``tests/test_reporting.py``).
+    """
+    finite = jnp.isfinite(values)
+    xz = jnp.where(finite, values, 0.0)
+
+    # pivot: per-(month, variable) mean over all finite entries
+    f32 = finite.astype(jnp.float32)
+    cnt_all = f32.sum(axis=1)                                   # (T, K)
+    c = xz.sum(axis=1) / jnp.maximum(cnt_all, 1.0).astype(xz.dtype)
+    d = jnp.where(finite, values - c[:, None, :], 0.0)
+
+    masks_f32 = stacked_masks.astype(jnp.float32)
+    masks_v = stacked_masks.astype(d.dtype)
+    # counts ride f32 GEMMs: products and per-month sums are small exact
+    # integers (≤ N < 2^24), and the 0/1 operands are exact in bf16, so
+    # default matmul precision is lossless for them
+    cnt = jnp.einsum("stn,tnk->stk", masks_f32, f32)            # (S, T, K)
+    # the MOMENT contractions must not run at the TPU default precision
+    # (bf16 operand truncation, ~2^-8 relative — same convention as
+    # ops/ols._PRECISION): the pivot-shift analysis below assumes
+    # full-precision Σd/Σd²
+    hi = jax.lax.Precision.HIGHEST
+    s1 = jnp.einsum("stn,tnk->stk", masks_v, d, precision=hi)
+    s2 = jnp.einsum("stn,tnk->stk", masks_v, d * d, precision=hi)
+
+    cf = cnt.astype(d.dtype)
+    shift = s1 / jnp.maximum(cf, 1.0)                           # m_s − c
+    mean_t = c[None] + shift
+    var_t = jnp.maximum(s2 - cf * shift * shift, 0.0) / jnp.maximum(
+        cf - 1.0, 1.0
+    )
+    std_t = jnp.sqrt(var_t)
+
+    has_mean = cnt >= 1
+    has_std = cnt >= 2
+    avg = jnp.sum(jnp.where(has_mean, mean_t, 0.0), axis=1) / jnp.maximum(
+        has_mean.sum(axis=1), 1
+    )
+    std = jnp.sum(jnp.where(has_std, std_t, 0.0), axis=1) / jnp.maximum(
+        has_std.sum(axis=1), 1
+    )
+    # distinct firms ever valid: months-present count per (subset, firm,
+    # variable) — a GEMM contracting the time axis — then count nonzeros
+    ever = jnp.einsum("stn,tnk->snk", masks_f32, f32)           # (S, N, K)
+    n_distinct = (ever > 0).sum(axis=1)                         # (S, K)
+
+    avg = jnp.where(has_mean.sum(axis=1) > 0, avg, jnp.nan)
+    std = jnp.where(has_std.sum(axis=1) > 0, std, jnp.nan)
+    return avg, std, n_distinct
+
+
 def build_table_1(
     panel: DensePanel,
     subset_masks: Dict[str, jnp.ndarray],
@@ -71,30 +149,15 @@ def build_table_1(
 ) -> pd.DataFrame:
     """Assemble the reference-layout Table 1 DataFrame.
 
-    Below the ``reporting.fusion`` footprint budget all subsets run in one
-    vmapped dispatch and one host pull — per-subset round trips are what a
-    remote TPU backend charges for. Above it (real shape), one dispatch
-    per subset: the subset vmap triples the (T, N, K) broadcast
-    temporaries, which on the CPU fallback thrashes cache and on TPU
-    inflates the program for no fusion win at these sizes."""
+    One jitted dispatch and one host pull for every (variable × subset)
+    cell at every shape: ``table1_stats_multi``'s GEMM contractions never
+    materialize a subset-expanded tensor, so Table 1 needs no
+    ``reporting.fusion`` budget dispatch (the per-subset split route this
+    replaced was the largest real-shape stage — BENCH_r04.json)."""
     var_cols = [panel.var_index(col) for col in variables_dict.values()]
     values = jnp.asarray(panel.values[:, :, var_cols])
-    t, n_firms, k = values.shape
-    # table1_stats holds ~3 same-shape (T, N, K) temporaries (valid, x,
-    # centered), so the fused footprint is ~3 subset-stacked copies — not
-    # the augmented-design model stacked_design_bytes prices.
-    fused_bytes = 3 * len(subset_masks) * t * n_firms * k * values.dtype.itemsize
-    if fused_bytes <= fuse_budget_bytes():
-        stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
-        avg, std, n = jax.device_get(
-            jax.vmap(lambda m: table1_stats(values, m))(stacked)
-        )
-    else:
-        per = jax.device_get([
-            table1_stats(values, jnp.asarray(m))
-            for m in subset_masks.values()
-        ])
-        avg, std, n = (np.stack(leaf) for leaf in zip(*per))
+    stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
+    avg, std, n = jax.device_get(table1_stats_multi(values, stacked))
 
     partials = []
     for si, subset_name in enumerate(subset_masks):

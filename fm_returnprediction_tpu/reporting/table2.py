@@ -184,8 +184,13 @@ def build_table_2(
         )
         stacked = jnp.stack([jnp.asarray(m) for m in subset_masks.values()])
         t, n = y.shape
-        p_max = max((len(i) for i in idxs), default=0)
-        if fuse_over_subsets(len(subset_names), t, n, p_max,
+        # _fm_sweep compiles ALL models' subset-vmapped sweeps into ONE
+        # program, so the footprint the compiler sees is the SUM of the
+        # models' stacked designs, not the largest one — price Σ(p_i + 2)
+        # by passing the equivalent single-design p (fusion.py docstring:
+        # the estimate is per-program)
+        p_sum = sum(len(i) + 2 for i in idxs)
+        if fuse_over_subsets(len(subset_names), t, n, max(p_sum - 2, 0),
                              x_all.dtype.itemsize):
             summaries = jax.device_get(
                 _fm_sweep(y, x_all, stacked, idxs,

@@ -11,11 +11,33 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import time
 from pathlib import Path
 from typing import Dict, Iterator, Optional
 
-__all__ = ["StageTimer", "stage", "trace"]
+__all__ = ["StageTimer", "stage", "stage_sync", "trace"]
+
+
+def stage_sync(values) -> None:
+    """Block on a stage's device outputs — when ``FMRP_SYNC_STAGES=1``.
+
+    JAX dispatch is async: a stage that ENQUEUES device work returns
+    before it executes, and whichever later stage first blocks (a
+    ``device_get`` in a table build, say) absorbs the wait. That skewed
+    round-4's attribution badly — the driver artifact charged Table 1
+    47 s at real shape when its true warm compute is ~5 s; the rest was
+    upstream panel/daily work draining at Table 1's first pull. Stages
+    that produce device arrays call this with them; under
+    ``FMRP_SYNC_STAGES=1`` (bench real-shape sections set it) the wait
+    lands in the stage that OWNS the compute, at the cost of
+    cross-stage dispatch overlap (~a round trip per coarse stage).
+    Default off: production keeps the overlap, the headline wall stays
+    unpadded."""
+    if os.environ.get("FMRP_SYNC_STAGES", "0") == "1":
+        import jax
+
+        jax.block_until_ready(values)
 
 
 class StageTimer:

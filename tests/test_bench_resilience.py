@@ -85,6 +85,7 @@ bench._bench_pipeline_real = lambda fast: {
 bench._bench_kernel = lambda fast: {}
 bench._bench_daily_fullscale = lambda fast: {}
 bench._bench_pallas = lambda fast: {}
+bench._bench_mesh8 = lambda fast: {}
 bench.main()
 """
     )

@@ -35,6 +35,22 @@ def test_env_override(monkeypatch):
     assert fuse_over_subsets(3, 600, 22000, 14, 4)
 
 
+def test_malformed_override_warns_and_uses_default(monkeypatch, recwarn):
+    import warnings
+
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "512MB")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert fuse_budget_bytes() == 512.0 * 2**20  # falls back, not raises
+    assert any("FMRP_FUSE_SUBSETS_MB" in str(w.message) for w in caught)
+
+
+def test_negative_override_clamps_to_force_split(monkeypatch):
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "-16")
+    assert fuse_budget_bytes() == 0  # explicit, not silent: acts like 0
+    assert not fuse_over_subsets(1, 1, 1, 1, 4)
+
+
 def test_budget_boundary_is_inclusive(monkeypatch):
     bytes_needed = stacked_design_bytes(2, 10, 100, 3, 4)
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", str(bytes_needed / 2**20))

@@ -166,6 +166,47 @@ def test_figure1_rolling_slopes_match_oracle(world):
         )
 
 
+def test_table1_multi_matches_two_pass(world):
+    """``table1_stats_multi`` (single-traversal GEMM route, pivot-shifted
+    one-pass variance) vs ``table1_stats`` (two-pass reference): the shift
+    term must keep the cancellation-prone variance as accurate as the
+    two-pass form, including on a near-constant cross-section."""
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu.reporting.table1 import (
+        table1_stats,
+        table1_stats_multi,
+    )
+
+    panel, factors, masks, _ = world
+    var_cols = [panel.var_index(col) for col in factors.values()]
+    values = jnp.asarray(panel.values[:, :, var_cols])
+    cases = [(values, masks)]
+
+    # near-constant cross-sections: raw one-pass variance would lose ~all
+    # significant digits here; the pivot-shifted form must not
+    rng = np.random.default_rng(5)
+    t, n = 24, 40
+    nc = 7.25 + 1e-9 * rng.standard_normal((t, n, 2))
+    nc[rng.random((t, n, 2)) < 0.1] = np.nan
+    nc_masks = {
+        "all": np.ones((t, n), bool),
+        "half": np.broadcast_to(np.arange(n)[None, :] < n // 2, (t, n)),
+    }
+    cases.append((jnp.asarray(nc), nc_masks))
+
+    for vals, mask_dict in cases:
+        stacked = jnp.stack([jnp.asarray(m) for m in mask_dict.values()])
+        avg_m, std_m, n_m = table1_stats_multi(vals, stacked)
+        for si, m in enumerate(mask_dict.values()):
+            avg, std, n_ = table1_stats(vals, jnp.asarray(m))
+            np.testing.assert_allclose(np.asarray(avg_m)[si], np.asarray(avg),
+                                       rtol=1e-10, atol=1e-12, equal_nan=True)
+            np.testing.assert_allclose(np.asarray(std_m)[si], np.asarray(std),
+                                       rtol=1e-6, atol=1e-15, equal_nan=True)
+            np.testing.assert_array_equal(np.asarray(n_m)[si], np.asarray(n_))
+
+
 def test_fusion_split_routes_match_fused(world, monkeypatch):
     """The large-shape per-cell/per-subset routes (reporting.fusion budget
     exceeded — the real-shape TPU compile fix) produce results identical to

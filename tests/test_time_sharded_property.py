@@ -53,6 +53,42 @@ def _cases(draw):
     return p, x, window, min_periods
 
 
+# Tolerance contract (reassociation-aware — see the failure analysis below).
+#
+# The sharded route computes the SAME cumulative sums as ``ops.rolling`` but
+# in a different association order: shard-local cumsum + all-gathered
+# exclusive prefix offset, instead of one sequential scan. Float addition is
+# not associative, so bit-equality with the single-device route is not a
+# theorem; each route's windowed sum carries a forward error of order
+# ``T·eps·max|prefix|`` (~1e-12 abs here: T ≤ 96, |prefix| ≲ 50, f64
+# eps 2.2e-16), and the DIFFERENCE between the two routes is bounded by the
+# sum of both errors.  ``atol=1e-9`` for the sum/mean leaves ~500x headroom
+# over that bound while still catching any semantic bug (wrong halo row,
+# off-by-one offset) whose error is O(|x|) ~ 1, not O(eps).
+#
+# std needs its own contract: variance is ``Σx² − (Σx)²/n`` — linear in the
+# moment errors, so the variance-domain comparison stays tight — but
+# ``sqrt`` amplifies a δ-sized variance error to ``√δ`` when the true
+# variance is ~0 (two near-equal values in a w=2 window: the draw that broke
+# the old flat ``rtol=1e-9, atol=1e-12`` assertion at 3e-8 rel).  So std is
+# asserted tight in the variance domain (got², want²) and with a √-aware
+# absolute bound (√(2e-9) ≈ 4.5e-5, rounded up) in the std domain.
+_SUM_TOL = dict(rtol=1e-9, atol=1e-9)
+_VAR_TOL = dict(rtol=1e-9, atol=1e-9)
+_STD_TOL = dict(rtol=1e-7, atol=5e-5)
+
+
+def _assert_std_close(got, want, err_msg):
+    np.testing.assert_allclose(
+        got * got, want * want, equal_nan=True,
+        err_msg=err_msg + " [variance domain]", **_VAR_TOL,
+    )
+    np.testing.assert_allclose(
+        got, want, equal_nan=True, err_msg=err_msg + " [std domain]",
+        **_STD_TOL,
+    )
+
+
 @given(_cases())
 @settings(max_examples=25, deadline=None)
 def test_time_sharded_matches_single_device(case):
@@ -61,12 +97,15 @@ def test_time_sharded_matches_single_device(case):
     pairs = [
         (rolling_sum, rolling_sum_time_sharded),
         (rolling_mean, rolling_mean_time_sharded),
-        (rolling_std, rolling_std_time_sharded),
     ]
     for single, sharded in pairs:
         want = np.asarray(single(jnp.asarray(x), window, min_periods))
         got = np.asarray(sharded(x, window, min_periods, mesh=mesh))
         np.testing.assert_allclose(
-            got, want, rtol=1e-9, atol=1e-12, equal_nan=True,
+            got, want, equal_nan=True,
             err_msg=f"{single.__name__} p={p} w={window} mp={min_periods}",
+            **_SUM_TOL,
         )
+    want = np.asarray(rolling_std(jnp.asarray(x), window, min_periods))
+    got = np.asarray(rolling_std_time_sharded(x, window, min_periods, mesh=mesh))
+    _assert_std_close(got, want, f"rolling_std p={p} w={window} mp={min_periods}")

@@ -1,0 +1,44 @@
+"""``utils.timing``: stage accounting and the async-attribution barrier.
+
+The round-4 driver artifact charged Table 1 47 s at real shape because
+JAX's async dispatch let upstream panel work drain inside Table 1's first
+``device_get`` — stage walls measured who BLOCKED, not who COMPUTED.
+``stage_sync`` is the fix; these tests pin its contract: a no-op by
+default (production keeps cross-stage overlap), a real
+``block_until_ready`` barrier under ``FMRP_SYNC_STAGES=1``.
+"""
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.utils.timing import StageTimer, stage_sync
+
+
+def test_stage_sync_default_noop(monkeypatch):
+    monkeypatch.delenv("FMRP_SYNC_STAGES", raising=False)
+    called = []
+    monkeypatch.setattr("jax.block_until_ready",
+                        lambda v: called.append(v) or v)
+    stage_sync(jnp.ones(3))
+    assert called == []
+
+
+def test_stage_sync_blocks_when_enabled(monkeypatch):
+    monkeypatch.setenv("FMRP_SYNC_STAGES", "1")
+    called = []
+    monkeypatch.setattr("jax.block_until_ready",
+                        lambda v: called.append(v) or v)
+    # pytree values (a stage's dict of masks) pass through whole
+    tree = {"a": jnp.ones(2), "b": jnp.zeros(2)}
+    stage_sync(tree)
+    assert called == [tree]
+
+
+def test_stage_timer_nested_total():
+    timer = StageTimer()
+    with timer.stage("parent"):
+        with timer.stage("parent/child"):
+            pass
+    # "/"-names are nested sub-stages: counted in durations, excluded
+    # from total() so the parent's wall is not double-counted
+    assert "parent/child" in timer.durations
+    assert timer.total() == timer.durations["parent"]
