@@ -206,6 +206,39 @@ def test_table1_multi_matches_two_pass(world):
                                        rtol=1e-6, atol=1e-15, equal_nan=True)
             np.testing.assert_array_equal(np.asarray(n_m)[si], np.asarray(n_))
 
+    # production dtype: the TPU pipeline runs f32 — the f32 GEMM route must
+    # stay within a few f32-eps of the f64 two-pass truth (well inside the
+    # 1e-4 parity budget); this is where a precision regression in the
+    # einsum contractions (bf16 operand truncation) would show as ~1e-3
+    vals64, mask_dict = cases[0]
+    stacked = jnp.stack([jnp.asarray(m) for m in mask_dict.values()])
+    avg32, std32, n32 = table1_stats_multi(
+        jnp.asarray(vals64, jnp.float32), stacked
+    )
+    for si, m in enumerate(mask_dict.values()):
+        avg, std, n_ = table1_stats(vals64, jnp.asarray(m))
+        np.testing.assert_allclose(np.asarray(avg32)[si], np.asarray(avg),
+                                   rtol=2e-5, atol=1e-7, equal_nan=True)
+        np.testing.assert_allclose(np.asarray(std32)[si], np.asarray(std),
+                                   rtol=2e-4, atol=1e-6, equal_nan=True)
+        np.testing.assert_array_equal(np.asarray(n32)[si], np.asarray(n_))
+
+
+def test_split_route_compiles_once_per_model_shape(world, monkeypatch):
+    """The Table 2 split route's claimed shape-caching must actually hit:
+    9 (model, subset) cells may add at most one compiled program per
+    DISTINCT model shape (3 here) — subsets share the (T, N, P) signature.
+    The real-shape TPU cold-compile bill (~33 s/program over the tunnel)
+    scales with this count, so a silent regression to per-cell compiles
+    would triple it."""
+    from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+
+    panel, factors, masks, _ = world
+    monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")  # force the split route
+    fama_macbeth.clear_cache()
+    build_table_2(panel, masks, factors)
+    assert fama_macbeth._cache_size() == 3
+
 
 def test_fusion_split_routes_match_fused(world, monkeypatch):
     """The large-shape per-cell/per-subset routes (reporting.fusion budget

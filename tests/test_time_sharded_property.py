@@ -73,6 +73,12 @@ def _cases(draw):
 # the old flat ``rtol=1e-9, atol=1e-12`` assertion at 3e-8 rel).  So std is
 # asserted tight in the variance domain (got², want²) and with a √-aware
 # absolute bound (√(2e-9) ≈ 4.5e-5, rounded up) in the std domain.
+#
+# Calibration check (round 5): a 500-case fresh-seed sweep of this exact
+# case space observed worst diffs of 1.5e-14 (variance domain) and
+# 2.6e-12 (std domain) — the asserted bounds carry ≥4 orders of margin
+# over observed reassociation error while sitting ≥4 orders below any
+# O(|x|) semantic-bug error.
 _SUM_TOL = dict(rtol=1e-9, atol=1e-9)
 _VAR_TOL = dict(rtol=1e-9, atol=1e-9)
 _STD_TOL = dict(rtol=1e-7, atol=5e-5)
