@@ -232,12 +232,20 @@ def test_split_route_compiles_once_per_model_shape(world, monkeypatch):
     scales with this count, so a silent regression to per-cell compiles
     would triple it."""
     from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+    from fm_returnprediction_tpu.reporting.figure1 import (
+        _subset_one_device,
+        subset_sweep,
+    )
 
     panel, factors, masks, _ = world
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")  # force the split route
     fama_macbeth.clear_cache()
     build_table_2(panel, masks, factors)
     assert fama_macbeth._cache_size() == 3
+    # figure/decile family: all subsets share one (T, N, P) signature
+    _subset_one_device.clear_cache()
+    subset_sweep(panel, masks, list(masks))
+    assert _subset_one_device._cache_size() == 1
 
 
 def test_fusion_split_routes_match_fused(world, monkeypatch):
