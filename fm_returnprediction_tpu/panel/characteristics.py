@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from fm_returnprediction_tpu.ops.compaction import compact, lag, make_compaction, scatter_back
+from fm_returnprediction_tpu.ops.compaction import lag, make_compaction
 from fm_returnprediction_tpu.ops.daily_chunked import (
     daily_characteristics_compact_chunked,
 )
@@ -135,15 +135,23 @@ def compute_monthly_characteristics(
     idx = dict(var_index)
     plan = make_compaction(mask)
 
-    def comp(name):
-        v = compact(values[:, :, idx[name]], plan)
-        return jnp.where(plan.valid, v, jnp.nan)
-
-    retx, prc, shrout = comp("retx"), comp("prc"), comp("shrout")
-    me, be = comp("me"), comp("be")
-    accruals, depreciation = comp("accruals"), comp("depreciation")
-    earnings, assets = comp("earnings"), comp("assets")
-    sales, total_debt, dvc = comp("sales"), comp("total_debt"), comp("dvc")
+    # ONE batched gather through the compaction plan for every base column
+    # (13-14 separate (T, N) take_along_axis kernels collapse into one
+    # (T, N, C) gather — same traffic, one launch; ~2x on the CPU fallback,
+    # fewer kernels in the TPU program)
+    names = ["retx", "prc", "shrout", "me", "be", "accruals", "depreciation",
+             "earnings", "assets", "sales", "total_debt", "dvc"]
+    if "vol" in idx:  # static: var_index is a static argname
+        names.append("vol")
+    sel = values[:, :, jnp.asarray([idx[n] for n in names])]
+    compd = jnp.take_along_axis(sel, plan.order[:, :, None], axis=0)
+    compd = jnp.where(plan.valid[:, :, None], compd, jnp.nan)
+    col = {n: compd[:, :, i] for i, n in enumerate(names)}
+    retx, prc, shrout = col["retx"], col["prc"], col["shrout"]
+    me, be = col["me"], col["be"]
+    accruals, depreciation = col["accruals"], col["depreciation"]
+    earnings, assets = col["earnings"], col["assets"]
+    sales, total_debt, dvc = col["sales"], col["total_debt"], col["dvc"]
 
     me_lag, be_lag = lag(me, 1), lag(be, 1)
     out = {
@@ -160,10 +168,15 @@ def compute_monthly_characteristics(
         "debt_price": total_debt / me_lag,
         "sales_price": sales / me_lag,
     }
-    if "vol" in idx:  # static: var_index is a static argname
-        turnover = comp("vol") / (shrout * 1000.0)
+    if "vol" in idx:
+        turnover = col["vol"] / (shrout * 1000.0)
         out[TURNOVER_COLUMN] = rolling_mean(lag(turnover, 1), 12, 12)
-    return {name: scatter_back(arr, plan) for name, arr in out.items()}
+    # matching batched scatter: one (T, N, V) take_along_axis back to
+    # calendar slots instead of one inverse-gather per characteristic
+    stacked = jnp.stack(list(out.values()), axis=-1)
+    back = jnp.take_along_axis(stacked, plan.inv_order[:, :, None], axis=0)
+    back = jnp.where(plan.mask[:, :, None], back, jnp.nan)
+    return {name: back[:, :, i] for i, name in enumerate(out)}
 
 
 @jax.jit
