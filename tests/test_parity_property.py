@@ -86,3 +86,45 @@ def test_compact_scatter_roundtrip(panel):
     back = np.asarray(scatter_back(compact(jnp.asarray(values), plan), plan))
     want = np.where(mask, values, np.nan)
     np.testing.assert_allclose(back, want, rtol=0, atol=0, equal_nan=True)
+
+
+@settings(max_examples=30, deadline=None)
+@given(_panels(), st.integers(min_value=1, max_value=3),
+       st.floats(min_value=0.0, max_value=0.1))
+def test_table1_stats_multi_matches_pandas(panel, k, inf_frac):
+    """The single-traversal Table 1 route vs the pandas oracle over random
+    shapes, masks, NaN densities, and ±inf contamination (the reference
+    treats ±inf as missing, ``src/calc_Lewellen_2014.py:625``)."""
+    from fm_returnprediction_tpu.reporting.table1 import table1_stats_multi
+
+    t, n, mask_frac, nan_frac, seed = panel
+    rng = np.random.default_rng(seed)
+    values = rng.standard_normal((t, n, k))
+    values[rng.random((t, n, k)) < nan_frac] = np.nan
+    pos = rng.random((t, n, k))
+    values[pos < inf_frac / 2] = np.inf
+    values[(pos >= inf_frac / 2) & (pos < inf_frac)] = -np.inf
+    masks = rng.random((2, t, n)) < mask_frac
+
+    avg, std, n_d = table1_stats_multi(jnp.asarray(values), jnp.asarray(masks))
+    for si in range(2):
+        rows = []
+        for kk in range(k):
+            v = np.where(masks[si], values[:, :, kk], np.nan)
+            v = np.where(np.isfinite(v), v, np.nan)
+            df = pd.DataFrame(v)  # rows = months, cols = firms
+            m = df.mean(axis=1, skipna=True)       # monthly CS mean
+            s = df.std(axis=1, ddof=1, skipna=True)
+            rows.append((
+                m.mean(skipna=True),               # time-series averages
+                s.mean(skipna=True),
+                int((df.notna().any(axis=0)).sum()),  # distinct firms
+            ))
+        want_avg = np.array([r[0] for r in rows])
+        want_std = np.array([r[1] for r in rows])
+        want_n = np.array([r[2] for r in rows])
+        np.testing.assert_allclose(np.asarray(avg)[si], want_avg,
+                                   rtol=1e-8, atol=1e-10, equal_nan=True)
+        np.testing.assert_allclose(np.asarray(std)[si], want_std,
+                                   rtol=1e-8, atol=1e-10, equal_nan=True)
+        np.testing.assert_array_equal(np.asarray(n_d)[si], want_n)
