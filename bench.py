@@ -550,6 +550,56 @@ def _bench_fuseprobe(fast: bool):
     }
 
 
+def _bench_serving(fast: bool):
+    """Warm microbatched serving path on a synthetic state (the online
+    E[r] query service, ``fm_returnprediction_tpu/serving``): build a
+    fitted state from a synthetic panel, warm every query bucket (so the
+    stream pays zero compiles — asserted by the cache counters), then push
+    a threaded stream of single-firm queries through the microbatcher and
+    record qps and tail latency from the service's own instrumentation.
+    FMRP_BENCH_SERVING=0 skips; _QUERIES resizes the stream."""
+    import concurrent.futures
+
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    t, n, p = (60, 200, 5) if fast else (600, 2000, 5)
+    n_queries = int(os.environ.get(
+        "FMRP_BENCH_SERVING_QUERIES", 200 if fast else 1000
+    ))
+    rng = np.random.default_rng(2015)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+
+    state = build_serving_state(
+        y, x, mask, window=min(120, t // 2), min_periods=min(60, t // 4)
+    )
+    months = rng.integers(t // 2, t, n_queries)
+    firms = rng.integers(0, n, n_queries)
+    with ERService(state, max_batch=64, max_latency_ms=1.0, warm=True) as svc:
+        base_hits, base_misses = svc.executor.hits, svc.executor.misses
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            futs = list(pool.map(
+                lambda q: svc.query(int(months[q]), x[months[q], firms[q]]),
+                range(n_queries),
+            ))
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+        assert len(futs) == n_queries
+    return {
+        "serving_qps": round(n_queries / wall, 1),
+        "serving_p50_ms": round(stats["p50_ms"], 3),
+        "serving_p99_ms": round(stats["p99_ms"], 3),
+        "serving_batch_occupancy": round(stats["batch_occupancy"], 4),
+        "serving_cache_misses_after_warm": svc.executor.misses - base_misses,
+        "serving_dispatches": svc.executor.hits - base_hits,
+        "serving_shape": f"T{t}_P{p}_Q{n_queries}",
+    }
+
+
 def _jax_cache_stats() -> dict:
     """Entry count + bytes of the persistent XLA compilation cache
     (``_cache/jax``) — the artifact-side evidence for whether the split
@@ -826,8 +876,9 @@ def main() -> None:
     # Every section has an off switch so a short accelerator window can be
     # spent on exactly the missing measurement (the tunnel comes and goes;
     # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
-    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _MESH8 = 0. Default: all
-    # on except _MESH8, which defaults on only with a live accelerator.
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _MESH8 = 0.
+    # Default: all on except _MESH8, which defaults on only with a live
+    # accelerator.
     sections = []
     if os.environ.get("FMRP_BENCH_PIPE", "1") == "1":
         sections.append(_bench_pipeline)
@@ -838,6 +889,8 @@ def main() -> None:
         sections.append(_bench_daily_fullscale)
     if os.environ.get("FMRP_BENCH_PALLAS", "1") == "1":
         sections.append(_bench_pallas)
+    if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
+        sections.append(_bench_serving)
     sections.append(_bench_fuseprobe)  # TPU-only, gated in-section
     sections.append(_bench_mesh8)  # _MESH8 gate handled in-section
 

@@ -33,6 +33,9 @@ Architecture (TPU-first, not a translation of the reference):
                    construction, sharding rules, ``shard_map`` bootstrap.
 - ``reporting``  — Table 1/2 builders, Figure 1, LaTeX report generation
                    (reference: ``src/calc_Lewellen_2014.py:577-1231``).
+- ``serving``    — the online E[r] query layer (no reference analog):
+                   frozen fitted state, microbatched shape-bucketed query
+                   execution, incremental month ingest.
 - ``taskgraph``  — a file-dependency DAG runner standing in for ``doit``
                    (reference: ``dodo.py``).
 

@@ -25,10 +25,22 @@ import jax.numpy as jnp
 
 from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
 from fm_returnprediction_tpu.ops.newey_west import nw_mean_se
-from fm_returnprediction_tpu.ops.ols import monthly_cs_ols, row_validity
+from fm_returnprediction_tpu.ops.ols import (
+    NormalStats,
+    monthly_cs_ols,
+    row_validity,
+    sufficient_stats,
+)
 from fm_returnprediction_tpu.ops.quantiles import masked_quantile
 
-__all__ = ["ForecastResult", "DecileSortResult", "rolling_er_forecast", "decile_sorts"]
+__all__ = [
+    "ForecastResult",
+    "ForecastArtifacts",
+    "DecileSortResult",
+    "rolling_er_forecast",
+    "fit_forecast_artifacts",
+    "decile_sorts",
+]
 
 
 class ForecastResult(NamedTuple):
@@ -36,6 +48,20 @@ class ForecastResult(NamedTuple):
     er_valid: jnp.ndarray      # (T, N) bool
     slopes_bar: jnp.ndarray    # (T, P) lagged rolling mean slopes (NaN-gated)
     intercept_bar: jnp.ndarray # (T,)
+
+
+class ForecastArtifacts(NamedTuple):
+    """The fitted quantities an online server needs — per-month coefficients,
+    their lagged rolling means, and the ADDITIVE normal-equation sufficient
+    statistics (``XᵀX``, ``Xᵀy``, ``n``, … — the structure that makes
+    incremental month ingest a cheap merge instead of a refit). Consumed by
+    ``serving.state.ServingState``."""
+
+    coef: jnp.ndarray          # (T, Q) per-month [intercept, slopes]
+    month_valid: jnp.ndarray   # (T,) bool: month had >= Q valid rows
+    slopes_bar: jnp.ndarray    # (T, P) lagged rolling mean slopes (NaN-gated)
+    intercept_bar: jnp.ndarray # (T,)
+    stats: NormalStats         # (T, ...) additive per-month sufficient stats
 
 
 class DecileSortResult(NamedTuple):
@@ -46,6 +72,71 @@ class DecileSortResult(NamedTuple):
     spread: jnp.ndarray          # () mean top-minus-bottom decile return
     spread_tstat: jnp.ndarray    # () spread / NW SE
     n_months: jnp.ndarray        # ()
+
+
+def _lagged_coef_means(cs, window: int, min_periods: int,
+                       fill_invalid: bool = False):
+    """Per-month [intercept, slopes] rows and their LAGGED rolling means.
+
+    Rolling mean over CONSECUTIVE surviving months (row-based, the
+    reference's Figure-1 convention, src/calc_Lewellen_2014.py:926),
+    shifted one row so month t sees only strictly-prior estimates. Shared
+    by the batch forecast and the serving-state refit hook — the serving
+    differential contract (streamed queries == batch forecast) holds
+    because both sides read the same program.
+
+    ``fill_invalid=True`` (the serving hook) also fills months whose OWN
+    cross-section produced no coefficient row: their lagged mean depends
+    only on strictly-prior surviving months, so it is equally defined —
+    and a serving system must quote E[r] for exactly such months (the
+    current month's returns cannot exist yet). The batch forecast keeps
+    the scatter convention (NaN at non-surviving months) because its rows
+    feed decile sorts that need the month's own cross-section anyway.
+    """
+    coefs = jnp.concatenate([cs.intercept[:, None], cs.slopes], axis=1)  # (T, Q)
+    bar = rolling_over_valid_rows(
+        coefs, cs.month_valid, window, min_periods, row_lag=1,
+        fill_invalid=fill_invalid,
+    )
+    return coefs, bar
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "min_periods", "solver")
+)
+def fit_forecast_artifacts(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    window: int = 120,
+    min_periods: int = 60,
+    solver: str = "qr",
+    cs=None,
+) -> ForecastArtifacts:
+    """The serving refit hook: everything ``ServingState`` persists, in one
+    compiled program.
+
+    Same inputs and conventions as :func:`rolling_er_forecast` (pass ``cs``
+    to reuse a precomputed batched OLS); additionally contracts the panel
+    into per-month normal-equation sufficient statistics
+    (``ops.ols.sufficient_stats``) so a later month can be ingested
+    incrementally — stats for disjoint row sets ADD, so appending firms to
+    a month is a merge, not a refit.
+
+    The lagged means are the ``fill_invalid`` variant: a month whose own
+    cross-section is too thin for a coefficient row still gets the lagged
+    mean of its strictly-prior surviving months, so serving can quote
+    E[r] there — a DELIBERATE superset of the batch forecast's coverage
+    (see ``serving.executor``); everywhere the batch is defined the two
+    agree exactly.
+    """
+    if cs is None:
+        cs = monthly_cs_ols(y, x, mask, solver=solver)
+    coefs, bar = _lagged_coef_means(cs, window, min_periods, fill_invalid=True)
+    stats = sufficient_stats(y, x, row_validity(y, x, mask))
+    return ForecastArtifacts(
+        coefs, cs.month_valid, bar[:, 1:], bar[:, 0], stats
+    )
 
 
 @functools.partial(
@@ -71,14 +162,7 @@ def rolling_er_forecast(
     if cs is None:
         cs = monthly_cs_ols(y, x, mask, solver=solver)
 
-    # Rolling mean over CONSECUTIVE surviving months (row-based, the
-    # reference's Figure-1 convention, src/calc_Lewellen_2014.py:926),
-    # shifted one row so month t sees only strictly-prior estimates.
-    coefs = jnp.concatenate([cs.intercept[:, None], cs.slopes], axis=1)  # (T, P+1)
-    bar = rolling_over_valid_rows(
-        coefs, cs.month_valid, window, min_periods, row_lag=1
-    )
-
+    coefs, bar = _lagged_coef_means(cs, window, min_periods)
     intercept_bar = bar[:, 0]
     slopes_bar = bar[:, 1:]
 

@@ -75,7 +75,7 @@ def lag(comp_values: jnp.ndarray, k: int, fill=jnp.nan) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("window", "min_periods", "row_lag")
+    jax.jit, static_argnames=("window", "min_periods", "row_lag", "fill_invalid")
 )
 def rolling_over_valid_rows(
     values: jnp.ndarray,
@@ -83,6 +83,7 @@ def rolling_over_valid_rows(
     window: int,
     min_periods: int,
     row_lag: int = 0,
+    fill_invalid: bool = False,
 ) -> jnp.ndarray:
     """Rolling mean over the SURVIVING rows of a (T, K) series, scattered
     back to calendar slots.
@@ -94,6 +95,16 @@ def rolling_over_valid_rows(
     where ``valid`` (T,) holds to the front, roll over the compacted axis,
     optionally shift by ``row_lag`` rows (strictly-prior information), and
     scatter back — invalid calendar slots give NaN.
+
+    ``fill_invalid=True`` (requires ``row_lag > 0``) instead gives EVERY
+    calendar slot the lagged mean its position would see — for an invalid
+    slot, the window ending at the last surviving row before it. A slot's
+    lagged mean depends only on strictly-prior surviving rows, so it is
+    well-defined whether or not the slot itself survives; the serving
+    layer needs it to quote E[r] for a month whose own cross-section
+    cannot contribute a row yet. At surviving slots the two modes agree
+    exactly (an invalid slot's compacted index IS the count of surviving
+    rows before it).
     """
     from fm_returnprediction_tpu.ops.rolling import rolling_mean
 
@@ -105,4 +116,12 @@ def rolling_over_valid_rows(
     if row_lag:
         pad = jnp.full((row_lag, rolled.shape[1]), jnp.nan, rolled.dtype)
         rolled = jnp.concatenate([pad, rolled[:-row_lag]], axis=0)
+    if fill_invalid:
+        if not row_lag:
+            raise ValueError("fill_invalid requires row_lag > 0")
+        # surviving rows strictly before each slot == the compacted index
+        # the slot's lagged window ends at (for surviving slots this equals
+        # inv_order, so the gather is a strict superset of the scatter)
+        k = jnp.cumsum(valid) - valid
+        return rolled[k]
     return jnp.where(valid[:, None], rolled[inv_order], jnp.nan)

@@ -93,6 +93,10 @@ class PipelineResult:
     timer: StageTimer
     decile_table: Optional[pd.DataFrame] = None
     bootstrap_table: Optional[pd.DataFrame] = None
+    # the fitted artifacts the online service consumes (serving.state):
+    # lagged rolling-mean slopes/intercepts, support bounds, additive OLS
+    # sufficient statistics — so serving never re-runs the fit
+    serving_state: Optional[object] = None
 
 
 # The daily stage consumes only (permno, dlycaldt, retx); the universe
@@ -280,6 +284,7 @@ def run_pipeline(
     compile_pdf: bool = True,
     make_deciles: bool = True,
     make_bootstrap: bool = False,
+    make_serving: bool = True,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
 ) -> PipelineResult:
@@ -372,6 +377,23 @@ def run_pipeline(
         with timer.stage("decile_table"):
             decile_table = build_decile_table(panel, subset_masks, cs_cache=cs_cache)
 
+    serving_state = None
+    if make_serving and "All stocks" in subset_masks:
+        from fm_returnprediction_tpu.reporting.figure1 import SubsetSweepEntry
+        from fm_returnprediction_tpu.serving.state import (
+            build_serving_state_from_panel,
+        )
+
+        with timer.stage("serving_state"):
+            # reuse the sweep's batched OLS on the figure variables — the
+            # serving fit shares the decile route's cross-sections instead
+            # of re-running them
+            entry = cs_cache.get("All stocks")
+            cs = entry.cs if isinstance(entry, SubsetSweepEntry) else entry
+            serving_state = build_serving_state_from_panel(
+                panel, subset_masks["All stocks"], cs=cs
+            )
+
     bootstrap_table = None
     if make_bootstrap:
         from fm_returnprediction_tpu.parallel import as_flat_mesh
@@ -398,6 +420,8 @@ def run_pipeline(
             save_data(table_1, table_2, figure_1, output_dir)
             if decile_table is not None:
                 save_decile_table(decile_table, output_dir)
+            if serving_state is not None:
+                serving_state.save(Path(output_dir) / "serving_state.npz")
             if bootstrap_table is not None:
                 from fm_returnprediction_tpu.reporting.bootstrap_table import (
                     save_bootstrap_table,
@@ -418,6 +442,7 @@ def run_pipeline(
         timer=timer,
         decile_table=decile_table,
         bootstrap_table=bootstrap_table,
+        serving_state=serving_state,
     )
 
 

@@ -1,0 +1,40 @@
+"""Online expected-return serving — the L8 query layer.
+
+The batch pipeline fits once; this package answers queries: a frozen,
+cache-serializable :class:`~fm_returnprediction_tpu.serving.state.ServingState`
+(lagged rolling-mean coefficients, featurization constants, additive OLS
+sufficient statistics per month), a thread-safe microbatcher that coalesces
+single-firm queries into padded power-of-two buckets (`batcher`), a
+shape-bucketed compiled-executable cache so no query ever pays a jit
+compile (`executor`), the request/response front-end with qps/latency/
+occupancy/cache counters (`service`), and incremental month ingest that
+appends a cross-section by sufficient-statistics merge instead of a refit
+(`ingest`).
+"""
+
+from fm_returnprediction_tpu.serving.batcher import MicroBatcher, QueueFullError
+from fm_returnprediction_tpu.serving.executor import (
+    BucketedExecutor,
+    bucket_for,
+    bucket_sizes,
+)
+from fm_returnprediction_tpu.serving.ingest import ingest_month
+from fm_returnprediction_tpu.serving.service import ERService
+from fm_returnprediction_tpu.serving.state import (
+    ServingState,
+    build_serving_state,
+    build_serving_state_from_panel,
+)
+
+__all__ = [
+    "ServingState",
+    "build_serving_state",
+    "build_serving_state_from_panel",
+    "MicroBatcher",
+    "QueueFullError",
+    "BucketedExecutor",
+    "bucket_sizes",
+    "bucket_for",
+    "ERService",
+    "ingest_month",
+]

@@ -1,0 +1,194 @@
+"""Shape-bucketed compiled-executable cache for the query projection.
+
+JAX compiles one program per input shape, and a compile costs orders of
+magnitude more than the projection itself (tens of seconds over a remote
+TPU tunnel). An online service therefore cannot let request sizes reach
+``jit`` directly: every distinct batch size would be a fresh trace. Instead
+batches land in power-of-two BUCKETS — each bucket is one ahead-of-time
+compiled executable, built once (at warm-up, so no query ever pays a
+compile) and reused forever. Padding rows carry ``valid=False`` and follow
+the repo-wide masking discipline: a masked row is an exact no-op, so the
+padded program returns bit-identical results for the real rows
+(property-tested in ``tests/test_serving_batcher.py``).
+
+The kernel mirrors ``models.forecast.rolling_er_forecast``'s projection —
+gather the month's lagged coefficient means, clip features to the fitted
+support, dot at HIGHEST precision — so a streamed query reproduces the
+batch forecast exactly wherever the batch forecast is defined
+(differential-tested in ``tests/test_serving.py``). Answerability is a
+DELIBERATE SUPERSET of the batch gate, at both levels, for the same
+reason — a serving system quotes E[r] at the START of a month, before
+realized returns can exist: per ROW, the batch path additionally requires
+the realized return to be finite (``row_validity`` includes
+``isfinite(y)``) because its rows feed decile sorts; per MONTH, the batch
+scatter leaves months whose own cross-section produced no coefficient row
+without a lagged mean, but that mean depends only on strictly-prior
+surviving months (``fit_forecast_artifacts``'s ``fill_invalid``
+semantics), so serving quotes there too. Every batch-finite cell matches
+serving exactly; serving additionally answers (features-complete,
+y-missing) rows and thin-cross-section months the batch skips. Pinned in
+``tests/test_serving.py::test_serving_answers_rows_with_missing_realized_return``
+and ``test_ingest_quote_for_month_without_returns``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["bucket_sizes", "bucket_for", "BucketedExecutor"]
+
+
+def bucket_sizes(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
+    """The power-of-two bucket ladder ``min_bucket .. max_batch`` (both
+    rounded UP to powers of two, so the top rung is the smallest power of
+    two holding a full ``max_batch`` request batch)."""
+    if max_batch < 1 or min_bucket < 1:
+        raise ValueError("max_batch and min_bucket must be >= 1")
+    lo = 1 << (min_bucket - 1).bit_length()
+    hi = 1 << (max_batch - 1).bit_length()
+    if lo > hi:
+        raise ValueError(
+            f"min_bucket {min_bucket} exceeds max_batch {max_batch}"
+        )
+    return tuple(1 << k for k in range(lo.bit_length() - 1, hi.bit_length()))
+
+
+def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
+    """Smallest bucket holding ``n`` rows (monotone in ``n``). The cap is
+    ``max_batch`` itself, not the rounded-up ladder top — callers must
+    split larger batches, even when the top bucket would physically fit
+    them (the knob means what it says for non-power-of-two values)."""
+    if n < 1:
+        raise ValueError("batch must hold at least one row")
+    ladder = bucket_sizes(max_batch, min_bucket)
+    if n > max_batch:
+        raise ValueError(f"batch of {n} exceeds max_batch {max_batch}")
+    for b in ladder:
+        if n <= b:
+            return b
+    raise AssertionError("unreachable")
+
+
+def _er_kernel(slopes_bar, intercept_bar, x_lo, x_hi, have_coef,
+               month_idx, x, valid):
+    """One bucket's projection: (B,) E[r] with NaN for unavailable rows.
+
+    A row is answerable when it is real (not padding), every predictor is
+    finite, and the month has a lagged coefficient mean. Features clip to
+    the month's fitted support (a no-op for in-panel values — the panel is
+    winsorized upstream — and a clamp for out-of-range raw features).
+    HIGHEST precision keeps the dot off the bf16 MXU path, matching the
+    batch forecast's einsum bit-for-bit on TPU f32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ok = valid & jnp.all(jnp.isfinite(x), axis=-1) & have_coef[month_idx]
+    xb = jnp.clip(x, x_lo[month_idx], x_hi[month_idx])
+    er = intercept_bar[month_idx] + jnp.einsum(
+        "bp,bp->b",
+        jnp.where(ok[:, None], xb, 0.0),
+        slopes_bar[month_idx],
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.where(ok, er, jnp.nan)
+
+
+class BucketedExecutor:
+    """Thread-safe cache of one AOT-compiled executable per bucket size.
+
+    Counters (read by the service's stats): ``hits`` — dispatches served by
+    an already-compiled bucket; ``misses`` — dispatches that had to compile
+    first (zero after ``warmup()``); ``compiles`` — total programs built.
+    """
+
+    def __init__(self, state, max_batch: int = 256, min_bucket: int = 1):
+        import jax.numpy as jnp
+
+        self.max_batch = int(max_batch)
+        self.min_bucket = int(min_bucket)
+        bucket_sizes(self.max_batch, self.min_bucket)  # fail fast, not in run()
+        self._dtype = state.dtype
+        # one device push of the fitted arrays, shared by every bucket
+        self._state_args = (
+            jnp.asarray(state.slopes_bar),
+            jnp.asarray(state.intercept_bar),
+            jnp.asarray(state.x_lo),
+            jnp.asarray(state.x_hi),
+            jnp.asarray(state.have_coef()),
+        )
+        self._n_months = state.n_months
+        self._exe: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+
+    def buckets(self) -> Tuple[int, ...]:
+        return bucket_sizes(self.max_batch, self.min_bucket)
+
+    def _build(self, bucket: int):
+        """Compile the bucket's executable. Called WITHOUT the lock held —
+        a compile costs orders of magnitude more than a dispatch, and
+        holding the executor-wide lock through one would stall concurrent
+        dispatches for buckets that are already compiled."""
+        import jax
+        import jax.numpy as jnp
+
+        example = (
+            jnp.zeros((bucket,), jnp.int32),
+            jnp.zeros((bucket, self._state_args[0].shape[1]), self._dtype),
+            jnp.zeros((bucket,), bool),
+        )
+        return jax.jit(_er_kernel).lower(*self._state_args, *example).compile()
+
+    def _ensure(self, bucket: int):
+        """The bucket's executable, compiling it first if needed (publish
+        under the lock; a rare concurrent duplicate build is idempotent and
+        cheaper than serializing every dispatch behind a compile)."""
+        with self._lock:
+            exe = self._exe.get(bucket)
+        if exe is None:
+            built = self._build(bucket)
+            with self._lock:
+                exe = self._exe.setdefault(bucket, built)
+                self.compiles += 1
+        return exe
+
+    def warmup(self) -> Tuple[int, ...]:
+        """Compile every bucket up front so no query ever pays a compile."""
+        for b in self.buckets():
+            self._ensure(b)
+        return self.buckets()
+
+    def run(self, month_idx, x, valid: Optional[np.ndarray] = None) -> np.ndarray:
+        """Execute one request batch: pad to its bucket, dispatch, trim.
+
+        month_idx : (B,) int month slots; x : (B, P); valid : (B,) bool
+        (rows the caller already knows are unanswerable). Returns (B,)
+        E[r] with NaN where unavailable.
+        """
+        month_idx = np.asarray(month_idx, dtype=np.int32)
+        x = np.asarray(x, dtype=self._dtype)
+        b = month_idx.shape[0]
+        if valid is None:
+            valid = np.ones(b, dtype=bool)
+        bucket = bucket_for(b, self.max_batch, self.min_bucket)
+        with self._lock:
+            if bucket in self._exe:
+                self.hits += 1
+            else:
+                self.misses += 1
+        exe = self._ensure(bucket)
+        pad = bucket - b
+        if pad:
+            month_idx = np.concatenate([month_idx, np.zeros(pad, np.int32)])
+            x = np.concatenate([x, np.zeros((pad, x.shape[1]), x.dtype)])
+            valid = np.concatenate([valid, np.zeros(pad, bool)])
+        # month_idx 0 on padding rows is a safe gather; valid=False makes
+        # the row an exact no-op (masking discipline).
+        out = exe(*self._state_args, month_idx, x, valid)
+        return np.asarray(out)[:b]

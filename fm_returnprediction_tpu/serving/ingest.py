@@ -1,0 +1,196 @@
+"""Incremental month ingest — append a cross-section without a refit.
+
+The batch fit is a pure function of per-month quantities: each month's OLS
+depends only on that month's cross-section, and the lagged rolling
+coefficient means depend only on STRICTLY PRIOR surviving months
+(``models.forecast``). Appending a month at the end of the calendar
+therefore touches exactly one new row of everything:
+
+- the new month's slopes come from its additive normal-equation sufficient
+  statistics (``ops.ols.sufficient_stats`` → ``solve_from_stats`` — the
+  same code path the multi-chip solver psums), so a month arriving in
+  pieces (two exchanges' files, say) MERGES: stats for disjoint row sets
+  add elementwise;
+- the new month's lagged rolling mean is recomputed from the stored
+  surviving coefficient rows alone (the trailing ``window`` of them, NaN
+  entries excluded per column with the ``min_periods`` gate — exactly
+  ``ops.rolling.rolling_mean``'s pandas semantics). It is computed whether
+  or not the new month's OWN cross-section yields a coefficient row — the
+  start-of-month quote is precisely a month with no realized returns yet
+  (``fit_forecast_artifacts``'s ``fill_invalid`` semantics);
+- every existing row of the state is carried over UNCHANGED — verified to
+  1e-6 against a full ``rolling_er_forecast`` refit in
+  ``tests/test_serving.py``.
+
+Appending more firms to the CURRENT last month re-solves that month from
+the merged stats; its own lagged mean is untouched (it never sees its own
+month), and no later months exist, so nothing else moves.
+
+Note the solver: the incremental route solves from sufficient statistics
+(the "normal" route). A state built with ``solver="normal"`` matches a
+full normal-route refit to machine precision; a ``"qr"``-built state's
+pre-existing months keep their QR solutions (unchanged by ingest), and
+only newly ingested months carry normal-route solutions — the documented
+drift between the two is conditioning-bounded (``ops.ols``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["ingest_month"]
+
+
+def _month_stats(y, x, mask, dtype):
+    """One cross-section's additive sufficient statistics (numpy leaves)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu.ops.ols import row_validity, sufficient_stats
+
+    y = jnp.asarray(np.asarray(y, dtype=dtype))
+    x = jnp.asarray(np.asarray(x, dtype=dtype))
+    valid = row_validity(y, x, jnp.asarray(np.asarray(mask, dtype=bool)))
+    return jax.device_get(sufficient_stats(y, x, valid))
+
+
+def _solve(stats_np):
+    """Per-month OLS from (numpy) sufficient statistics → (coef_row, valid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu.ops.ols import NormalStats, solve_from_stats
+
+    stats = NormalStats(*[jnp.asarray(leaf) for leaf in stats_np])
+    slopes, intercept, _r2, _n, month_valid = jax.device_get(
+        solve_from_stats(stats)
+    )
+    return np.concatenate([np.atleast_1d(intercept), slopes]), bool(month_valid)
+
+
+def _lagged_bar_row(coef, month_valid, window, min_periods, dtype):
+    """The lagged rolling coefficient mean for a row appended AFTER the
+    given months: mean of the trailing ``window`` surviving rows, NaN
+    entries excluded per column, gated on ``min_periods`` — replicating
+    ``rolling_over_valid_rows(..., row_lag=1)`` for the one new slot."""
+    surv = coef[month_valid]
+    tail = surv[-window:] if len(surv) else surv
+    finite = np.isfinite(tail)
+    cnt = finite.sum(axis=0)
+    total = np.where(finite, tail, 0.0).sum(axis=0)
+    return np.where(
+        cnt >= min_periods, total / np.maximum(cnt, 1), np.nan
+    ).astype(dtype)
+
+
+def _support_row(x, mask, dtype):
+    from fm_returnprediction_tpu.serving.state import _support_bounds
+
+    lo, hi = _support_bounds(
+        np.asarray(x, dtype=dtype)[None], np.asarray(mask, dtype=bool)[None]
+    )
+    return lo[0], hi[0]
+
+
+def ingest_month(state, y_new, x_new, mask_new, month):
+    """Append one month's cross-section to a ``ServingState``.
+
+    Parameters
+    ----------
+    state : ServingState
+    y_new : (N,) realized returns (may be all-NaN only if the month should
+            stay coefficient-less; the forecast for the month needs only
+            PRIOR months' coefficients, so serving can quote E[r] for a
+            month whose own returns are not final yet — it just won't
+            contribute a coefficient row until they are).
+    x_new : (N, P) lagged predictors for the month.
+    mask_new : (N,) row-exists mask.
+    month : the new month's label. Must be strictly later than the state's
+            last month (append-only), or EQUAL to it — in which case the
+            rows are merged into that month via stats addition.
+
+    Returns a NEW ServingState (states are frozen); the caller re-wraps it
+    in an executor/service (the T axis changed shape, so the old
+    executables do not apply).
+    """
+    dtype = state.dtype
+    x_new = np.asarray(x_new, dtype=dtype)
+    if x_new.shape[-1] != state.n_predictors:
+        raise ValueError(
+            f"expected {state.n_predictors} predictors ({state.xvars}), "
+            f"got {x_new.shape[-1]}"
+        )
+    stamp = np.datetime64(month, "ns")
+    merge = state.n_months > 0 and stamp == state.months[-1]
+    if state.n_months and not merge and stamp <= state.months[-1]:
+        raise ValueError(
+            f"ingest is append-only: {month!r} is not after {state.months[-1]!r}"
+        )
+
+    new = _month_stats(y_new, x_new, mask_new, dtype)
+    if merge:
+        last = tuple(leaf[-1] for leaf in (
+            state.gram, state.moment, state.n_obs, state.ysum, state.yy
+        ))
+        new = type(new)(*[a + b for a, b in zip(last, new)])
+    coef_row, valid_row = _solve(new)
+
+    if merge:
+        months = state.months
+        prior_coef = state.coef[:-1]
+        prior_valid = state.month_valid[:-1]
+    else:
+        months = np.concatenate(
+            [state.months, np.asarray([stamp], dtype="datetime64[ns]")]
+        )
+        prior_coef = state.coef
+        prior_valid = state.month_valid
+
+    # The slot's lagged rolling mean sees STRICTLY PRIOR months only, so a
+    # merge leaves it untouched (prior months did not move), and an append
+    # computes it UNCONDITIONALLY — whether the new month's own
+    # cross-section yields a coefficient row is irrelevant to the quote
+    # (matching ``fit_forecast_artifacts``'s fill_invalid semantics: the
+    # start-of-month use case is exactly a month with no returns yet).
+    if merge:
+        bar_for_slot = np.concatenate(
+            [state.intercept_bar[-1:], state.slopes_bar[-1]]
+        ).astype(dtype)
+    else:
+        bar_for_slot = _lagged_bar_row(
+            prior_coef, prior_valid, state.window, state.min_periods, dtype
+        )
+
+    from fm_returnprediction_tpu.serving.state import _merge_bounds
+
+    lo_new, hi_new = _support_row(x_new, mask_new, dtype)
+    if merge:
+        lo_row, hi_row = _merge_bounds(
+            state.x_lo[-1], state.x_hi[-1], lo_new, hi_new
+        )
+    else:
+        lo_row, hi_row = lo_new, hi_new
+
+    def _append(existing, row):
+        row = np.asarray(row)[None]
+        if merge:
+            return np.concatenate([existing[:-1], row.astype(existing.dtype)])
+        return np.concatenate([existing, row.astype(existing.dtype)])
+
+    return dataclasses.replace(
+        state,
+        months=months,
+        coef=_append(state.coef, coef_row),
+        month_valid=_append(state.month_valid, valid_row),
+        slopes_bar=_append(state.slopes_bar, bar_for_slot[1:]),
+        intercept_bar=_append(state.intercept_bar, bar_for_slot[0]),
+        x_lo=_append(state.x_lo, lo_row),
+        x_hi=_append(state.x_hi, hi_row),
+        gram=_append(state.gram, new.gram),
+        moment=_append(state.moment, new.moment),
+        n_obs=_append(state.n_obs, new.n),
+        ysum=_append(state.ysum, new.ysum),
+        yy=_append(state.yy, new.yy),
+    )

@@ -1,0 +1,122 @@
+"""Request/response front-end for online expected-return queries.
+
+Wires the frozen :class:`~fm_returnprediction_tpu.serving.state.ServingState`
+to the bucketed executor and the microbatcher, and owns the service-level
+instrumentation — the same discipline as ``utils.timing.StageTimer`` (every
+second has an owner): warm-up time is a named stage, and ``stats()`` merges
+the batcher's queue metrics (p50/p99 latency, batch occupancy, rejects)
+with the executor's executable-cache counters (hits/misses/compiles) and
+the service-level qps.
+
+Quickstart (build-state → warm → query)::
+
+    state = build_serving_state_from_panel(panel, masks["All stocks"])
+    with ERService(state) as svc:            # warm=True compiles all buckets
+        er = svc.query("2001-06-30", x_row)  # one firm's features
+        print(svc.report())
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fm_returnprediction_tpu.serving.batcher import MicroBatcher
+from fm_returnprediction_tpu.serving.executor import BucketedExecutor
+from fm_returnprediction_tpu.utils.timing import StageTimer
+
+__all__ = ["ERService"]
+
+
+class ERService:
+    """Online E[r] query service over a fitted ``ServingState``."""
+
+    def __init__(
+        self,
+        state,
+        max_batch: int = 256,
+        max_latency_ms: float = 2.0,
+        max_queue: int = 1024,
+        min_bucket: int = 1,
+        warm: bool = True,
+        auto_flush: bool = True,
+    ):
+        self.state = state
+        self.timer = StageTimer()
+        with self.timer.stage("serving/build_executor"):
+            self.executor = BucketedExecutor(
+                state, max_batch=max_batch, min_bucket=min_bucket
+            )
+        if warm:
+            with self.timer.stage("serving/warmup"):
+                self.executor.warmup()
+        self.batcher = MicroBatcher(
+            self.executor.run,
+            max_batch=max_batch,
+            max_latency_ms=max_latency_ms,
+            max_queue=max_queue,
+            auto_flush=auto_flush,
+            n_predictors=state.n_predictors,
+            min_bucket=min_bucket,
+        )
+        self._t0 = time.perf_counter()
+
+    # -- queries -----------------------------------------------------------
+
+    def submit(self, month, x) -> Future:
+        """Async query: one firm's predictor row for one month. The month is
+        an int T-slot or a datetime-like in the state's vocabulary; raises
+        ``KeyError`` for unknown months, :class:`QueueFullError` under
+        backpressure."""
+        return self.batcher.submit(self.state.month_index(month), x)
+
+    def query(self, month, x, timeout: Optional[float] = 30.0) -> float:
+        """Blocking single query → E[r] (NaN when unavailable: incomplete
+        predictors or a month with no lagged coefficient mean)."""
+        return self.submit(month, x).result(timeout=timeout)
+
+    def query_many(
+        self, months: Sequence, xs, timeout: Optional[float] = 30.0
+    ) -> np.ndarray:
+        """Submit a stream of single-row queries, gather all results (the
+        batcher coalesces them into bucket batches underneath)."""
+        futures = [self.submit(m, x) for m, x in zip(months, xs)]
+        return np.asarray([f.result(timeout=timeout) for f in futures])
+
+    # -- instrumentation ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """One flat dict: queue metrics + executable-cache counters + qps."""
+        out = self.batcher.stats()
+        elapsed = time.perf_counter() - self._t0
+        out.update(
+            qps=(out["n_done"] / elapsed) if elapsed > 0 else 0.0,
+            executable_cache_hits=self.executor.hits,
+            executable_cache_misses=self.executor.misses,
+            executable_compiles=self.executor.compiles,
+            buckets_compiled=len(self.executor.buckets()),
+            warmup_s=self.timer.durations.get("serving/warmup"),
+        )
+        return out
+
+    def report(self) -> str:
+        """StageTimer-style aligned report of the service counters."""
+        lines = [
+            f"{name:<40s} {value}"
+            for name, value in sorted(self.stats().items())
+        ]
+        return "\n".join([self.timer.report(), *lines])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self.batcher.close()
+
+    def __enter__(self) -> "ERService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,267 @@
+"""Frozen, cache-serializable serving state for online E[r] queries.
+
+The online service answers ``Ê[r]_{i,t} = ā_t + b̄_t' X_{i,t}`` — the same
+projection the batch forecast computes (``models.forecast``), addressed one
+firm (or a small batch) at a time. Everything a query needs is fitted
+offline and frozen here:
+
+- the LAGGED rolling-mean slopes and intercepts per month (strictly
+  out-of-sample: month t's coefficients average months ≤ t−1 only);
+- the featurization constants — the predictor order (``xvars``), the month
+  vocabulary, and per-month support bounds ``[x_lo, x_hi]`` (the observed
+  min/max of each predictor's valid cross-section — the panel is already
+  winsorized upstream, so clipping an in-panel value here is an exact
+  no-op, while a genuinely out-of-range raw query feature clamps to the
+  fitted support instead of extrapolating);
+- the per-month additive OLS sufficient statistics (``XᵀX``, ``Xᵀy``,
+  ``n``, ``Σy``, ``Σy²`` — ``ops.ols.NormalStats``), which make incremental
+  month ingest (``serving.ingest``) a merge instead of a refit;
+- the raw per-month coefficient rows and validity flags, from which the
+  ingest path recomputes ONLY the affected rolling means.
+
+The state is host-resident numpy (the executor pushes one device copy at
+construction) and persists through ``utils.cache.save_array_bundle`` — the
+same no-pickle npz contract as the dense-panel checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "ServingState",
+    "build_serving_state",
+    "build_serving_state_from_panel",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingState:
+    """Immutable fitted artifacts for the query path. All leaves numpy."""
+
+    months: np.ndarray         # (T,) datetime64[ns] month vocabulary
+    xvars: Tuple[str, ...]     # predictor order (featurization constant)
+    coef: np.ndarray           # (T, Q) per-month [intercept, slopes]
+    month_valid: np.ndarray    # (T,) bool
+    slopes_bar: np.ndarray     # (T, P) lagged rolling-mean slopes
+    intercept_bar: np.ndarray  # (T,)
+    x_lo: np.ndarray           # (T, P) fitted support lower bound (−inf: none)
+    x_hi: np.ndarray           # (T, P) fitted support upper bound (+inf: none)
+    gram: np.ndarray           # (T, Q, Q) additive XᵀX
+    moment: np.ndarray         # (T, Q)    additive Xᵀy
+    n_obs: np.ndarray          # (T,)      valid rows per month
+    ysum: np.ndarray           # (T,)      Σy per month
+    yy: np.ndarray             # (T,)      Σy² per month
+    window: int = 120
+    min_periods: int = 60
+    solver: str = "qr"
+
+    @property
+    def n_months(self) -> int:
+        return len(self.months)
+
+    @property
+    def n_predictors(self) -> int:
+        return self.slopes_bar.shape[1]
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.slopes_bar.dtype
+
+    def have_coef(self) -> np.ndarray:
+        """(T,) bool: month has a finite lagged coefficient mean."""
+        return np.isfinite(self.intercept_bar) & np.all(
+            np.isfinite(self.slopes_bar), axis=1
+        )
+
+    def month_index(self, month) -> int:
+        """Resolve a month (int index or datetime-like) to its T-axis slot."""
+        if isinstance(month, (int, np.integer)):
+            idx = int(month)
+            if not -self.n_months <= idx < self.n_months:
+                raise KeyError(f"month index {idx} out of range")
+            return idx % self.n_months
+        stamp = np.datetime64(month, "ns")
+        hit = np.nonzero(self.months == stamp)[0]
+        if not len(hit):
+            raise KeyError(f"month {month!r} not in serving state")
+        return int(hit[0])
+
+    def save(self, path: Union[Path, str]) -> Path:
+        from fm_returnprediction_tpu.utils.cache import save_array_bundle
+
+        arrays = {
+            "months": self.months.astype("datetime64[ns]").astype(np.int64),
+            "coef": self.coef,
+            "month_valid": self.month_valid,
+            "slopes_bar": self.slopes_bar,
+            "intercept_bar": self.intercept_bar,
+            "x_lo": self.x_lo,
+            "x_hi": self.x_hi,
+            "gram": self.gram,
+            "moment": self.moment,
+            "n_obs": self.n_obs,
+            "ysum": self.ysum,
+            "yy": self.yy,
+        }
+        meta = {
+            "xvars": list(self.xvars),
+            "window": self.window,
+            "min_periods": self.min_periods,
+            "solver": self.solver,
+        }
+        return save_array_bundle(path, arrays, meta)
+
+    @classmethod
+    def load(cls, path: Union[Path, str]) -> "ServingState":
+        from fm_returnprediction_tpu.utils.cache import load_array_bundle
+
+        arrays, meta = load_array_bundle(path)
+        return cls(
+            months=arrays["months"].astype("datetime64[ns]"),
+            xvars=tuple(meta["xvars"]),
+            coef=arrays["coef"],
+            month_valid=arrays["month_valid"],
+            slopes_bar=arrays["slopes_bar"],
+            intercept_bar=arrays["intercept_bar"],
+            x_lo=arrays["x_lo"],
+            x_hi=arrays["x_hi"],
+            gram=arrays["gram"],
+            moment=arrays["moment"],
+            n_obs=arrays["n_obs"],
+            ysum=arrays["ysum"],
+            yy=arrays["yy"],
+            window=int(meta["window"]),
+            min_periods=int(meta["min_periods"]),
+            solver=str(meta["solver"]),
+        )
+
+
+def _support_bounds(x, mask, xp=np):
+    """Per-month observed min/max of each predictor's valid entries.
+
+    Per-predictor finiteness (not complete-case): a firm missing ROA still
+    contributes its size to size's support. Empty cells open to ±inf so the
+    query-time clip is a no-op there. ``xp`` selects the array module — the
+    ONE home for this rule: numpy for the ingest path's single row,
+    ``jax.numpy`` for the build path (bounds computed on device so only the
+    (T, P) result crosses the link, not the (T, N, P) predictor slice).
+    """
+    ok = mask[..., None] & xp.isfinite(x)
+    lo = xp.where(ok, x, xp.inf).min(axis=1)
+    hi = xp.where(ok, x, -xp.inf).max(axis=1)
+    empty = ~ok.any(axis=1)
+    lo = xp.where(empty, -xp.inf, lo)
+    hi = xp.where(empty, xp.inf, hi)
+    return lo.astype(x.dtype), hi.astype(x.dtype)
+
+
+def _merge_bounds(lo_a, hi_a, lo_b, hi_b):
+    """Elementwise union of two fitted supports, respecting the ±inf
+    "no observation" sentinels (observed bounds are always finite — the
+    support only covers finite entries). Both sides empty stays open."""
+    lo = np.minimum(
+        np.where(np.isfinite(lo_a), lo_a, np.inf),
+        np.where(np.isfinite(lo_b), lo_b, np.inf),
+    )
+    hi = np.maximum(
+        np.where(np.isfinite(hi_a), hi_a, -np.inf),
+        np.where(np.isfinite(hi_b), hi_b, -np.inf),
+    )
+    return (
+        np.where(np.isfinite(lo), lo, -np.inf),
+        np.where(np.isfinite(hi), hi, np.inf),
+    )
+
+
+def build_serving_state(
+    y,
+    x,
+    mask,
+    months: Optional[np.ndarray] = None,
+    xvars: Optional[Sequence[str]] = None,
+    window: int = 120,
+    min_periods: int = 60,
+    solver: str = "qr",
+    cs=None,
+) -> ServingState:
+    """Fit a ``ServingState`` from a dense panel's arrays.
+
+    Same inputs as ``models.forecast.rolling_er_forecast`` (pass ``cs`` to
+    reuse an already-computed batched OLS — e.g. a ``subset_sweep`` entry,
+    so the pipeline does not re-run the fit). One compiled program
+    (``fit_forecast_artifacts``) produces the coefficients, the lagged
+    rolling means and the sufficient statistics; the support bounds are one
+    numpy pass.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu.models.forecast import fit_forecast_artifacts
+
+    y_j, x_j, mask_j = jnp.asarray(y), jnp.asarray(x), jnp.asarray(mask)
+    art = jax.device_get(
+        fit_forecast_artifacts(
+            y_j, x_j, mask_j,
+            window=window, min_periods=min_periods, solver=solver, cs=cs,
+        )
+    )
+    lo, hi = jax.device_get(_support_bounds(x_j, mask_j, xp=jnp))
+    n_predictors = x_j.shape[-1]
+    t = art.coef.shape[0]
+    if months is None:
+        months = np.arange(t).astype("datetime64[M]").astype("datetime64[ns]")
+    if xvars is None:
+        xvars = tuple(f"x{k}" for k in range(n_predictors))
+    return ServingState(
+        months=np.asarray(months).astype("datetime64[ns]"),
+        xvars=tuple(xvars),
+        coef=art.coef,
+        month_valid=art.month_valid,
+        slopes_bar=art.slopes_bar,
+        intercept_bar=art.intercept_bar,
+        x_lo=lo,
+        x_hi=hi,
+        gram=art.stats.gram,
+        moment=art.stats.moment,
+        n_obs=art.stats.n,
+        ysum=art.stats.ysum,
+        yy=art.stats.yy,
+        window=window,
+        min_periods=min_periods,
+        solver=solver,
+    )
+
+
+def build_serving_state_from_panel(
+    panel,
+    subset_mask,
+    return_col: str = "retx",
+    xvars: Optional[Sequence[str]] = None,
+    window: int = 120,
+    min_periods: int = 60,
+    solver: str = "qr",
+    cs=None,
+) -> ServingState:
+    """Fit the serving state from a pipeline ``DensePanel`` — the figure's
+    5-variable model over one subset, matching the decile-table forecast
+    route cell for cell."""
+    from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
+
+    if xvars is None:
+        xvars = list(FIGURE1_VARS.keys())
+    return build_serving_state(
+        panel.var(return_col),
+        panel.select(xvars),
+        np.asarray(subset_mask),
+        months=panel.months,
+        xvars=xvars,
+        window=window,
+        min_periods=min_periods,
+        solver=solver,
+        cs=cs,
+    )

@@ -2,7 +2,8 @@
 
 Replaces the reference's doit build system (``dodo.py``) with an in-package
 engine (sqlite state, content-hash deps, green/SLURM reporters) and the
-Lewellen pipeline expressed as five tasks with a dense-panel checkpoint.
+Lewellen pipeline expressed as six tasks with dense-panel and warmed
+serving-state checkpoints.
 """
 
 from fm_returnprediction_tpu.taskgraph.engine import (

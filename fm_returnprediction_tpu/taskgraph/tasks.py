@@ -19,6 +19,9 @@ Stages (task name → targets):
 - ``reports``     → Table 1/2 pickles + ``.tex`` + ``figure_1.pdf`` +
   ``data_saved.marker`` in OUTPUT_DIR (contract of ``save_data``,
   ``src/calc_Lewellen_2014.py:959-1005``)
+- ``serve_state`` → ``serving_state.npz`` in PROCESSED_DATA_DIR — the
+  warmed online-serving state (``serving.state``), rebuilt only when the
+  panel checkpoint changes
 - ``latex``       → compiled report PDF (``pdflatex`` run twice,
   continue-on-error, ``src/calc_Lewellen_2014.py:1197-1209``)
 
@@ -34,10 +37,14 @@ from typing import List, Optional
 from fm_returnprediction_tpu.settings import config, create_dirs
 from fm_returnprediction_tpu.taskgraph.engine import Task
 
-__all__ = ["build_tasks", "build_notebook_tasks", "PANEL_FILE", "FACTORS_FILE"]
+__all__ = [
+    "build_tasks", "build_notebook_tasks",
+    "PANEL_FILE", "FACTORS_FILE", "SERVING_FILE",
+]
 
 PANEL_FILE = "lewellen_panel.npz"
 FACTORS_FILE = "factors_dict.json"
+SERVING_FILE = "serving_state.npz"
 
 
 def _raw_paths(raw_dir: Path) -> List[Path]:
@@ -208,6 +215,31 @@ def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
     _primary_writes("reports_saved", save)
 
 
+def _serve_state(processed_dir: Path) -> None:
+    """Build and WARM the online-serving state from the panel checkpoint.
+
+    The warm-up compiles every query bucket through the same
+    ``BucketedExecutor`` the service uses, so the persistent XLA
+    compilation cache (when enabled) already holds the serving programs
+    when the first service process starts — build-and-warm is one task,
+    not a query-time surprise."""
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.serving.executor import BucketedExecutor
+    from fm_returnprediction_tpu.serving.state import (
+        build_serving_state_from_panel,
+    )
+
+    panel = DensePanel.load(processed_dir / PANEL_FILE)
+    masks = compute_subset_masks(panel)
+    state = build_serving_state_from_panel(panel, masks["All stocks"])
+    BucketedExecutor(state).warmup()
+    _primary_writes(
+        "serve_state_saved",
+        lambda: state.save(processed_dir / SERVING_FILE),
+    )
+
+
 def _parity(raw_dir: Path, output_dir: Path) -> None:
     """Real-cache Table 1 vs the published Lewellen oracle; records the full
     diff, then raises on any out-of-tolerance cell."""
@@ -291,6 +323,17 @@ def build_tasks(
             ],
             task_dep=["build_panel"],
             doc="Panel checkpoint → Table 1/2, Figure 1, artifacts",
+        ),
+        Task(
+            name="serve_state",
+            actions=[lambda: _serve_state(processed_dir)],
+            # depends on the ONE fitted artifact it reads — the panel
+            # checkpoint — so the warmed state rebuilds only when that
+            # changes (a factors-only refresh must not re-warm)
+            file_dep=[processed_dir / PANEL_FILE],
+            targets=[processed_dir / SERVING_FILE],
+            task_dep=["build_panel"],
+            doc="Panel checkpoint → warmed online-serving state",
         ),
         Task(
             name="latex",

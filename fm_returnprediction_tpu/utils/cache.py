@@ -17,11 +17,13 @@ from __future__ import annotations
 
 import datetime
 import hashlib
+import json
 import re
 import zipfile
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
+import numpy as np
 import pandas as pd
 
 __all__ = [
@@ -33,6 +35,8 @@ __all__ = [
     "save_cache_data",
     "load_cache_data",
     "flatten_dict_to_str",
+    "save_array_bundle",
+    "load_array_bundle",
 ]
 
 _DEFAULT_EXTS = ("parquet", "csv", "zip")
@@ -209,6 +213,62 @@ def save_cache_data(
         cache_path = Path(data_dir, file_name)
     write_cache_data(df, cache_path)
     return cache_path
+
+
+_BUNDLE_META_KEY = "__meta__"
+
+
+def save_array_bundle(
+    path: Union[Path, str],
+    arrays: Dict[str, np.ndarray],
+    meta: Optional[Dict[str, Any]] = None,
+) -> Path:
+    """Checkpoint a named set of arrays + a JSON metadata blob as one npz.
+
+    The non-frame sibling of the parquet cache (same substrate role:
+    persist-and-short-circuit): array-valued artifacts like the serving
+    state live here. The metadata rides as a fixed-width unicode scalar —
+    NOT object dtype — so the bundle stays loadable with ``allow_pickle``
+    off (no pickle deserialization surface in a shared artifact, the same
+    contract as ``DensePanel.save``).
+    """
+    path = Path(path)
+    if path.suffix != ".npz":
+        # np.savez appends ".npz" to other names; normalize up front so the
+        # RETURNED path is always the one actually written
+        path = Path(str(path) + ".npz")
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # names that collide with np.savez_compressed's own parameters would be
+    # consumed as keyword arguments (TypeError for "file", silently dropped
+    # for flags like "allow_pickle") instead of saved — reject them up front
+    reserved = {_BUNDLE_META_KEY, "file", "args", "kwds", "allow_pickle"}
+    bad = reserved.intersection(arrays)
+    if bad:
+        raise ValueError(f"array names {sorted(bad)!r} are reserved")
+    np.savez_compressed(
+        path,
+        **{_BUNDLE_META_KEY: np.asarray(json.dumps(meta or {}))},
+        **arrays,
+    )
+    return path
+
+
+def load_array_bundle(
+    path: Union[Path, str],
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load an array bundle written by :func:`save_array_bundle`:
+    ``(arrays, meta)``. Raises ``FileNotFoundError`` when absent."""
+    path = Path(path)
+    if not path.exists():
+        raise FileNotFoundError(f"Array bundle {path} not found.")
+    with np.load(path, allow_pickle=False) as z:
+        meta = (
+            json.loads(str(z[_BUNDLE_META_KEY][()]))
+            if _BUNDLE_META_KEY in z.files
+            else {}
+        )
+        arrays = {k: z[k] for k in z.files if k != _BUNDLE_META_KEY}
+    return arrays, meta
 
 
 def load_cache_data(

@@ -101,3 +101,21 @@ def test_hash_filename_date_in_value_is_hashed(tmp_path):
     paths = cache.hash_cache_filename("q", "table=stkdatedelist,start_date=2020-01-01", tmp_path)
     assert "stkdatedelist" not in paths[0].name
     assert "20200101" in paths[0].name
+
+
+def test_array_bundle_roundtrip_and_reserved_names(tmp_path):
+    """Bundle arrays + meta roundtrip exactly; names that would collide
+    with np.savez_compressed's own parameters (consumed as kwargs —
+    TypeError for 'file', silently DROPPED for 'allow_pickle') are
+    rejected up front instead of corrupting the bundle."""
+    import numpy as np
+
+    arrays = {"a": np.arange(6.0).reshape(2, 3), "b": np.array([True, False])}
+    path = cache.save_array_bundle(tmp_path / "bundle", arrays, {"k": 1})
+    got, meta = cache.load_array_bundle(path)
+    assert meta == {"k": 1}
+    for name in arrays:
+        np.testing.assert_array_equal(got[name], arrays[name])
+    for bad in ("file", "allow_pickle", "args", "kwds", "__meta__"):
+        with pytest.raises(ValueError, match="reserved"):
+            cache.save_array_bundle(tmp_path / "x", {bad: np.zeros(1)})

@@ -1,0 +1,397 @@
+"""Online serving subsystem vs the batch forecast oracle.
+
+The serving contract is differential: a streamed, microbatched, padded,
+thread-interleaved sequence of single-firm queries must reproduce the batch
+``rolling_er_forecast`` projection exactly (1e-6 over the acceptance
+tolerance; asserted far tighter here), including firms with incomplete
+predictors (NaN, never a padded-garbage value); incremental month ingest
+must match a full refit; and after warm-up the executable cache must serve
+every dispatch (no query-time compiles — asserted through the service's
+own counters).
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.models.forecast import rolling_er_forecast
+from fm_returnprediction_tpu.serving import (
+    ERService,
+    ServingState,
+    build_serving_state,
+    ingest_month,
+)
+
+WINDOW, MIN_PERIODS = 40, 20
+
+
+def _make_panel(rng, t=120, n=80, p=3, signal=0.05, nan_features=True):
+    x = rng.standard_normal((t, n, p))
+    beta = signal * np.array([1.0, -0.5, 0.25])[:p]
+    y = x @ beta + 0.02 * rng.standard_normal((t, n))
+    mask = rng.random((t, n)) > 0.1
+    y = np.where(mask, y, np.nan)
+    x = np.where(mask[..., None], x, np.nan)
+    if nan_features:
+        # firms with incomplete predictors INSIDE the mask: one feature NaN
+        holes = rng.random((t, n)) < 0.05
+        x[..., 0] = np.where(holes & mask, np.nan, x[..., 0])
+    return y, x, mask
+
+
+@pytest.fixture(scope="module")
+def case():
+    rng = np.random.default_rng(2015)
+    y, x, mask = _make_panel(rng)
+    fr = rolling_er_forecast(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(mask),
+        window=WINDOW, min_periods=MIN_PERIODS,
+    )
+    state = build_serving_state(
+        y, x, mask, window=WINDOW, min_periods=MIN_PERIODS
+    )
+    return y, x, mask, np.asarray(fr.er), np.asarray(fr.slopes_bar), state
+
+
+def test_state_matches_batch_artifacts(case):
+    _, _, _, _, slopes_bar, state = case
+    np.testing.assert_allclose(
+        state.slopes_bar, slopes_bar, rtol=1e-12, equal_nan=True
+    )
+    assert state.coef.shape == (120, 4)
+    assert state.gram.shape == (120, 4, 4)
+
+
+def test_microbatched_stream_matches_batch_forecast(case):
+    """Random single-firm queries from several threads, coalesced by the
+    live batcher, equal the batch projection — NaN rows included."""
+    _, x, _, er, _, state = case
+    rng = np.random.default_rng(7)
+    t, n = er.shape
+    pairs = [
+        (int(rng.integers(0, t)), int(rng.integers(0, n))) for _ in range(400)
+    ]
+    got = np.empty(len(pairs))
+    with ERService(state, max_batch=32, max_latency_ms=1.0) as svc:
+        def worker(lo, hi):
+            for k in range(lo, hi):
+                tt, i = pairs[k]
+                got[k] = svc.query(tt, x[tt, i])
+
+        threads = [
+            threading.Thread(target=worker, args=(k * 100, (k + 1) * 100))
+            for k in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stats = svc.stats()
+    want = np.array([er[tt, i] for tt, i in pairs])
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+    # the stream genuinely exercised the serving path
+    assert stats["n_done"] == len(pairs)
+    assert stats["executable_cache_misses"] == 0  # warm=True precompiled
+
+
+def test_incomplete_predictors_return_nan_not_padded_garbage(case):
+    _, x, mask, er, _, state = case
+    with ERService(state, max_batch=8, max_latency_ms=0.5) as svc:
+        # a masked-out firm-month (features NaN) and an in-mask firm with a
+        # NaN feature must both come back NaN
+        t_q = 100
+        nan_rows = np.nonzero(~np.isfinite(er[t_q]))[0]
+        assert len(nan_rows), "fixture must contain unavailable rows"
+        for i in nan_rows[:5]:
+            assert np.isnan(svc.query(t_q, x[t_q, i]))
+        # an explicit all-NaN feature row is unavailable too
+        assert np.isnan(svc.query(t_q, np.full(state.n_predictors, np.nan)))
+
+
+def test_serving_answers_rows_with_missing_realized_return(case):
+    """DELIBERATE superset of the batch gate (executor docstring): the
+    batch ``er_valid`` additionally requires the row's REALIZED return to
+    be finite because its rows feed decile sorts, but serving quotes E[r]
+    at the start of a month — before the realized return can exist — so a
+    features-complete row with missing y is answerable, and the answer is
+    exactly the projection the batch would make."""
+    y, x, mask, er, _, state = case
+    t_q = 110
+    assert state.have_coef()[t_q]
+    rows = np.nonzero(
+        mask[t_q] & ~np.isfinite(y[t_q]) | (
+            mask[t_q] & np.all(np.isfinite(x[t_q]), axis=1)
+        )
+    )[0]
+    # a live-quote row: complete predictors, NO realized return
+    x_row = x[t_q, rows[0]].copy()
+    x_row = np.where(np.isfinite(x_row), x_row, 0.0)  # force complete
+    expected = state.intercept_bar[t_q] + float(
+        np.clip(x_row, state.x_lo[t_q], state.x_hi[t_q]) @ state.slopes_bar[t_q]
+    )
+    with ERService(state, max_batch=8, max_latency_ms=0.5) as svc:
+        got = svc.query(t_q, x_row)
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+    # and wherever the batch IS defined, serving agrees (the differential
+    # tests pin this panel-wide; this is the superset's boundary)
+    finite_cells = np.nonzero(np.isfinite(er[t_q]))[0]
+    assert len(finite_cells)
+
+
+def test_months_before_min_periods_are_unavailable(case):
+    _, x, _, er, _, state = case
+    assert not state.have_coef()[:MIN_PERIODS].any()
+    with ERService(state, max_batch=8, max_latency_ms=0.5) as svc:
+        assert np.isnan(svc.query(0, np.zeros(state.n_predictors)))
+
+
+def test_unknown_month_raises(case):
+    *_, state = case
+    with ERService(state, warm=False, auto_flush=False) as svc:
+        with pytest.raises(KeyError):
+            svc.query(np.datetime64("1901-01-01"), np.zeros(3))
+        with pytest.raises(KeyError):
+            svc.query(10_000, np.zeros(3))
+
+
+def test_no_compiles_after_warmup_over_1k_query_stream(case):
+    """Acceptance criterion: a 1k-query synthetic stream with varying batch
+    sizes hits the executable cache on EVERY dispatch after warm-up —
+    asserted via the service's own counters."""
+    _, x, _, er, _, state = case
+    rng = np.random.default_rng(11)
+    t, n = er.shape
+    with ERService(state, max_batch=32, max_latency_ms=0.2) as svc:
+        warm_compiles = svc.executor.compiles
+        assert warm_compiles == len(svc.executor.buckets())
+        assert svc.executor.misses == 0
+        served = 0
+        while served < 1000:
+            k = int(rng.integers(1, 50))  # varying burst sizes
+            months = rng.integers(0, t, k)
+            rows = rng.integers(0, n, k)
+            svc.query_many(list(months), [x[tt, i] for tt, i in zip(months, rows)])
+            served += k
+        stats = svc.stats()
+    assert stats["n_done"] == served >= 1000
+    assert stats["executable_cache_misses"] == 0
+    assert svc.executor.compiles == warm_compiles  # nothing new compiled
+    assert stats["executable_cache_hits"] == stats["n_batches"] > 0
+
+
+def test_ingest_matches_full_refit(case):
+    """Acceptance criterion: ingesting months one at a time from sufficient
+    statistics matches a full ``rolling_er_forecast`` refit (1e-6; asserted
+    tighter) — coefficients, lagged means, AND the queried projections."""
+    y, x, mask, _, _, _ = case
+    t0, t = 90, y.shape[0]
+    state = build_serving_state(
+        y[:t0], x[:t0], mask[:t0], window=WINDOW, min_periods=MIN_PERIODS,
+        solver="normal",
+    )
+    for tt in range(t0, t):
+        state = ingest_month(
+            state, y[tt], x[tt], mask[tt], np.datetime64(tt, "M")
+        )
+    full = rolling_er_forecast(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(mask),
+        window=WINDOW, min_periods=MIN_PERIODS, solver="normal",
+    )
+    np.testing.assert_allclose(
+        state.slopes_bar, np.asarray(full.slopes_bar),
+        rtol=1e-9, atol=1e-12, equal_nan=True,
+    )
+    np.testing.assert_allclose(
+        state.intercept_bar, np.asarray(full.intercept_bar),
+        rtol=1e-9, atol=1e-12, equal_nan=True,
+    )
+    # rebuild-from-scratch equals the ingested state (bounds, stats, coef)
+    rebuilt = build_serving_state(
+        y, x, mask, window=WINDOW, min_periods=MIN_PERIODS, solver="normal"
+    )
+    np.testing.assert_allclose(
+        state.coef, rebuilt.coef, rtol=1e-9, atol=1e-12, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        state.x_lo, rebuilt.x_lo, rtol=1e-12, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        state.gram, rebuilt.gram, rtol=1e-9, atol=1e-12
+    )
+    # and the queries served off the ingested state match the batch er
+    er_full = np.asarray(full.er)
+    with ERService(state, max_batch=16, max_latency_ms=0.5) as svc:
+        for tt in range(t0, t):
+            for i in range(0, y.shape[1], 17):
+                got = svc.query(tt, x[tt, i])
+                want = er_full[tt, i]
+                if np.isnan(want):
+                    assert np.isnan(got)
+                else:
+                    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_ingest_same_month_stats_are_additive(case):
+    """A month arriving in two disjoint pieces merges via stats addition to
+    exactly the one-shot ingest."""
+    y, x, mask, _, _, _ = case
+    t0 = 90
+    base = build_serving_state(
+        y[:t0], x[:t0], mask[:t0], window=WINDOW, min_periods=MIN_PERIODS,
+        solver="normal",
+    )
+    month = np.datetime64(t0, "M")
+    half = y.shape[1] // 2
+    m_a, m_b = mask[t0].copy(), mask[t0].copy()
+    m_a[half:] = False
+    m_b[:half] = False
+    two = ingest_month(base, np.where(m_a, y[t0], np.nan), x[t0], m_a, month)
+    two = ingest_month(two, np.where(m_b, y[t0], np.nan), x[t0], m_b, month)
+    one = ingest_month(base, y[t0], x[t0], mask[t0], month)
+    np.testing.assert_allclose(two.gram, one.gram, rtol=1e-12)
+    np.testing.assert_allclose(two.moment, one.moment, rtol=1e-12)
+    np.testing.assert_array_equal(two.n_obs, one.n_obs)
+    np.testing.assert_allclose(
+        two.coef, one.coef, rtol=1e-9, atol=1e-12, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        two.x_lo, one.x_lo, rtol=1e-12, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        two.x_hi, one.x_hi, rtol=1e-12, equal_nan=True
+    )
+
+
+def test_ingest_quote_for_month_without_returns(case):
+    """The start-of-month use case (the superset's month level, executor
+    docstring): ingest a new month whose returns do not exist yet — its
+    own cross-section yields NO coefficient row — and the service must
+    still quote E[r] there from strictly-prior months' coefficients; the
+    bar must equal a full serving-state rebuild on the extended panel."""
+    y, x, mask, _, _, _ = case
+    base = build_serving_state(
+        y, x, mask, window=WINDOW, min_periods=MIN_PERIODS
+    )
+    t, n, p = x.shape
+    rng = np.random.default_rng(3)
+    x_new = rng.standard_normal((n, p))
+    y_new = np.full(n, np.nan)  # no realized returns yet
+    month = np.datetime64(t, "M")
+    state = ingest_month(base, y_new, x_new, np.ones(n, bool), month)
+    assert not state.month_valid[-1]  # contributed no coefficient row ...
+    assert state.have_coef()[-1]      # ... but the quote is available
+    # the bar equals a full rebuild that sees the same y-less month
+    rebuilt = build_serving_state(
+        np.concatenate([y, y_new[None]]),
+        np.concatenate([x, x_new[None]]),
+        np.concatenate([mask, np.ones((1, n), bool)]),
+        window=WINDOW, min_periods=MIN_PERIODS,
+    )
+    np.testing.assert_allclose(
+        state.slopes_bar[-1], rebuilt.slopes_bar[-1], rtol=1e-6, atol=1e-9
+    )
+    with ERService(state, max_batch=8, max_latency_ms=0.5) as svc:
+        got = svc.query(month, x_new[0])
+    expected = state.intercept_bar[-1] + float(
+        np.clip(x_new[0], state.x_lo[-1], state.x_hi[-1])
+        @ state.slopes_bar[-1]
+    )
+    np.testing.assert_allclose(got, expected, rtol=1e-9)
+    # once the returns arrive, the merge upgrades the month to a
+    # coefficient row and the (prior-months-only) bar does not move
+    y_real = x_new @ (0.05 * np.array([1.0, -0.5, 0.25])[:p])
+    merged = ingest_month(state, y_real, x_new, np.ones(n, bool), month)
+    assert merged.month_valid[-1]
+    np.testing.assert_array_equal(merged.slopes_bar[-1], state.slopes_bar[-1])
+
+
+def test_built_state_quotes_thin_months(case):
+    """``build_serving_state`` applies the same month-level superset: a
+    month with too few valid rows for its own OLS still gets the lagged
+    mean of its strictly-prior surviving months."""
+    y, x, mask, _, _, _ = case
+    y2, x2, mask2 = y.copy(), x.copy(), mask.copy()
+    t_thin = 110
+    mask2[t_thin, 2:] = False  # 2 rows < Q=4: month cannot run its OLS
+    y2[t_thin, 2:] = np.nan
+    x2[t_thin, 2:] = np.nan
+    state = build_serving_state(
+        y2, x2, mask2, window=WINDOW, min_periods=MIN_PERIODS
+    )
+    assert not state.month_valid[t_thin]
+    assert state.have_coef()[t_thin]
+    # the batch forecast keeps its scatter convention (NaN there) — the
+    # superset is serving-only
+    fr = rolling_er_forecast(
+        jnp.asarray(y2), jnp.asarray(x2), jnp.asarray(mask2),
+        window=WINDOW, min_periods=MIN_PERIODS,
+    )
+    assert np.isnan(np.asarray(fr.slopes_bar)[t_thin]).all()
+    # and the thin month's bar equals the NEXT surviving month's (same
+    # prior window: the thin month contributed no row)
+    t_next = t_thin + 1
+    assert state.month_valid[t_next]
+    np.testing.assert_array_equal(
+        state.slopes_bar[t_thin], state.slopes_bar[t_next]
+    )
+
+
+def test_ingest_is_append_only(case):
+    *_, state = case
+    with pytest.raises(ValueError):
+        ingest_month(
+            state, np.zeros(3), np.zeros((3, 3)), np.ones(3, bool),
+            state.months[0],
+        )
+    with pytest.raises(ValueError):  # predictor-count contract
+        ingest_month(
+            state, np.zeros(3), np.zeros((3, 7)), np.ones(3, bool),
+            np.datetime64("2999-01-01"),
+        )
+
+
+def test_state_save_load_roundtrip(case, tmp_path):
+    *_, state = case
+    path = state.save(tmp_path / "serving_state.npz")
+    back = ServingState.load(path)
+    np.testing.assert_array_equal(back.months, state.months)
+    assert back.xvars == state.xvars
+    assert (back.window, back.min_periods, back.solver) == (
+        state.window, state.min_periods, state.solver
+    )
+    for name in ("coef", "month_valid", "slopes_bar", "intercept_bar",
+                 "x_lo", "x_hi", "gram", "moment", "n_obs", "ysum", "yy"):
+        np.testing.assert_allclose(
+            getattr(back, name), getattr(state, name),
+            rtol=0, atol=0, equal_nan=True,
+        )
+    # the loaded state serves: one query round-trips a fresh service
+    with ERService(back, max_batch=4, max_latency_ms=0.5) as svc:
+        value = svc.query(100, np.zeros(back.n_predictors))
+    assert isinstance(value, float)  # numerics pinned by the differential tests
+
+
+def test_pipeline_returns_and_persists_serving_state(tmp_path):
+    """Satellite contract: ``run_pipeline`` exposes the fitted serving
+    artifacts and persists them next to the report artifacts."""
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    res = run_pipeline(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=50, n_months=90),
+        output_dir=tmp_path,
+        make_figure=False,
+        compile_pdf=False,
+    )
+    state = res.serving_state
+    assert state is not None
+    assert state.n_months == len(res.panel.months)
+    assert list(state.xvars)  # figure variables
+    assert (tmp_path / "serving_state.npz").exists()
+    back = ServingState.load(tmp_path / "serving_state.npz")
+    np.testing.assert_allclose(
+        back.slopes_bar, state.slopes_bar, equal_nan=True
+    )

@@ -1,0 +1,372 @@
+"""Microbatcher + bucketed-executor property tests.
+
+Hand-rolled property sweeps (no hypothesis in the image): bucket choice is
+monotone and power-of-two, padding is an exact no-op on real rows, and a
+full queue raises the documented backpressure error instead of blocking —
+guarded by thread-join timeouts so a regression fails instead of hanging
+the suite. Stress variants are marked ``slow``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.serving import (
+    MicroBatcher,
+    QueueFullError,
+    build_serving_state,
+)
+from fm_returnprediction_tpu.serving.executor import (
+    BucketedExecutor,
+    bucket_for,
+    bucket_sizes,
+)
+
+
+def _tiny_state(rng, t=50, n=30, p=2):
+    x = rng.standard_normal((t, n, p))
+    y = x @ np.array([0.5, -0.25]) + 0.01 * rng.standard_normal((t, n))
+    mask = rng.random((t, n)) > 0.1
+    y = np.where(mask, y, np.nan)
+    x = np.where(mask[..., None], x, np.nan)
+    return build_serving_state(y, x, mask, window=20, min_periods=10)
+
+
+# -- bucketing properties --------------------------------------------------
+
+
+def test_bucket_ladder_is_powers_of_two():
+    for max_batch in (1, 2, 3, 7, 8, 64, 100, 256):
+        ladder = bucket_sizes(max_batch)
+        assert all(b & (b - 1) == 0 for b in ladder)
+        assert ladder[-1] >= max_batch
+        assert list(ladder) == sorted(set(ladder))
+
+
+def test_bucket_choice_is_monotone_and_minimal():
+    """Property: over every request size up to max_batch, the bucket is the
+    SMALLEST ladder rung that fits, and n → bucket_for(n) is monotone
+    non-decreasing."""
+    for max_batch in (8, 64, 100):
+        prev = 0
+        for n in range(1, max_batch + 1):
+            b = bucket_for(n, max_batch)
+            assert b >= n
+            assert b >= prev  # monotone
+            smaller = [r for r in bucket_sizes(max_batch) if r < b]
+            assert all(r < n for r in smaller)  # minimal
+            prev = b
+
+
+def test_bucket_for_rejects_nonsense():
+    with pytest.raises(ValueError):
+        bucket_for(0, 8)
+    with pytest.raises(ValueError):
+        bucket_for(9, 8)  # past max_batch: caller must split
+    with pytest.raises(ValueError):
+        # the cap is max_batch ITSELF, not the rounded-up ladder top —
+        # 101 rows would physically fit the 128 bucket but the knob says 100
+        bucket_for(101, 100)
+    with pytest.raises(ValueError):
+        bucket_sizes(0)
+
+
+def test_min_bucket_floors_the_ladder():
+    assert bucket_sizes(64, min_bucket=8)[0] == 8
+    assert bucket_for(1, 64, min_bucket=8) == 8
+
+
+# -- padding is an exact no-op --------------------------------------------
+
+
+def test_padding_never_changes_results(rng):
+    """Property: for every batch size 1..max_batch, running the same rows
+    through a padded bucket equals running them alone — bit-identical (the
+    masking discipline: a padding row is an exact no-op)."""
+    state = _tiny_state(rng)
+    exe = BucketedExecutor(state, max_batch=16)
+    exe.warmup()
+    t = state.n_months
+    full = exe.run(
+        np.arange(16) % t,
+        np.asarray([np.zeros(2) + 0.1 * k for k in range(16)]),
+    )
+    for size in range(1, 17):
+        got = exe.run(
+            np.arange(size) % t,
+            np.asarray([np.zeros(2) + 0.1 * k for k in range(size)]),
+        )
+        # same row, same bucket-or-not: results must agree exactly
+        np.testing.assert_array_equal(got, full[:size])
+
+
+def test_padding_rows_never_leak(rng):
+    """A batch of one in the 16-bucket returns exactly one value, and a NaN
+    feature row yields NaN (not a padded zero-row's projection)."""
+    state = _tiny_state(rng)
+    exe = BucketedExecutor(state, max_batch=16)
+    out = exe.run(np.asarray([40]), np.asarray([[np.nan, 0.0]]))
+    assert out.shape == (1,)
+    assert np.isnan(out[0])
+
+
+# -- backpressure ----------------------------------------------------------
+
+
+def test_full_queue_raises_queue_full_error():
+    """The documented backpressure contract: submit on a full queue raises
+    QueueFullError immediately (no auto-flusher draining it)."""
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)),
+        max_batch=4, max_queue=3, auto_flush=False,
+    )
+    for k in range(3):
+        batcher.submit(0, np.zeros(2))
+    with pytest.raises(QueueFullError):
+        batcher.submit(0, np.zeros(2))
+    # draining frees capacity again
+    assert batcher.drain() == 3
+    batcher.submit(0, np.zeros(2))
+    assert batcher.stats()["n_rejected"] == 1
+
+
+def test_full_queue_raise_does_not_block():
+    """Guard: the rejecting submit must return within the timeout even while
+    the runner is stalled mid-batch (the failure mode this contract exists
+    to prevent is blocking forever)."""
+    release = threading.Event()
+
+    def stalled_runner(m, x, v):
+        release.wait(10.0)
+        return np.zeros(len(m))
+
+    batcher = MicroBatcher(
+        stalled_runner, max_batch=2, max_latency_ms=0.1, max_queue=2,
+        auto_flush=True,
+    )
+    try:
+        # saturate: 2 in-flight via the flusher + keep the queue full
+        outcome = {}
+
+        def producer():
+            errors = 0
+            for _ in range(50):
+                try:
+                    batcher.submit(0, np.zeros(2))
+                except QueueFullError:
+                    errors += 1
+            outcome["rejected"] = errors
+
+        th = threading.Thread(target=producer)
+        th.start()
+        th.join(timeout=5.0)
+        assert not th.is_alive(), "submit blocked instead of raising"
+        assert outcome["rejected"] > 0
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_closed_batcher_rejects():
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)), auto_flush=False
+    )
+    batcher.close()
+    with pytest.raises(RuntimeError):
+        batcher.submit(0, np.zeros(2))
+
+
+def test_close_without_flusher_drains_pending():
+    """close() may never leave a future dangling: with no flusher thread it
+    drains synchronously instead of letting callers time out."""
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)), auto_flush=False
+    )
+    fut = batcher.submit(0, np.zeros(2))
+    batcher.close()
+    assert fut.result(timeout=1.0) == 0.0
+
+
+def test_malformed_row_fails_alone_not_its_batch():
+    """A wrong-shape feature row is rejected at submit (ValueError for that
+    request only); a batch-mate submitted in the same window still runs."""
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)), auto_flush=False, n_predictors=2
+    )
+    good = batcher.submit(0, np.zeros(2))
+    with pytest.raises(ValueError):
+        batcher.submit(0, np.zeros(7))
+    with pytest.raises(ValueError):
+        batcher.submit(0, np.zeros((2, 2)))
+    batcher.flush()
+    assert good.result(timeout=1.0) == 0.0
+    batcher.close()
+
+
+def test_flusher_survives_errors_and_batches_are_width_homogeneous():
+    """The flusher thread must outlive both a failing runner and malformed
+    submissions: a runner exception lands on its batch's futures and later
+    requests still get served; with no declared n_predictors a wrong-width
+    row sinks in a batch OF ITS OWN KIND (never poisoning differently
+    shaped batch-mates in np.stack, never pinning the batcher to a bad
+    first request's width)."""
+    calls = {"n": 0}
+
+    def picky(m, x, v):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient backend fault")
+        if x.shape[1] != 2:
+            raise ValueError(f"state expects 2 predictors, got {x.shape[1]}")
+        return np.full(len(m), 7.0)
+
+    batcher = MicroBatcher(picky, max_batch=4, max_latency_ms=0.5,
+                           auto_flush=True)
+    try:
+        doomed = batcher.submit(0, np.zeros(2))
+        with pytest.raises(RuntimeError, match="transient"):
+            doomed.result(timeout=5.0)
+        # a malformed FIRST-of-its-window row must not brick the batcher:
+        # it fails alone (its own batch), the well-formed row still serves
+        bad = batcher.submit(0, np.zeros(3))
+        ok = batcher.submit(0, np.zeros(2))
+        with pytest.raises(ValueError, match="2 predictors"):
+            bad.result(timeout=5.0)
+        assert ok.result(timeout=5.0) == 7.0
+    finally:
+        batcher.close()
+
+
+def test_min_bucket_above_max_batch_fails_fast(rng):
+    with pytest.raises(ValueError):
+        bucket_sizes(4, min_bucket=8)
+    with pytest.raises(ValueError):
+        BucketedExecutor(_tiny_state(rng), max_batch=4, min_bucket=8)
+
+
+def test_close_with_stalled_runner_fails_queued_futures():
+    """close() may never silently strand a future: when the flusher cannot
+    drain within the timeout (runner stalled mid-batch), the still-queued
+    requests fail with RuntimeError instead of hanging their callers."""
+    release = threading.Event()
+
+    def stalled_runner(m, x, v):
+        release.wait(10.0)
+        return np.zeros(len(m))
+
+    batcher = MicroBatcher(
+        stalled_runner, max_batch=1, max_latency_ms=0.1, max_queue=8,
+        auto_flush=True,
+    )
+    try:
+        in_flight = batcher.submit(0, np.zeros(2))  # taken by the flusher
+        time.sleep(0.05)
+        queued = [batcher.submit(0, np.zeros(2)) for _ in range(3)]
+        batcher.close(timeout=0.2)
+        for fut in queued:
+            with pytest.raises(RuntimeError, match="stalled"):
+                fut.result(timeout=1.0)
+    finally:
+        release.set()
+    # the batch already inside the runner still completes normally
+    assert in_flight.result(timeout=5.0) == 0.0
+
+
+def test_occupancy_is_rows_per_dispatched_slot():
+    """Occupancy counts rows per DISPATCHED bucket slot, so it mirrors the
+    executor's ladder: 2 rows in a min_bucket=8 dispatch is 0.25, not a
+    flattering 2/2 = 1.0 — the metric exists to expose exactly that
+    padding waste."""
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)),
+        max_batch=16, min_bucket=8, auto_flush=False,
+    )
+    for _ in range(2):
+        batcher.submit(0, np.zeros(2))
+    batcher.flush()
+    assert batcher.stats()["batch_occupancy"] == pytest.approx(2 / 8)
+    batcher.close()
+
+    batcher = MicroBatcher(
+        lambda m, x, v: np.zeros(len(m)), max_batch=16, auto_flush=False
+    )
+    for _ in range(3):
+        batcher.submit(0, np.zeros(2))
+    batcher.flush()
+    assert batcher.stats()["batch_occupancy"] == pytest.approx(3 / 4)
+    batcher.close()
+
+
+def test_runner_exception_delivered_to_futures():
+    def boom(m, x, v):
+        raise RuntimeError("backend fault")
+
+    batcher = MicroBatcher(boom, auto_flush=False)
+    fut = batcher.submit(0, np.zeros(2))
+    batcher.flush()
+    with pytest.raises(RuntimeError, match="backend fault"):
+        fut.result(timeout=1.0)
+    batcher.close()
+
+
+def test_latency_deadline_flushes_a_lone_request(rng):
+    """A single query never waits for a batch that isn't coming: the
+    max-latency knob flushes it."""
+    state = _tiny_state(rng)
+    exe = BucketedExecutor(state, max_batch=64)
+    exe.warmup()
+    batcher = MicroBatcher(
+        exe.run, max_batch=64, max_latency_ms=5.0, auto_flush=True
+    )
+    try:
+        fut = batcher.submit(25, np.zeros(2))
+        assert isinstance(fut.result(timeout=5.0), float)
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow
+def test_stress_many_producers_tiny_queue(rng):
+    """Stress: 8 producers hammer a queue of 16 with a slow runner; every
+    submit either resolves or raises QueueFullError — nothing deadlocks,
+    nothing is lost, accounting adds up."""
+    state = _tiny_state(rng)
+    exe = BucketedExecutor(state, max_batch=8)
+    exe.warmup()
+
+    def slow_runner(m, x, v):
+        time.sleep(0.002)
+        return exe.run(m, x, v)
+
+    batcher = MicroBatcher(
+        slow_runner, max_batch=8, max_latency_ms=0.5, max_queue=16,
+        auto_flush=True,
+    )
+    done = np.zeros(8, dtype=np.int64)
+    rejected = np.zeros(8, dtype=np.int64)
+
+    def producer(k):
+        futures = []
+        for _ in range(200):
+            try:
+                futures.append(batcher.submit(25, np.zeros(2)))
+            except QueueFullError:
+                rejected[k] += 1
+        for fut in futures:
+            fut.result(timeout=30.0)
+        done[k] = len(futures)
+
+    threads = [threading.Thread(target=producer, args=(k,)) for k in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60.0)
+    assert not any(th.is_alive() for th in threads), "stress run deadlocked"
+    stats = batcher.stats()
+    batcher.close()
+    assert done.sum() + rejected.sum() == 8 * 200
+    assert stats["n_done"] == done.sum()
+    assert stats["n_rejected"] == rejected.sum()
+    assert exe.misses == 0  # still no query-time compiles under stress
