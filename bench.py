@@ -600,6 +600,120 @@ def _bench_serving(fast: bool):
     }
 
 
+def _bench_resilience(fast: bool):
+    """The fault-tolerance layer's numbers (``resilience`` subsystem):
+
+    - ``resilience_retry_*``        — a transiently failing call retried to
+      success under the shared policy (attempt counts from the plan's own
+      ledger, zero-wall-clock backoff).
+    - ``serving_p50_degraded_*``    — quote latency with the service in
+      DEGRADED mode (a quarantined ingest month) vs healthy, on the same
+      warmed state: degradation must cost visibility, not latency.
+    - ``resume_stage_s``            — checkpoint-resume wall-clock: the
+      pipeline crashed (injected) at each reporting stage, then resumed;
+      each entry is the resume run's wall vs the full run's. The pipeline
+      shapes are intentionally small — the section measures the MACHINERY
+      (what fraction of a run a resume pays), not device throughput.
+
+    FMRP_BENCH_RESIL=0 skips."""
+    if os.environ.get("FMRP_BENCH_RESIL", "1") == "0":
+        return {}
+    import tempfile
+
+    from fm_returnprediction_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        RetryPolicy,
+        call_with_retry,
+        fault_site,
+    )
+
+    out = {}
+
+    # -- retry counts ------------------------------------------------------
+    with FaultPlan({"bench.flaky": FaultSpec(times=2)}) as plan:
+        call_with_retry(
+            lambda: fault_site("bench.flaky") or True,
+            RetryPolicy(max_attempts=4, backoff_s=0.0),
+            sleep=lambda s: None,
+        )
+    out["resilience_retry_attempts"] = int(plan.calls["bench.flaky"])
+    out["resilience_retry_faults_injected"] = int(plan.fired["bench.flaky"])
+
+    # -- degraded-mode quote latency vs healthy ----------------------------
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    t, n, p = (48, 80, 5) if fast else (120, 400, 5)
+    n_queries = 200 if fast else 600
+    rng = np.random.default_rng(2016)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(
+        y, x, mask, window=t // 2, min_periods=t // 4
+    )
+    months = rng.integers(t * 3 // 4, t, n_queries)
+    firms = rng.integers(0, n, n_queries)
+
+    def p50(svc):
+        # per-phase samples, NOT svc.stats()["p50_ms"]: the batcher's
+        # latency ring is cumulative, so the post-quarantine read there
+        # would pool healthy samples into the degraded median and mask
+        # exactly the regression this comparison exists to catch
+        lat = np.empty(n_queries)
+        for q in range(n_queries):
+            t0 = time.perf_counter()
+            svc.query(int(months[q]), x[months[q], firms[q]])
+            lat[q] = time.perf_counter() - t0
+        return float(np.percentile(lat, 50) * 1e3)
+
+    with ERService(state, max_batch=64, max_latency_ms=0.5, warm=True) as svc:
+        healthy = p50(svc)
+        # poison an ingest: all-NaN cross-section for the next month →
+        # quarantined, service keeps quoting from last-known-good
+        bad_x = np.full((n, p), np.nan, dtype=np.float32)
+        bad_month = np.datetime64("2070-01-31", "ns")
+        accepted = svc.ingest_month(
+            np.full(n, np.nan), bad_x, np.ones(n, bool), bad_month
+        )
+        degraded = p50(svc)
+        stats = svc.stats()
+    out["serving_p50_healthy_ms"] = round(healthy, 3)
+    out["serving_p50_degraded_ms"] = round(degraded, 3)
+    out["serving_degraded_mode"] = bool(stats["degraded"]) and not accepted
+    out["serving_quarantined_months"] = len(stats["quarantined_months"])
+
+    # -- checkpoint-resume wall-clock savings ------------------------------
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    cfg = SyntheticConfig(*( (20, 36) if fast else (40, 72) ))
+    stages = ("table_1", "table_2", "decile_table", "serving_state")
+    resume_s = {}
+    with tempfile.TemporaryDirectory() as root:
+        kw = dict(
+            synthetic=True, synthetic_config=cfg, make_figure=False,
+            make_deciles=True, make_serving=True, compile_pdf=False,
+        )
+        t0 = time.perf_counter()
+        run_pipeline(**kw, checkpoint_dir=os.path.join(root, "warmref"))
+        full = time.perf_counter() - t0
+        for stage in stages:
+            ck = os.path.join(root, f"crash_{stage}")
+            try:
+                with FaultPlan({f"pipeline.{stage}": FaultSpec()}):
+                    run_pipeline(**kw, checkpoint_dir=ck)
+            except OSError:
+                pass  # the injected crash
+            t0 = time.perf_counter()
+            run_pipeline(**kw, checkpoint_dir=ck)  # resume
+            resume_s[stage] = round(time.perf_counter() - t0, 3)
+    out["resilience_pipeline_full_s"] = round(full, 3)
+    out["resilience_resume_stage_s"] = resume_s
+    return out
+
+
 def _jax_cache_stats() -> dict:
     """Entry count + bytes of the persistent XLA compilation cache
     (``_cache/jax``) — the artifact-side evidence for whether the split
@@ -876,7 +990,8 @@ def main() -> None:
     # Every section has an off switch so a short accelerator window can be
     # spent on exactly the missing measurement (the tunnel comes and goes;
     # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
-    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _MESH8 = 0.
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _RESIL /
+    # _MESH8 = 0.
     # Default: all on except _MESH8, which defaults on only with a live
     # accelerator.
     sections = []
@@ -891,6 +1006,7 @@ def main() -> None:
         sections.append(_bench_pallas)
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
+    sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_fuseprobe)  # TPU-only, gated in-section
     sections.append(_bench_mesh8)  # _MESH8 gate handled in-section
 

@@ -244,31 +244,47 @@ def _wrds_query(
     retries: int = 3,
     backoff_s: float = 5.0,
 ) -> pd.DataFrame:
-    """Run one WRDS query with retry/backoff.
+    """Run one WRDS query under the shared retry policy.
 
     The WRDS Postgres connection is the pipeline's only network boundary
     (``src/pull_crsp.py:238``); the reference has no failure handling there
     at all — a transient drop loses a multi-minute pull. Each attempt opens
-    a fresh connection; failures back off exponentially."""
-    import time
+    a fresh connection; failures back off exponentially with deterministic
+    jitter (``resilience.retry``). The allowlist is every ``Exception`` —
+    the wrds client wraps transport errors in assorted library types, and
+    the only non-retryable failures here (bad SQL, bad credentials) exhaust
+    the budget in seconds against a healthy server.
 
+    Fault site ``wrds.query`` fires before each connection attempt, so the
+    chaos suite drives this exact loop without network access."""
     import wrds  # deferred: optional dependency, needs network
 
-    last_err = None
-    for attempt in range(retries + 1):
-        if attempt:
-            time.sleep(backoff_s * (2 ** (attempt - 1)))
-            print(f"WRDS retry {attempt}/{retries} after: {last_err}")
+    from fm_returnprediction_tpu.resilience.faults import fault_site
+    from fm_returnprediction_tpu.resilience.retry import (
+        RetryPolicy,
+        call_with_retry,
+    )
+
+    def attempt() -> pd.DataFrame:
+        fault_site("wrds.query")
         db = None
         try:
             db = wrds.Connection(wrds_username=wrds_username)
             return db.raw_sql(sql, date_cols=date_cols)
-        except Exception as err:  # noqa: BLE001 — network layer, retry all
-            last_err = err
         finally:
             if db is not None:
                 db.close()
-    raise RuntimeError(f"WRDS query failed after {retries + 1} attempts") from last_err
+
+    return call_with_retry(
+        attempt,
+        RetryPolicy(
+            max_attempts=retries + 1,
+            backoff_s=backoff_s,
+            retry_on=(Exception,),
+        ),
+        label="WRDS query",
+        on_retry=lambda n, err: print(f"WRDS retry {n}/{retries} after: {err}"),
+    )
 
 
 def pull_CRSP_stock(

@@ -82,6 +82,22 @@ def resolve_dtype() -> np.dtype:
 RAW_FILE_NAMES = dict(FILE_NAMES)  # canonical mapping lives in data.synthetic
 
 
+def _pipeline_fingerprint(panel, dtype, salt: str = "") -> str:
+    """Checkpoint key for the reporting stages: the panel's identity axes
+    (months, ids, variables, shape) + compute dtype + a data-provenance
+    salt (raw-cache fingerprint, or the synthetic config). Cheap — no pull
+    of the (T, N, P) values — yet any re-pull/resize/reshape invalidates."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(f"{np.dtype(dtype).str}|{salt}|{tuple(panel.values.shape)}|".encode())
+    h.update(np.asarray(panel.months).astype("datetime64[ns]")
+             .astype(np.int64).tobytes())
+    h.update(np.ascontiguousarray(panel.ids).tobytes())
+    h.update("|".join(panel.var_names).encode())
+    return h.hexdigest()
+
+
 @dataclasses.dataclass
 class PipelineResult:
     panel: DensePanel
@@ -287,11 +303,23 @@ def run_pipeline(
     make_serving: bool = True,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
+    checkpoint_dir=None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
 
     ``dtype=None`` resolves the DTYPE setting (float32 on TPU by default;
-    float64 requires jax_enable_x64 and is the CPU parity configuration)."""
+    float64 requires jax_enable_x64 and is the CPU parity configuration).
+
+    ``checkpoint_dir`` arms per-stage checkpoint-resume
+    (``resilience.StageCheckpointer``): each reporting stage (Table 1,
+    Table 2, deciles, serving state) persists on completion, keyed by a
+    panel+data fingerprint, so a rerun after a crash loads the completed
+    stages and recomputes only from the failure point — at real shape each
+    skipped FM sweep is tens of seconds of device compute. Stale or
+    corrupt stage artifacts (checksum-verified) silently degrade to
+    recompute. The panel build itself is covered by the prepared-inputs
+    checkpoint (``data.prepared``); Figure 1 is not checkpointed (a
+    matplotlib artifact whose cross-sections ride the shared sweep)."""
     if dtype is None:
         dtype = resolve_dtype()
     timer = StageTimer()
@@ -344,27 +372,87 @@ def run_pipeline(
         subset_masks = compute_subset_masks(panel)
         stage_sync(subset_masks)
 
+    from fm_returnprediction_tpu.resilience.faults import fault_site
+
+    ckpt = None
+    if checkpoint_dir is not None:
+        # Single-process only: on a pod, one process loading a stage while
+        # a peer recomputes it would desynchronize the collective sequence
+        # (the same hazard the engine's _consensus exists for). The
+        # multi-host resume story is the task graph + prepared checkpoint.
+        from fm_returnprediction_tpu.parallel.multihost import (
+            distributed_client_active,
+        )
+
+        if distributed_client_active():
+            import jax
+            import warnings
+
+            if jax.process_count() > 1:
+                warnings.warn(
+                    "checkpoint_dir ignored on multi-process runs",
+                    stacklevel=2,
+                )
+                checkpoint_dir = None
+    if checkpoint_dir is not None:
+        import json as _json
+
+        from fm_returnprediction_tpu.resilience.checkpoint import (
+            StageCheckpointer,
+        )
+
+        if synthetic:
+            cfg = synthetic_config or SyntheticConfig()
+            salt = _json.dumps(vars(cfg), sort_keys=True, default=str)
+        else:
+            from fm_returnprediction_tpu.data.prepared import raw_fingerprint
+
+            salt = raw_fingerprint(raw_data_dir, dtype)
+        ckpt = StageCheckpointer(
+            checkpoint_dir, _pipeline_fingerprint(panel, dtype, salt)
+        )
+
+    def _frame_stage(name, compute):
+        """One checkpointable DataFrame stage. The fault site lets the
+        chaos suite crash the pipeline AT this stage; with no checkpoint
+        dir the stage just computes (site still armed)."""
+
+        def compute_with_fault():
+            fault_site(f"pipeline.{name}")
+            return compute()
+
+        if ckpt is None:
+            return compute_with_fault()
+        return ckpt.frame(name, compute_with_fault)
+
     with timer.stage("table_1"):
-        table_1 = build_table_1(panel, subset_masks, factors_dict)
+        table_1 = _frame_stage(
+            "table_1", lambda: build_table_1(panel, subset_masks, factors_dict)
+        )
 
     with timer.stage("table_2"):
-        table_2 = build_table_2(panel, subset_masks, factors_dict, mesh=mesh)
+        table_2 = _frame_stage(
+            "table_2",
+            lambda: build_table_2(panel, subset_masks, factors_dict, mesh=mesh),
+        )
 
     # The figure and decile paths share the same per-subset batched OLS on
     # the figure's 5-variable set — ONE fused program computes OLS, rolling
     # means and decile sorts for every subset, and one device_get pulls all
     # of it (per-subset dispatches + scalar pulls dominate the reporting
-    # wall-clock on remote TPU backends).
+    # wall-clock on remote TPU backends). A resumed run whose decile table
+    # is already checkpointed drops the decile legs of the sweep.
+    decile_fresh = make_deciles and not (ckpt and ckpt.completed("decile_table"))
     cs_cache = {}
-    if make_figure or make_deciles:
+    if make_figure or decile_fresh:
         from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
 
         with timer.stage("figure_cs"):
-            needed = set(subset_masks) if make_deciles else {
+            needed = set(subset_masks) if decile_fresh else {
                 "All stocks", "Large stocks"
             }
             cs_cache = subset_sweep(
-                panel, subset_masks, list(needed), make_deciles=make_deciles
+                panel, subset_masks, list(needed), make_deciles=decile_fresh
             )
 
     figure_1 = None
@@ -375,24 +463,46 @@ def run_pipeline(
     decile_table = None
     if make_deciles:
         with timer.stage("decile_table"):
-            decile_table = build_decile_table(panel, subset_masks, cs_cache=cs_cache)
+            # on a checkpoint hit the (possibly sweep-less) cs_cache is
+            # irrelevant; on a corrupt-checkpoint rebuild the builder
+            # falls back to per-subset compute for missing entries
+            decile_table = _frame_stage(
+                "decile_table",
+                lambda: build_decile_table(
+                    panel, subset_masks, cs_cache=cs_cache
+                ),
+            )
 
     serving_state = None
     if make_serving and "All stocks" in subset_masks:
         from fm_returnprediction_tpu.reporting.figure1 import SubsetSweepEntry
         from fm_returnprediction_tpu.serving.state import (
+            ServingState,
             build_serving_state_from_panel,
         )
 
         with timer.stage("serving_state"):
-            # reuse the sweep's batched OLS on the figure variables — the
-            # serving fit shares the decile route's cross-sections instead
-            # of re-running them
-            entry = cs_cache.get("All stocks")
-            cs = entry.cs if isinstance(entry, SubsetSweepEntry) else entry
-            serving_state = build_serving_state_from_panel(
-                panel, subset_masks["All stocks"], cs=cs
-            )
+            def compute_serving():
+                fault_site("pipeline.serving_state")
+                # reuse the sweep's batched OLS on the figure variables —
+                # the serving fit shares the decile route's cross-sections
+                # instead of re-running them (cs=None → self-contained fit)
+                entry = cs_cache.get("All stocks")
+                cs = entry.cs if isinstance(entry, SubsetSweepEntry) else entry
+                return build_serving_state_from_panel(
+                    panel, subset_masks["All stocks"], cs=cs
+                )
+
+            if ckpt is None:
+                serving_state = compute_serving()
+            else:
+                serving_state = ckpt.stage(
+                    "serving_state",
+                    compute_serving,
+                    saver=lambda st, path: st.save(path),
+                    loader=ServingState.load,
+                    suffix=".npz",
+                )
 
     bootstrap_table = None
     if make_bootstrap:
@@ -465,6 +575,11 @@ def _main() -> None:
         "--bootstrap", type=int, default=0, metavar="B",
         help="also build the bootstrap-SE table with B replicates",
     )
+    parser.add_argument(
+        "--checkpoint-dir", default=None,
+        help="per-stage checkpoint directory: a rerun after a crash "
+             "resumes at the last completed reporting stage",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -486,6 +601,7 @@ def _main() -> None:
         synthetic_config=cfg if args.synthetic else None,
         make_bootstrap=args.bootstrap > 0,
         bootstrap_replicates=args.bootstrap or 10_000,
+        checkpoint_dir=args.checkpoint_dir,
     )
     print(result.table_1.round(3).to_string())
     print()

@@ -1,0 +1,63 @@
+"""Fault-tolerance layer: retries, timeouts, checkpoint-resume, degraded-
+mode serving, and deterministic fault injection.
+
+The production-scale stance (ROADMAP north star): a transient failure
+anywhere — a flaky WRDS pull, a torn ``.npz``, a stalled serving runner,
+one failed taskgraph node — costs a retry, a quarantine, or one stage of
+recompute, never the whole run. Four pieces:
+
+- :mod:`.retry`     — ``RetryPolicy`` + ``call_with_retry`` (exponential
+  backoff, deterministic jitter, exception allowlist); applied to the
+  WRDS pull and per-``Task`` actions.
+- :mod:`.checkpoint`— ``StageCheckpointer``: fingerprint-keyed,
+  checksum-verified per-stage artifacts so ``run_pipeline`` resumes at
+  the last completed stage.
+- :mod:`.faults`    — ``FaultPlan``/``fault_site``: deterministic chaos
+  injection at named production sites (free when inactive).
+- :mod:`.errors`    — the typed failure taxonomy the recovery paths
+  dispatch on.
+
+Degraded-mode serving lives with the service itself
+(``serving.service.ERService.ingest_month``); the engine-side retry/
+timeout/keep-going semantics live in ``taskgraph.engine``.
+"""
+
+from fm_returnprediction_tpu.resilience.errors import (
+    CorruptArtifactError,
+    DispatchTimeoutError,
+    IngestRejectedError,
+    InjectedFault,
+    ResilienceError,
+    RetryExhaustedError,
+    TaskTimeoutError,
+)
+from fm_returnprediction_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    fault_site,
+    truncate_file,
+)
+from fm_returnprediction_tpu.resilience.retry import (
+    RetryPolicy,
+    call_with_retry,
+    retrying,
+)
+from fm_returnprediction_tpu.resilience.checkpoint import StageCheckpointer
+
+__all__ = [
+    "ResilienceError",
+    "RetryExhaustedError",
+    "TaskTimeoutError",
+    "DispatchTimeoutError",
+    "CorruptArtifactError",
+    "IngestRejectedError",
+    "InjectedFault",
+    "FaultPlan",
+    "FaultSpec",
+    "fault_site",
+    "truncate_file",
+    "RetryPolicy",
+    "call_with_retry",
+    "retrying",
+    "StageCheckpointer",
+]

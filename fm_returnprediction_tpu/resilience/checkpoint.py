@@ -1,0 +1,165 @@
+"""Per-stage pipeline checkpoints — resume a crashed run, don't refit it.
+
+The prepared-inputs checkpoint (``data.prepared``) already covers the host
+ingest; this covers the REPORTING stages: ``run_pipeline`` registers each
+completed stage artifact (Table 1, Table 2, decile table, serving state)
+here, and a rerun after a crash loads the completed stages and recomputes
+only from the failure point on. At real shape each FM sweep stage is tens
+of seconds of device compute — a crash in ``serving_state`` must not
+re-pay ``table_2``.
+
+Contract:
+
+- One directory per run family, keyed by a FINGERPRINT (panel identity +
+  raw-cache fingerprint + flags). A mismatched fingerprint invalidates
+  every recorded stage — a checkpoint can never leak across datasets.
+- Every artifact is written atomically (tmp + ``os.replace``) and recorded
+  in the manifest with its file sha256. Load verifies the hash; any
+  mismatch or unreadable artifact degrades to "recompute this stage" with
+  a warning — checkpoints are an accelerant, never a correctness gate
+  (same stance as ``data.prepared``).
+- The manifest itself is written last and atomically, so a crash mid-save
+  leaves the previous consistent manifest, never a half-recorded one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import warnings
+from pathlib import Path
+from typing import Callable, Optional
+
+from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
+
+__all__ = ["StageCheckpointer"]
+
+_MANIFEST = "manifest.json"
+
+
+def _file_sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class StageCheckpointer:
+    """Fingerprint-keyed, checksum-verified stage artifact store."""
+
+    def __init__(self, checkpoint_dir, fingerprint: str):
+        self.dir = Path(checkpoint_dir)
+        self.fingerprint = str(fingerprint)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._stages = {}
+        manifest = self.dir / _MANIFEST
+        try:
+            meta = json.loads(manifest.read_text())
+            if meta.get("fingerprint") == self.fingerprint:
+                self._stages = dict(meta.get("stages", {}))
+        except (OSError, ValueError):
+            pass  # absent or torn manifest → start empty
+
+    # -- manifest ----------------------------------------------------------
+
+    def _write_manifest(self) -> None:
+        tmp = self.dir / f".{_MANIFEST}.tmp-{os.getpid()}"
+        tmp.write_text(json.dumps(
+            {"fingerprint": self.fingerprint, "stages": self._stages},
+            indent=2, sort_keys=True,
+        ))
+        os.replace(tmp, self.dir / _MANIFEST)
+
+    def completed(self, name: str) -> bool:
+        """Cheap probe: stage recorded and its file present (content is
+        verified at load time)."""
+        rec = self._stages.get(name)
+        return rec is not None and (self.dir / rec["file"]).exists()
+
+    def stages(self) -> tuple:
+        return tuple(sorted(self._stages))
+
+    # -- generic stage -----------------------------------------------------
+
+    def stage(
+        self,
+        name: str,
+        compute: Callable[[], object],
+        *,
+        saver: Callable[[object, Path], None],
+        loader: Callable[[Path], object],
+        suffix: str,
+    ):
+        """Load stage ``name`` if recorded and intact, else compute, persist
+        atomically, record, and return. The compute path runs OUTSIDE any
+        lock or transaction — a crash inside it leaves prior stages
+        recorded and this one absent, which is exactly resume-at-last-
+        completed-stage."""
+        got = self._load(name, loader)
+        if got is not None:
+            return got
+        obj = compute()
+        try:
+            self._save(name, obj, saver, suffix)
+        except OSError as exc:  # read-only dir, disk full: keep the result
+            warnings.warn(
+                f"stage checkpoint {name!r} not written: {exc!r}",
+                stacklevel=2,
+            )
+        return obj
+
+    def _load(self, name: str, loader: Callable[[Path], object]):
+        rec = self._stages.get(name)
+        if rec is None:
+            return None
+        path = self.dir / rec["file"]
+        try:
+            if not path.exists():
+                raise CorruptArtifactError(f"checkpoint file {path} missing")
+            if _file_sha256(path) != rec["sha256"]:
+                raise CorruptArtifactError(
+                    f"checkpoint {name!r} failed its content hash"
+                )
+            return loader(path)
+        except Exception as exc:  # noqa: BLE001 — any unreadable artifact rebuilds
+            warnings.warn(
+                f"stage checkpoint {name!r} unreadable, recomputing: {exc!r}",
+                stacklevel=3,
+            )
+            # drop the record so completed() stops advertising it
+            self._stages.pop(name, None)
+            try:
+                self._write_manifest()
+            except OSError:
+                pass
+            return None
+
+    def _save(self, name, obj, saver, suffix) -> None:
+        final = self.dir / f"{name}{suffix}"
+        tmp = self.dir / f".{name}.tmp-{os.getpid()}{suffix}"
+        try:
+            saver(obj, tmp)
+            os.replace(tmp, final)
+        finally:
+            tmp.unlink(missing_ok=True)
+        self._stages[name] = {
+            "file": final.name, "sha256": _file_sha256(final)
+        }
+        self._write_manifest()
+
+    # -- pandas convenience ------------------------------------------------
+
+    def frame(self, name: str, compute: Callable[[], object]):
+        """DataFrame stage: pickle on disk (tables carry MultiIndex shapes
+        parquet cannot), integrity guarded by the manifest's file sha256 —
+        the same no-silent-corruption contract as the npz bundles."""
+        import pandas as pd
+
+        return self.stage(
+            name, compute,
+            saver=lambda df, path: pd.to_pickle(df, path),
+            loader=pd.read_pickle,
+            suffix=".pkl",
+        )

@@ -1,0 +1,66 @@
+"""Typed failure taxonomy for the resilience layer.
+
+One exception class per recovery path, so a handler can catch exactly the
+failure it knows how to recover from — retry wrappers catch
+``InjectedFault``/``OSError`` allowlists, the checkpoint resume path
+catches ``CorruptArtifactError`` and rebuilds, the microbatcher delivers
+``DispatchTimeoutError`` to the in-flight bucket's futures, and the task
+engine records ``TaskTimeoutError``/``RetryExhaustedError`` in its sqlite
+failure log. Nothing here imports anything — this module sits at the
+bottom of the dependency graph so ``utils.cache`` and ``taskgraph.engine``
+can both name these types without a cycle.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ResilienceError",
+    "RetryExhaustedError",
+    "TaskTimeoutError",
+    "DispatchTimeoutError",
+    "CorruptArtifactError",
+    "IngestRejectedError",
+    "InjectedFault",
+]
+
+
+class ResilienceError(RuntimeError):
+    """Base class for failures the resilience layer raises itself."""
+
+
+class RetryExhaustedError(ResilienceError):
+    """A retried call failed on every attempt; ``__cause__`` is the last
+    underlying error."""
+
+
+class TaskTimeoutError(ResilienceError):
+    """A task action exceeded its ``timeout_s`` wall-clock budget."""
+
+
+class DispatchTimeoutError(ResilienceError):
+    """A serving bucket dispatch exceeded the executor's watchdog budget.
+
+    Delivered to the in-flight batch's futures so the microbatcher keeps
+    draining instead of hanging behind a stalled runner."""
+
+
+class CorruptArtifactError(ResilienceError):
+    """A persisted artifact failed its content checksum (or is structurally
+    unreadable). The resume path catches this and REBUILDS the artifact
+    instead of crashing with a cryptic numpy/zipfile error."""
+
+
+class IngestRejectedError(ResilienceError):
+    """An ingest month failed validation (NaN cross-section, shape
+    mismatch, merge divergence beyond tolerance). The serving front-end
+    quarantines the month and keeps quoting from the last-known-good
+    state."""
+
+
+class InjectedFault(OSError):
+    """The default exception a ``FaultPlan`` raises at a fault site.
+
+    Subclasses ``OSError`` so injected faults exercise the same handler
+    paths a real transient IO error would (retry allowlists include
+    ``OSError`` by default).
+    """

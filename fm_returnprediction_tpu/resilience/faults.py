@@ -1,0 +1,180 @@
+"""Deterministic fault injection — the chaos harness behind the chaos tests.
+
+Production code is instrumented with NAMED FAULT SITES::
+
+    from fm_returnprediction_tpu.resilience.faults import fault_site
+    ...
+    fault_site("wrds.query")                 # may raise / stall
+    rows = fault_site("serving.ingest", payload=rows)   # may poison
+    fault_site("cache.save_array_bundle", path=written) # may corrupt
+
+With no :class:`FaultPlan` installed, ``fault_site`` is ONE module-global
+read and an immediate return — no locks, no clocks, no randomness — so the
+hooks are free on the serving hot path (pinned by the bench's p50 numbers).
+
+A test (or the bench's resilience section) installs a plan::
+
+    with FaultPlan({"wrds.query": FaultSpec(times=2, exc=ConnectionError)}):
+        pull_CRSP_stock(...)        # first two connection attempts fail
+
+Determinism: a spec triggers by CALL COUNT (``skip`` then ``times``), or by
+a seeded counter-keyed hash when ``probability`` is set — never by wall
+clock or global RNG state, so a failing chaos test replays exactly. The
+plan records every site visit (``calls``) and every triggered fault
+(``fired``) for assertions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from collections import Counter
+from pathlib import Path
+from typing import Callable, Dict, Optional, Union
+
+from fm_returnprediction_tpu.resilience.errors import InjectedFault
+
+__all__ = ["FaultSpec", "FaultPlan", "fault_site", "truncate_file"]
+
+# The installed plan. Plain module global on purpose: the inactive-path
+# cost must be one read. Installation is guarded by _INSTALL_LOCK; per-site
+# counters are guarded by the plan's own lock.
+_ACTIVE: Optional["FaultPlan"] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def truncate_file(path: Union[str, Path]) -> None:
+    """Default corruption: keep the first half of the file — the torn-write
+    shape a crash mid-``write()`` leaves behind."""
+    path = Path(path)
+    data = path.read_bytes()
+    path.write_bytes(data[: max(len(data) // 2, 1)])
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """What happens when a named site triggers.
+
+    times       : trigger on this many calls, then heal (-1 = every call).
+    skip        : let this many calls through untouched first.
+    probability : instead of count-gating, trigger each eligible call with
+                  this probability, decided by a seeded hash of
+                  (plan seed, site, call number) — deterministic replay.
+    exc         : exception to raise — a type, an instance, or a zero-arg
+                  factory. ``None`` with no other effect raises
+                  :class:`InjectedFault`.
+    delay_s     : stall this long BEFORE any other effect (slow/stalled
+                  runner; a watchdogged caller times out mid-stall).
+    corrupt     : called with the site's ``path`` operand (artifact
+                  corruption; ``True`` selects :func:`truncate_file`).
+    mutate      : called with the site's ``payload`` operand, returns the
+                  poisoned payload (e.g. NaN rows into an ingest).
+    """
+
+    times: int = 1
+    skip: int = 0
+    probability: Optional[float] = None
+    exc: Union[None, BaseException, type, Callable[[], BaseException]] = None
+    delay_s: float = 0.0
+    corrupt: Union[None, bool, Callable[[Path], None]] = None
+    mutate: Optional[Callable] = None
+
+    def _make_exc(self, site: str) -> BaseException:
+        if self.exc is None:
+            return InjectedFault(f"injected fault at {site!r}")
+        if isinstance(self.exc, BaseException):
+            return self.exc
+        made = self.exc()  # type or factory
+        if not isinstance(made, BaseException):
+            raise TypeError(f"FaultSpec.exc for {site!r} produced {made!r}")
+        return made
+
+
+class FaultPlan:
+    """A set of site → :class:`FaultSpec` rules, installed as a context.
+
+    Plans nest: entering a plan shadows the previously installed one and
+    ``__exit__`` restores it. Counters (``calls`` — every visit to an
+    instrumented site, ``fired`` — visits that triggered) live on the plan,
+    so a test asserts exactly what its chaos did.
+    """
+
+    def __init__(self, specs: Dict[str, FaultSpec], seed: int = 0):
+        for site, spec in specs.items():
+            if not isinstance(spec, FaultSpec):
+                raise TypeError(f"spec for {site!r} must be a FaultSpec")
+        self.specs = dict(specs)
+        self.seed = int(seed)
+        self.calls: Counter = Counter()
+        self.fired: Counter = Counter()
+        self._lock = threading.Lock()
+        self._prev: Optional[FaultPlan] = None
+
+    # -- installation ------------------------------------------------------
+
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        with _INSTALL_LOCK:
+            self._prev = _ACTIVE
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        with _INSTALL_LOCK:
+            _ACTIVE = self._prev
+            self._prev = None
+
+    # -- trigger decision --------------------------------------------------
+
+    def _should_fire(self, spec: FaultSpec, call_no: int, site: str) -> bool:
+        """``call_no`` is 1-based. Count-gated unless ``probability`` is
+        set; either way a pure function of (plan, site, call_no)."""
+        if call_no <= spec.skip:
+            return False
+        if spec.probability is not None:
+            digest = hashlib.sha256(
+                f"{self.seed}|{site}|{call_no}".encode()
+            ).digest()
+            frac = int.from_bytes(digest[:8], "big") / 2**64
+            return frac < spec.probability
+        if spec.times < 0:
+            return True
+        return call_no - spec.skip <= spec.times
+
+    def _apply(self, site: str, payload, path):
+        spec = self.specs.get(site)
+        with self._lock:
+            # count every visit, matched or not, so tests can assert a site
+            # was exercised even when its spec belongs to another plan run
+            self.calls[site] += 1
+            call_no = self.calls[site]
+            if spec is None or not self._should_fire(spec, call_no, site):
+                return payload
+            self.fired[site] += 1
+        # effects OUTSIDE the lock: a delay must not serialize other sites
+        if spec.delay_s:
+            time.sleep(spec.delay_s)
+        if spec.corrupt is not None and path is not None:
+            corruptor = truncate_file if spec.corrupt is True else spec.corrupt
+            corruptor(Path(path))
+        if spec.mutate is not None:
+            payload = spec.mutate(payload)
+            if spec.exc is None:
+                return payload  # a pure poisoning site returns, not raises
+        if spec.exc is not None or (spec.mutate is None and spec.corrupt is None
+                                    and not spec.delay_s):
+            raise spec._make_exc(site)
+        return payload
+
+
+def fault_site(site: str, payload=None, path=None):
+    """The production-side hook. Returns ``payload`` (possibly poisoned by
+    the active plan); may raise or stall per the plan's spec. With no plan
+    installed this is one global read — free on hot paths."""
+    plan = _ACTIVE
+    if plan is None:
+        return payload
+    return plan._apply(site, payload, path)

@@ -94,6 +94,12 @@ class MicroBatcher:
         self._n_done = 0
         self._n_rejected = 0
         self._n_batches = 0
+        # failure visibility: batches whose runner RAISED (e.g. a stalled
+        # dispatch failed by the executor watchdog) and the requests that
+        # rode them — the error lands on each request's future, the flusher
+        # survives, and these counters make the event observable in stats()
+        self._n_failed_batches = 0
+        self._n_failed = 0
         self._thread: Optional[threading.Thread] = None
         if auto_flush:
             self._thread = threading.Thread(
@@ -209,6 +215,9 @@ class MicroBatcher:
             valid = np.ones(len(batch), dtype=bool)
             out = self._runner(month_idx, x, valid)
         except Exception as exc:  # noqa: BLE001 - delivered per-request
+            with self._cv:
+                self._n_failed_batches += 1
+                self._n_failed += len(batch)
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(exc)
@@ -271,6 +280,8 @@ class MicroBatcher:
                 "n_done": self._n_done,
                 "n_rejected": self._n_rejected,
                 "n_batches": self._n_batches,
+                "n_failed": self._n_failed,
+                "n_failed_batches": self._n_failed_batches,
             }
         out["p50_ms"] = float(np.percentile(lat, 50) * 1e3) if len(lat) else None
         out["p99_ms"] = float(np.percentile(lat, 99) * 1e3) if len(lat) else None

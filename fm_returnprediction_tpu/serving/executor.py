@@ -38,6 +38,9 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from fm_returnprediction_tpu.resilience.errors import DispatchTimeoutError
+from fm_returnprediction_tpu.resilience.faults import fault_site
+
 __all__ = ["bucket_sizes", "bucket_for", "BucketedExecutor"]
 
 
@@ -105,11 +108,24 @@ class BucketedExecutor:
     first (zero after ``warmup()``); ``compiles`` — total programs built.
     """
 
-    def __init__(self, state, max_batch: int = 256, min_bucket: int = 1):
+    def __init__(
+        self,
+        state,
+        max_batch: int = 256,
+        min_bucket: int = 1,
+        dispatch_timeout_s: Optional[float] = None,
+    ):
         import jax.numpy as jnp
 
         self.max_batch = int(max_batch)
         self.min_bucket = int(min_bucket)
+        # watchdog budget per dispatch: a runner stalled inside a device
+        # call fails its OWN bucket (DispatchTimeoutError on that batch's
+        # futures) instead of hanging the microbatcher's flusher thread
+        # forever. None (default) = direct dispatch, zero added machinery
+        # on the hot path.
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self.timeouts = 0  # dispatches failed by the watchdog
         bucket_sizes(self.max_batch, self.min_bucket)  # fail fast, not in run()
         self._dtype = state.dtype
         # one device push of the fitted arrays, shared by every bucket
@@ -190,5 +206,44 @@ class BucketedExecutor:
             valid = np.concatenate([valid, np.zeros(pad, bool)])
         # month_idx 0 on padding rows is a safe gather; valid=False makes
         # the row an exact no-op (masking discipline).
-        out = exe(*self._state_args, month_idx, x, valid)
+        out = self._dispatch(exe, bucket, month_idx, x, valid)
         return np.asarray(out)[:b]
+
+    def _dispatch(self, exe, bucket: int, month_idx, x, valid):
+        """One device dispatch, optionally watchdogged.
+
+        The ``serving.dispatch`` fault site lives INSIDE the dispatched
+        call so an injected stall is exactly what a wedged runner looks
+        like to the watchdog. With no timeout configured and no FaultPlan
+        installed this adds one global read to the hot path — nothing the
+        bench p50 can see."""
+
+        def call():
+            fault_site("serving.dispatch")
+            return exe(*self._state_args, month_idx, x, valid)
+
+        if self.dispatch_timeout_s is None:
+            return call()
+        result: Dict[str, object] = {}
+
+        def target() -> None:
+            try:
+                result["out"] = call()
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                result["err"] = exc
+
+        worker = threading.Thread(
+            target=target, daemon=True, name="fmrp-serving-dispatch"
+        )
+        worker.start()
+        worker.join(self.dispatch_timeout_s)
+        if worker.is_alive():
+            with self._lock:
+                self.timeouts += 1
+            raise DispatchTimeoutError(
+                f"bucket {bucket} dispatch exceeded "
+                f"{self.dispatch_timeout_s}s (runner stalled; worker abandoned)"
+            )
+        if "err" in result:
+            raise result["err"]  # type: ignore[misc]
+        return result["out"]

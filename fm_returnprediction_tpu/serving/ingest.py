@@ -40,7 +40,45 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["ingest_month"]
+from fm_returnprediction_tpu.resilience.errors import IngestRejectedError
+
+__all__ = ["ingest_month", "validate_cross_section"]
+
+
+def validate_cross_section(state, y_new, x_new, mask_new):
+    """Gate a candidate ingest month before it can touch the state.
+
+    Returns the coerced ``(y, x, mask)`` numpy triple or raises
+    :class:`IngestRejectedError` for the poisoned shapes the degraded-mode
+    front-end quarantines: mismatched lengths, wrong predictor width, a
+    cross-section whose masked rows are ALL-non-finite (a NaN flood is an
+    upstream data fault, not a thin month — thin months are legal and stay
+    quotable), and infinite realized returns (NaN y is the start-of-month
+    contract; ±inf is corruption).
+    """
+    x = np.asarray(x_new, dtype=state.dtype)
+    y = np.asarray(y_new, dtype=state.dtype)
+    mask = np.asarray(mask_new, dtype=bool)
+    if x.ndim != 2:
+        raise IngestRejectedError(f"x must be (N, P), got shape {x.shape}")
+    if x.shape[-1] != state.n_predictors:
+        raise IngestRejectedError(
+            f"expected {state.n_predictors} predictors ({state.xvars}), "
+            f"got {x.shape[-1]}"
+        )
+    if not (y.shape == mask.shape == x.shape[:1]):
+        raise IngestRejectedError(
+            f"length mismatch: y {y.shape}, x {x.shape}, mask {mask.shape}"
+        )
+    if mask.any():
+        if not np.isfinite(x[mask]).any():
+            raise IngestRejectedError(
+                "all-NaN cross-section: no finite predictor in any "
+                "masked row"
+            )
+        if np.isinf(y[mask]).any():
+            raise IngestRejectedError("infinite realized return in y")
+    return y, x, mask
 
 
 def _month_stats(y, x, mask, dtype):

@@ -14,16 +14,40 @@ Quickstart (build-state → warm → query)::
     with ERService(state) as svc:            # warm=True compiles all buckets
         er = svc.query("2001-06-30", x_row)  # one firm's features
         print(svc.report())
+
+Fault tolerance (the ``resilience`` layer's serving story):
+
+- the service holds a LAST-KNOWN-GOOD state: :meth:`ingest_month` validates
+  a candidate month (``serving.ingest.validate_cross_section``), appends it
+  via the incremental merge, and only then atomically swaps in the new
+  state behind a freshly WARMED executor. Any failure — NaN flood, shape
+  mismatch, merge divergence beyond tolerance, an exception anywhere in
+  the ingest math — QUARANTINES the month and the service keeps quoting
+  from the previous state (``degraded``/``quarantined_months`` in
+  ``stats()``). A later successful re-ingest of a quarantined month clears
+  it.
+- ``dispatch_timeout_s`` arms the executor's per-dispatch watchdog: a
+  stalled runner fails its own bucket (the batch's futures get
+  ``DispatchTimeoutError``) instead of hanging the microbatcher; the
+  flusher keeps draining and later queries are unaffected.
+
+Both knobs default OFF; with no ``FaultPlan`` installed the added hot-path
+cost is one module-global read per dispatch (pinned by the bench's serving
+p50).
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
 import numpy as np
 
+from fm_returnprediction_tpu.resilience.errors import IngestRejectedError
+from fm_returnprediction_tpu.resilience.faults import fault_site
 from fm_returnprediction_tpu.serving.batcher import MicroBatcher
 from fm_returnprediction_tpu.serving.executor import BucketedExecutor
 from fm_returnprediction_tpu.utils.timing import StageTimer
@@ -43,18 +67,27 @@ class ERService:
         min_bucket: int = 1,
         warm: bool = True,
         auto_flush: bool = True,
+        dispatch_timeout_s: Optional[float] = None,
+        merge_tolerance: Optional[float] = None,
     ):
         self.state = state
         self.timer = StageTimer()
+        self._max_batch = max_batch
+        self._min_bucket = min_bucket
+        self._dispatch_timeout_s = dispatch_timeout_s
+        # merge-divergence gate for re-ingest of the current last month:
+        # a merged cross-section that moves the month's coefficients by
+        # more than tolerance*(1+|old|) per entry is quarantined as a
+        # data fault. None disables (legitimate late data can move a thin
+        # month's fit a lot; the knob is for callers who know their feed).
+        self.merge_tolerance = merge_tolerance
         with self.timer.stage("serving/build_executor"):
-            self.executor = BucketedExecutor(
-                state, max_batch=max_batch, min_bucket=min_bucket
-            )
+            self.executor = self._build_executor(state)
         if warm:
             with self.timer.stage("serving/warmup"):
                 self.executor.warmup()
         self.batcher = MicroBatcher(
-            self.executor.run,
+            self._dispatch,
             max_batch=max_batch,
             max_latency_ms=max_latency_ms,
             max_queue=max_queue,
@@ -62,7 +95,37 @@ class ERService:
             n_predictors=state.n_predictors,
             min_bucket=min_bucket,
         )
+        self._quarantined: dict = {}  # month label → rejection reason
+        self._n_ingested = 0
+        self._n_ingest_failed = 0
+        # Executor counters must survive ingest swaps (each ingest
+        # publishes a FRESH executor): retired executors stay in a short
+        # deque and are summed LIVE in stats() — an in-flight batch still
+        # dispatching on one keeps incrementing a counted object — and
+        # only fold into the plain-int totals once enough swaps have
+        # passed that nothing can still be running on them. The lock makes
+        # a swap atomic against a concurrent stats() read (no double
+        # count mid-swap).
+        self._swap_lock = threading.Lock()
+        self._retired: deque = deque()
+        self._exec_prior = {"hits": 0, "misses": 0, "compiles": 0,
+                            "timeouts": 0}
         self._t0 = time.perf_counter()
+
+    def _build_executor(self, state) -> BucketedExecutor:
+        return BucketedExecutor(
+            state,
+            max_batch=self._max_batch,
+            min_bucket=self._min_bucket,
+            dispatch_timeout_s=self._dispatch_timeout_s,
+        )
+
+    def _dispatch(self, month_idx, x, valid) -> np.ndarray:
+        # one indirection instead of binding ``executor.run`` into the
+        # batcher: ingest_month swaps ``self.executor`` atomically and
+        # in-flight batches finish on whichever executor they started with
+        # (append-only states keep old month slots valid in new ones)
+        return self.executor.run(month_idx, x, valid)
 
     # -- queries -----------------------------------------------------------
 
@@ -86,19 +149,119 @@ class ERService:
         futures = [self.submit(m, x) for m, x in zip(months, xs)]
         return np.asarray([f.result(timeout=timeout) for f in futures])
 
+    # -- incremental ingest with quarantine --------------------------------
+
+    @staticmethod
+    def _month_key(month) -> str:
+        try:
+            return str(np.datetime64(month, "ns"))
+        except (ValueError, TypeError):
+            return str(month)
+
+    def ingest_month(self, y_new, x_new, mask_new, month) -> bool:
+        """Append (or merge) one month's cross-section; ``True`` on success.
+
+        On ANY failure the month is quarantined — recorded with its
+        rejection reason, counted in ``stats()`` — and the service keeps
+        quoting from the last-known-good state. Nothing the caller feeds
+        this method can take the service down; the worst outcome is a
+        stale-by-one-month quote stream, disclosed via ``degraded``.
+
+        The swap is crash-consistent and warm: the new state's executor is
+        built and fully warmed BEFORE publication, so the first query after
+        an ingest pays zero compiles, and a failure during warm-up leaves
+        the old state serving.
+        """
+        key = self._month_key(month)
+        from fm_returnprediction_tpu.serving.ingest import (
+            ingest_month as _ingest,
+            validate_cross_section,
+        )
+
+        try:
+            # chaos hook: a poisoned feed mutates the payload HERE, before
+            # validation — the quarantine path must catch what it does
+            y_new, x_new, mask_new = fault_site(
+                "serving.ingest", payload=(y_new, x_new, mask_new)
+            )
+            y, x, mask = validate_cross_section(self.state, y_new, x_new, mask_new)
+            with self.timer.stage("serving/ingest"):
+                new_state = _ingest(self.state, y, x, mask, month)
+            merged = new_state.n_months == self.state.n_months
+            if merged and self.merge_tolerance is not None:
+                old_row, new_row = self.state.coef[-1], new_state.coef[-1]
+                both = np.isfinite(old_row) & np.isfinite(new_row)
+                moved = np.abs(new_row - old_row)[both]
+                bound = self.merge_tolerance * (1.0 + np.abs(old_row)[both])
+                if moved.size and (moved > bound).any():
+                    raise IngestRejectedError(
+                        f"merge divergence: coefficient moved "
+                        f"{moved.max():.3g} > tolerance"
+                    )
+            with self.timer.stage("serving/ingest_warmup"):
+                new_exec = self._build_executor(new_state)
+                new_exec.warmup()
+        except Exception as exc:  # noqa: BLE001 — quarantine, keep serving
+            self._quarantined[key] = repr(exc)[:300]
+            self._n_ingest_failed += 1
+            return False
+        # publish: attribute assignment is atomic under the GIL, and
+        # append-only month slots mean an in-flight request resolved on the
+        # old state dispatches correctly on either executor
+        with self._swap_lock:
+            self._retired.append(self.executor)
+            while len(self._retired) > 4:  # nothing in-flight survives 4 swaps
+                dead = self._retired.popleft()
+                self._exec_prior["hits"] += dead.hits
+                self._exec_prior["misses"] += dead.misses
+                self._exec_prior["compiles"] += dead.compiles
+                self._exec_prior["timeouts"] += dead.timeouts
+            self.state = new_state
+            self.executor = new_exec
+        self._n_ingested += 1
+        # a successful re-ingest of a quarantined month heals it
+        self._quarantined.pop(key, None)
+        return True
+
+    @property
+    def degraded(self) -> bool:
+        """True while any quarantined month is outstanding — the quote
+        stream is live but missing data it was offered."""
+        return bool(self._quarantined)
+
+    def quarantined_months(self) -> dict:
+        """month label → rejection reason, for every outstanding
+        quarantine."""
+        return dict(self._quarantined)
+
     # -- instrumentation ---------------------------------------------------
 
     def stats(self) -> dict:
-        """One flat dict: queue metrics + executable-cache counters + qps."""
+        """One flat dict: queue metrics + executable-cache counters + qps
+        + degraded-mode visibility."""
         out = self.batcher.stats()
         elapsed = time.perf_counter() - self._t0
+        with self._swap_lock:
+            live = [*self._retired, self.executor]
+            tot = {
+                k: self._exec_prior[k] + sum(getattr(ex, a) for ex in live)
+                for k, a in (("hits", "hits"), ("misses", "misses"),
+                             ("compiles", "compiles"),
+                             ("timeouts", "timeouts"))
+            }
+            buckets = len(self.executor.buckets())
         out.update(
             qps=(out["n_done"] / elapsed) if elapsed > 0 else 0.0,
-            executable_cache_hits=self.executor.hits,
-            executable_cache_misses=self.executor.misses,
-            executable_compiles=self.executor.compiles,
-            buckets_compiled=len(self.executor.buckets()),
+            executable_cache_hits=tot["hits"],
+            executable_cache_misses=tot["misses"],
+            executable_compiles=tot["compiles"],
+            buckets_compiled=buckets,
             warmup_s=self.timer.durations.get("serving/warmup"),
+            degraded=self.degraded,
+            quarantined_months=sorted(self._quarantined),
+            n_ingested=self._n_ingested,
+            n_ingest_failed=self._n_ingest_failed,
+            dispatch_timeouts=tot["timeouts"],
         )
         return out
 

@@ -24,6 +24,9 @@ def main(argv=None) -> int:
     parser.add_argument("--list", action="store_true", help="list tasks and exit")
     parser.add_argument("--forget", action="store_true", help="drop recorded state")
     parser.add_argument("--force", action="store_true", help="ignore up-to-date state")
+    parser.add_argument("--keep-going", action="store_true",
+                        help="continue independent subgraphs after a failure "
+                             "(failures recorded in the state DB)")
     parser.add_argument("--synthetic", action="store_true",
                         help="use the synthetic fake-WRDS backend")
     parser.add_argument("--notebooks", action="store_true",
@@ -54,7 +57,18 @@ def main(argv=None) -> int:
             runner.forget(args.tasks or None)
             print("state forgotten")
             return 0
-        ok = runner.run(args.tasks or None, force=args.force)
+        import time
+
+        t_run = time.time()
+        ok = runner.run(args.tasks or None, force=args.force,
+                        keep_going=args.keep_going)
+        if not ok and args.keep_going:
+            # THIS run's failures only — the ledger also holds rows from
+            # prior still-unhealed runs
+            for entry in runner.failures():
+                if entry["ts"] >= t_run:
+                    print(f"FAILED {entry['task']}: {entry['error']}",
+                          file=sys.stderr)
         write_timing_log(runner, Path(config("OUTPUT_DIR")) / "task_timings.json")
         return 0 if ok else 1
 

@@ -27,9 +27,12 @@ import os
 import sqlite3
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+from fm_returnprediction_tpu.resilience.errors import TaskTimeoutError
 
 __all__ = ["Task", "TaskRunner", "Reporter", "GreenReporter", "PlainReporter"]
 
@@ -41,7 +44,18 @@ class Task:
     """One node of the graph. Mirrors doit's task dict contract
     (``dodo.py:115-129``): run ``actions`` when any ``file_dep`` content
     changed, a ``target`` is missing, an ``uptodate`` check fails, or the
-    task has never run."""
+    task has never run.
+
+    Resilience knobs (``resilience`` layer):
+
+    - ``retries``         — re-run the whole action list this many times
+      after a failure (exponential backoff from ``retry_backoff_s``,
+      deterministic jitter). A flaky WRDS pull costs a retry, not the run.
+    - ``timeout_s``       — per-ACTION wall-clock budget. A stalled action
+      fails with :class:`TaskTimeoutError` instead of hanging the graph
+      (python actions run on a watchdogged worker thread that is abandoned
+      on timeout; shell actions get ``subprocess`` timeouts).
+    """
 
     name: str
     actions: Sequence[Action]
@@ -51,11 +65,16 @@ class Task:
     uptodate: Sequence[Callable[[], bool]] = ()
     doc: str = ""
     verbosity: int = 1
+    retries: int = 0
+    retry_backoff_s: float = 0.5
+    timeout_s: Optional[float] = None
 
 
 class Reporter:
     def start(self, task: Task) -> None: ...
     def skip(self, task: Task) -> None: ...
+    def skip_failed(self, task: Task, dep: str) -> None: ...
+    def retry(self, task: Task, attempt: int, err: Exception) -> None: ...
     def done(self, task: Task, seconds: float) -> None: ...
     def fail(self, task: Task, err: Exception) -> None: ...
 
@@ -71,6 +90,14 @@ class PlainReporter(Reporter):
 
     def skip(self, task: Task) -> None:
         print(f"-- {task.name} (up to date)", file=self.out, flush=True)
+
+    def skip_failed(self, task: Task, dep: str) -> None:
+        print(f"## {task.name} (skipped: dependency {dep} failed)",
+              file=self.out, flush=True)
+
+    def retry(self, task: Task, attempt: int, err: Exception) -> None:
+        print(f"~~ {task.name} retry {attempt}: {err}",
+              file=self.out, flush=True)
 
     def done(self, task: Task, seconds: float) -> None:
         print(f"   {task.name} ok [{seconds:.2f}s]", file=self.out, flush=True)
@@ -166,7 +193,15 @@ class TaskRunner:
             "CREATE TABLE IF NOT EXISTS run_state"
             " (task TEXT PRIMARY KEY, ok INTEGER, seconds REAL, ts REAL)"
         )
+        # the failure ledger ``keep_going`` runs append to: one row per
+        # failed task (or dependency-skip), so a partially-failed graph is
+        # inspectable after the fact instead of reconstructed from logs
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS failure_log"
+            " (task TEXT, error TEXT, ts REAL)"
+        )
         self._db.commit()
+        self._closed = False
 
     # -- state ------------------------------------------------------------
     def _stored_deps(self, task: Task) -> Dict[str, tuple]:
@@ -177,6 +212,9 @@ class TaskRunner:
         return {path: (h, size, mtime) for path, h, size, mtime in rows}
 
     def _record_success(self, task: Task, seconds: float) -> None:
+        # a success heals the ledger: failure rows describe the CURRENT
+        # state of the graph, not dead history (history is the run log)
+        self._db.execute("DELETE FROM failure_log WHERE task=?", (task.name,))
         self._db.execute("DELETE FROM dep_hash WHERE task=?", (task.name,))
         for dep in task.file_dep:
             p = Path(dep)
@@ -229,11 +267,22 @@ class TaskRunner:
         return True
 
     def forget(self, names: Optional[Sequence[str]] = None) -> None:
-        """Drop recorded state (doit ``forget``) for ``names`` or all."""
+        """Drop recorded state (doit ``forget``) for ``names`` or all —
+        including the failure ledger, so a forgotten task re-runs with a
+        clean record."""
         for name in names or list(self.tasks):
             self._db.execute("DELETE FROM dep_hash WHERE task=?", (name,))
             self._db.execute("DELETE FROM run_state WHERE task=?", (name,))
+            self._db.execute("DELETE FROM failure_log WHERE task=?", (name,))
         self._db.commit()
+
+    def failures(self) -> List[dict]:
+        """The recorded failure ledger, oldest first: one entry per failed
+        task or dependency-skip (``{"task", "error", "ts"}``)."""
+        rows = self._db.execute(
+            "SELECT task, error, ts FROM failure_log ORDER BY ts, rowid"
+        ).fetchall()
+        return [{"task": t, "error": e, "ts": ts} for t, e, ts in rows]
 
     def timings(self) -> Dict[str, float]:
         """Last SUCCESSFUL wall-clock seconds per task."""
@@ -307,28 +356,146 @@ class TaskRunner:
         )
         return bool(reduce(_np.asarray(flags)))
 
-    def run(self, names: Optional[Sequence[str]] = None, force: bool = False) -> bool:
+    # -- action execution (retry / timeout / fault isolation) -------------
+
+    def _run_action(self, task: Task, action: Action) -> None:
+        """One action under the task's ``timeout_s`` budget. The fault site
+        lets the chaos harness inject failures/stalls per task name."""
+        from fm_returnprediction_tpu.resilience.faults import fault_site
+
+        fault_site(f"taskgraph.{task.name}")
+        if isinstance(action, str):
+            try:
+                subprocess.run(
+                    action, shell=True, check=True, timeout=task.timeout_s
+                )
+            except subprocess.TimeoutExpired as exc:
+                raise TaskTimeoutError(
+                    f"task {task.name!r} shell action exceeded "
+                    f"{task.timeout_s}s"
+                ) from exc
+            return
+        if task.timeout_s is None:
+            action()
+            return
+        # Python actions cannot be killed; run on a daemon worker and
+        # ABANDON it on timeout — the graph fails the node and moves on
+        # (the documented trade: a leaked sleeping thread beats a hung
+        # build). Callers whose actions must run on the main thread
+        # (signal handlers) should not set timeout_s.
+        result: Dict[str, object] = {}
+
+        def target() -> None:
+            try:
+                result["ok"] = action()
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                result["err"] = exc
+
+        worker = threading.Thread(
+            target=target, daemon=True, name=f"fmrp-task-{task.name}"
+        )
+        worker.start()
+        worker.join(task.timeout_s)
+        if worker.is_alive():
+            raise TaskTimeoutError(
+                f"task {task.name!r} action exceeded {task.timeout_s}s "
+                "(worker abandoned)"
+            )
+        if "err" in result:
+            raise result["err"]  # type: ignore[misc]
+
+    def _execute_actions(self, task: Task) -> None:
+        """The whole action list, re-run up to ``task.retries`` extra times
+        on failure (shared backoff policy, deterministic jitter; retries
+        restart from the FIRST action — actions are assumed idempotent,
+        which the file_dep/target contract already requires)."""
+        from fm_returnprediction_tpu.resilience.retry import (
+            RetryPolicy,
+            call_with_retry,
+        )
+
+        def once() -> None:
+            for action in task.actions:
+                self._run_action(task, action)
+
+        if task.retries <= 0:
+            once()  # no wrapper: the original traceback stays primary
+            return
+        call_with_retry(
+            once,
+            RetryPolicy(
+                max_attempts=task.retries + 1,
+                backoff_s=task.retry_backoff_s,
+                retry_on=(Exception,),
+            ),
+            label=task.name,
+            on_retry=lambda attempt, err: self.reporter.retry(
+                task, attempt, err
+            ),
+        )
+
+    def _record_failure(self, task: Task, error: str, ran: bool = True) -> None:
+        """Append to the failure ledger; a task that actually RAN is also
+        marked stale (PRESERVING the last successful timing — the timing
+        log is the wall-clock record, not the failure log). A dependency-
+        skip leaves run_state untouched: the task itself never executed."""
+        if ran:
+            self._db.execute(
+                "INSERT INTO run_state VALUES (?,0,NULL,?)"
+                " ON CONFLICT(task) DO UPDATE SET ok=0, ts=excluded.ts",
+                (task.name, time.time()),
+            )
+        self._db.execute(
+            "INSERT INTO failure_log VALUES (?,?,?)",
+            (task.name, error, time.time()),
+        )
+        self._db.commit()
+
+    def run(
+        self,
+        names: Optional[Sequence[str]] = None,
+        force: bool = False,
+        keep_going: bool = False,
+    ) -> bool:
         """Run ``names`` (default: all tasks) and their deps. Returns True
-        if everything succeeded."""
+        if everything succeeded.
+
+        ``keep_going`` (make's ``-k``): a failed node fails its DEPENDENT
+        subgraph — dependents are marked skipped in the failure ledger —
+        but independent subgraphs keep running, so one flaky stage does
+        not strand unrelated work. Without it, the first failure halts
+        the run (prior behavior).
+
+        An abort (KeyboardInterrupt/SystemExit) is recorded like a
+        failure, then the sqlite connection is CLOSED before re-raising —
+        an interrupted run must not leave a locked state DB behind.
+        """
         import numpy as _np
 
         order = self._toposort(list(names or self.tasks))
+        ok_all = True
+        dead: set = set()  # failed, or skipped because a dependency failed
         for name in order:
             task = self.tasks[name]
+            if dead:
+                bad = next((d for d in task.task_dep if d in dead), None)
+                if bad is not None:
+                    self.reporter.skip_failed(task, bad)
+                    self._record_failure(
+                        task, f"skipped: dependency {bad!r} failed", ran=False
+                    )
+                    dead.add(name)
+                    continue
             stale = force or not self.is_up_to_date(task)
             if not self._consensus(stale, _np.any):
                 self.reporter.skip(task)
                 continue
             self.reporter.start(task)
             start = time.perf_counter()
-            err = None
+            err: Optional[BaseException] = None
             try:
-                for action in task.actions:
-                    if isinstance(action, str):
-                        subprocess.run(action, shell=True, check=True)
-                    else:
-                        action()
-            except Exception as exc:  # noqa: BLE001 — report and halt
+                self._execute_actions(task)
+            except BaseException as exc:  # noqa: BLE001 — recorded below
                 err = exc
             if not self._consensus(err is None, _np.all):
                 if err is None:  # a PEER failed; this process's task was fine
@@ -336,21 +503,24 @@ class TaskRunner:
                         "task failed on another process (see its log)"
                     )
                 self.reporter.fail(task, err)
-                # Mark stale but PRESERVE the last successful timing — the
-                # timing log is the wall-clock record, not the failure log.
-                self._db.execute(
-                    "INSERT INTO run_state VALUES (?,0,NULL,?)"
-                    " ON CONFLICT(task) DO UPDATE SET ok=0, ts=excluded.ts",
-                    (task.name, time.time()),
-                )
-                self._db.commit()
-                return False
+                self._record_failure(task, repr(err))
+                if isinstance(err, (KeyboardInterrupt, SystemExit)):
+                    self.close()
+                    raise err
+                if not keep_going:
+                    return False
+                ok_all = False
+                dead.add(name)
+                continue
             seconds = time.perf_counter() - start
             self._record_success(task, seconds)
             self.reporter.done(task, seconds)
-        return True
+        return ok_all
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._db.close()
 
     def __enter__(self) -> "TaskRunner":

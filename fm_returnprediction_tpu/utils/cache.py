@@ -15,9 +15,11 @@ pulls and intermediate dense panels persist here and short-circuit recompute.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import hashlib
 import json
+import os
 import re
 import zipfile
 from pathlib import Path
@@ -25,6 +27,9 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
+
+from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
+from fm_returnprediction_tpu.resilience.faults import fault_site
 
 __all__ = [
     "cache_filename",
@@ -37,6 +42,7 @@ __all__ = [
     "flatten_dict_to_str",
     "save_array_bundle",
     "load_array_bundle",
+    "CorruptArtifactError",
 ]
 
 _DEFAULT_EXTS = ("parquet", "csv", "zip")
@@ -170,25 +176,52 @@ def read_cached_data(filepath: Path, columns=None) -> pd.DataFrame:
     raise ValueError(f"Unsupported file format: {fmt}")
 
 
+@contextlib.contextmanager
+def _atomic_replace(filepath: Path):
+    """Yield a temp path in the SAME directory, then ``os.replace`` it over
+    ``filepath`` — a crash mid-write leaves the old file (or nothing), never
+    a truncated artifact that poisons the next run. The temp name keeps the
+    real suffix (pandas/numpy writers sniff it: ``to_excel`` picks its
+    engine by extension, ``np.savez`` appends ``.npz`` to anything else)
+    and is pid+thread salted so concurrent writers — including two THREADS
+    of one process, the serving layer is threaded — get distinct temp
+    files; last replace wins, nothing tears."""
+    import threading
+
+    filepath = Path(filepath)
+    filepath.parent.mkdir(parents=True, exist_ok=True)
+    tmp = filepath.parent / (
+        f".{filepath.stem}.tmp-{os.getpid()}-{threading.get_ident()}"
+        f"{filepath.suffix}"
+    )
+    try:
+        yield tmp
+        os.replace(tmp, filepath)
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
 def write_cache_data(df: pd.DataFrame, filepath: Path) -> None:
     """Write a frame by extension; parquet is the default interchange format
-    (``src/utils.py:221-235``)."""
+    (``src/utils.py:221-235``). Atomic: temp file + rename, so a crashed
+    writer never leaves a torn parquet behind."""
     filepath = Path(filepath)
     fmt = filepath.suffix.lstrip(".")
-    filepath.parent.mkdir(parents=True, exist_ok=True)
-    if fmt == "parquet":
-        df.to_parquet(filepath, index=False)
-    elif fmt == "csv":
-        df.to_csv(filepath, index=False)
-    elif fmt == "xlsx":
-        df.to_excel(filepath, index=False)
-    elif fmt == "zip":
-        # One CSV member named after the archive stem — the layout the zip
-        # read path expects (and the common WRDS-export shape).
-        with zipfile.ZipFile(filepath, "w", zipfile.ZIP_DEFLATED) as archive:
-            archive.writestr(filepath.stem + ".csv", df.to_csv(index=False))
-    else:
-        raise ValueError(f"Unsupported file format: {fmt}")
+    with _atomic_replace(filepath) as tmp:
+        if fmt == "parquet":
+            df.to_parquet(tmp, index=False)
+        elif fmt == "csv":
+            df.to_csv(tmp, index=False)
+        elif fmt == "xlsx":
+            df.to_excel(tmp, index=False)
+        elif fmt == "zip":
+            # One CSV member named after the archive stem — the layout the
+            # zip read path expects (and the common WRDS-export shape).
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as archive:
+                archive.writestr(filepath.stem + ".csv", df.to_csv(index=False))
+        else:
+            raise ValueError(f"Unsupported file format: {fmt}")
+    fault_site("cache.write_cache_data", path=filepath)
 
 
 def save_cache_data(
@@ -216,6 +249,18 @@ def save_cache_data(
 
 
 _BUNDLE_META_KEY = "__meta__"
+_BUNDLE_HASH_KEY = "__sha256__"  # meta-dict slot for the content checksum
+
+
+def _bundle_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """Order-independent content hash over (name, dtype, shape, bytes) of
+    every array — the integrity contract ``load_array_bundle`` verifies."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        h.update(f"{name}|{arr.dtype.str}|{arr.shape}|".encode())
+        h.update(arr.data)
+    return h.hexdigest()
 
 
 def save_array_bundle(
@@ -231,13 +276,18 @@ def save_array_bundle(
     NOT object dtype — so the bundle stays loadable with ``allow_pickle``
     off (no pickle deserialization surface in a shared artifact, the same
     contract as ``DensePanel.save``).
+
+    Two integrity guarantees: the write is ATOMIC (temp + rename — a crash
+    mid-write leaves no truncated npz), and the metadata records a content
+    sha256 over every array, which :func:`load_array_bundle` verifies
+    (silent bit-rot surfaces as a typed ``CorruptArtifactError``, not a
+    wrong answer three stages later).
     """
     path = Path(path)
     if path.suffix != ".npz":
         # np.savez appends ".npz" to other names; normalize up front so the
         # RETURNED path is always the one actually written
         path = Path(str(path) + ".npz")
-    path.parent.mkdir(parents=True, exist_ok=True)
     # names that collide with np.savez_compressed's own parameters would be
     # consumed as keyword arguments (TypeError for "file", silently dropped
     # for flags like "allow_pickle") instead of saved — reject them up front
@@ -245,11 +295,16 @@ def save_array_bundle(
     bad = reserved.intersection(arrays)
     if bad:
         raise ValueError(f"array names {sorted(bad)!r} are reserved")
-    np.savez_compressed(
-        path,
-        **{_BUNDLE_META_KEY: np.asarray(json.dumps(meta or {}))},
-        **arrays,
-    )
+    if meta and _BUNDLE_HASH_KEY in meta:
+        raise ValueError(f"meta key {_BUNDLE_HASH_KEY!r} is reserved")
+    meta_out = {**(meta or {}), _BUNDLE_HASH_KEY: _bundle_digest(arrays)}
+    with _atomic_replace(path) as tmp:
+        np.savez_compressed(
+            tmp,
+            **{_BUNDLE_META_KEY: np.asarray(json.dumps(meta_out))},
+            **arrays,
+        )
+    fault_site("cache.save_array_bundle", path=path)
     return path
 
 
@@ -257,17 +312,32 @@ def load_array_bundle(
     path: Union[Path, str],
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Load an array bundle written by :func:`save_array_bundle`:
-    ``(arrays, meta)``. Raises ``FileNotFoundError`` when absent."""
+    ``(arrays, meta)``. Raises ``FileNotFoundError`` when absent and
+    :class:`CorruptArtifactError` when the file is structurally unreadable
+    or its stored content hash does not match — the typed signal the
+    checkpoint-resume path catches to REBUILD instead of crashing on a
+    cryptic numpy/zipfile error. Bundles written before the checksum
+    existed load unverified (no stored hash to check)."""
     path = Path(path)
     if not path.exists():
         raise FileNotFoundError(f"Array bundle {path} not found.")
-    with np.load(path, allow_pickle=False) as z:
-        meta = (
-            json.loads(str(z[_BUNDLE_META_KEY][()]))
-            if _BUNDLE_META_KEY in z.files
-            else {}
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = (
+                json.loads(str(z[_BUNDLE_META_KEY][()]))
+                if _BUNDLE_META_KEY in z.files
+                else {}
+            )
+            arrays = {k: z[k] for k in z.files if k != _BUNDLE_META_KEY}
+    except (zipfile.BadZipFile, OSError, ValueError, KeyError, EOFError) as exc:
+        raise CorruptArtifactError(
+            f"array bundle {path} is unreadable: {exc!r}"
+        ) from exc
+    stored = meta.pop(_BUNDLE_HASH_KEY, None)
+    if stored is not None and stored != _bundle_digest(arrays):
+        raise CorruptArtifactError(
+            f"array bundle {path} failed its content hash"
         )
-        arrays = {k: z[k] for k in z.files if k != _BUNDLE_META_KEY}
     return arrays, meta
 
 

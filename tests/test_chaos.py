@@ -1,0 +1,251 @@
+"""Chaos suite: every injected fault class must provably RECOVER.
+
+Each test installs a deterministic :class:`FaultPlan` against a real
+production code path and asserts the recovery the resilience layer
+promises (ISSUE acceptance contract):
+
+- transient IO error        → retried to success (WRDS pull loop);
+- corrupt artifact          → typed checksum failure, resume path rebuilds;
+- stalled runner            → the in-flight bucket FAILS, the microbatcher
+                              keeps draining, later queries are unharmed;
+- poisoned ingest month     → quarantined; the service keeps quoting from
+                              the last-known-good state (degraded mode);
+- mid-pipeline crash        → rerun resumes at the last completed stage.
+
+Everything here is seeded/counter-gated — no wall-clock randomness — so a
+failure replays exactly. Marked ``chaos`` (registered in pyproject); the
+tests are fast and run in tier-1.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.resilience import (
+    CorruptArtifactError,
+    DispatchTimeoutError,
+    FaultPlan,
+    FaultSpec,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _tiny_state(t=24, n=40, p=3, seed=11):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    return build_serving_state(y, x, mask, window=t // 2,
+                               min_periods=t // 4), x
+
+
+# -- transient IO error: retried to success --------------------------------
+
+def test_transient_wrds_fault_retried_to_success(monkeypatch):
+    """Two injected connection-layer faults cost two retries, not the
+    pull: the third attempt lands and returns the frame."""
+    from fm_returnprediction_tpu.data import wrds_pull
+
+    class FakeConn:
+        def __init__(self, wrds_username=""):
+            pass
+
+        def raw_sql(self, sql, date_cols=None):
+            return pd.DataFrame({"x": [1]})
+
+        def close(self):
+            pass
+
+    fake = types.ModuleType("wrds")
+    fake.Connection = FakeConn
+    monkeypatch.setitem(sys.modules, "wrds", fake)
+
+    with FaultPlan({"wrds.query": FaultSpec(times=2)}) as plan:
+        out = wrds_pull._wrds_query("SELECT 1", "u", [], retries=3,
+                                    backoff_s=0.0)
+    assert len(out) == 1
+    assert plan.fired["wrds.query"] == 2 and plan.calls["wrds.query"] == 3
+
+    # a persistent fault exhausts the budget with the typed error
+    with FaultPlan({"wrds.query": FaultSpec(times=-1)}):
+        with pytest.raises(RuntimeError, match="after 2 attempts"):
+            wrds_pull._wrds_query("SELECT 1", "u", [], retries=1,
+                                  backoff_s=0.0)
+
+
+# -- corrupt artifact: typed failure, resume rebuilds ----------------------
+
+def test_corrupted_serving_state_rebuilt_not_crashed(tmp_path):
+    """An artifact corrupted after a (successful) write fails its checksum
+    as a typed error, and the checkpoint resume path REBUILDS it instead
+    of surfacing a cryptic numpy error."""
+    from fm_returnprediction_tpu.resilience import StageCheckpointer
+    from fm_returnprediction_tpu.serving.state import ServingState
+
+    state, _ = _tiny_state()
+    ck = StageCheckpointer(tmp_path, "fp")
+    calls = {"n": 0}
+
+    def compute():
+        calls["n"] += 1
+        return state
+
+    kw = dict(saver=lambda st, path: st.save(path),
+              loader=ServingState.load, suffix=".npz")
+    ck.stage("serving_state", compute, **kw)
+    assert calls["n"] == 1
+
+    # corrupt the persisted npz the way a torn write / bit-rot would
+    with FaultPlan({"cache.save_array_bundle": FaultSpec(corrupt=True)}):
+        state.save(tmp_path / "serving_state.npz")  # overwrite + corrupt
+    with pytest.raises(CorruptArtifactError):
+        ServingState.load(tmp_path / "serving_state.npz")
+
+    with pytest.warns(UserWarning, match="recomputing"):
+        rebuilt = StageCheckpointer(tmp_path, "fp").stage(
+            "serving_state", compute, **kw
+        )
+    assert calls["n"] == 2
+    np.testing.assert_array_equal(rebuilt.slopes_bar, state.slopes_bar)
+
+
+# -- stalled runner: bucket fails, batcher survives ------------------------
+
+def test_stalled_dispatch_fails_bucket_without_hanging_batcher():
+    """A runner stalled mid-dispatch is failed by the executor watchdog:
+    the batch's futures get DispatchTimeoutError, the flusher thread keeps
+    draining, and the NEXT query (fault healed) succeeds on the same
+    service."""
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _tiny_state()
+    t = state.n_months
+    with ERService(state, max_batch=8, max_latency_ms=0.5, warm=True,
+                   dispatch_timeout_s=0.25) as svc:
+        row = x[t - 1, 0]
+        with FaultPlan({"serving.dispatch": FaultSpec(times=1, delay_s=5.0)}):
+            fut = svc.submit(t - 1, row)
+            with pytest.raises(DispatchTimeoutError):
+                fut.result(timeout=10.0)
+        # the stall cost ONE bucket; the service is still live
+        er = svc.query(t - 1, row, timeout=10.0)
+        assert np.isfinite(er)
+        stats = svc.stats()
+        assert stats["dispatch_timeouts"] == 1
+        assert stats["n_failed"] == 1 and stats["n_failed_batches"] == 1
+        assert stats["n_done"] >= 1
+
+
+# -- poisoned ingest month: quarantined, service stays quotable ------------
+
+def test_poisoned_ingest_quarantined_service_stays_quotable():
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _tiny_state()
+    t, n, p = state.n_months, x.shape[1], state.n_predictors
+    month = np.datetime64("2071-03-31", "ns")
+    with ERService(state, max_batch=8, warm=True) as svc:
+        before = svc.query(t - 1, x[t - 1, 0])
+        assert np.isfinite(before)
+
+        # the poisoned feed: a NaN-flood cross-section injected at the
+        # ingest fault site (what a broken upstream join produces)
+        poison = FaultSpec(times=1, mutate=lambda payload: (
+            np.full(n, np.nan),
+            np.full((n, p), np.nan, np.float32),
+            np.ones(n, bool),
+        ))
+        with FaultPlan({"serving.ingest": poison}):
+            ok = svc.ingest_month(
+                np.full(n, np.nan), x[t - 1, :, :], np.ones(n, bool), month
+            )
+        assert not ok and svc.degraded
+        assert str(month) in svc.quarantined_months()
+        assert "all-NaN" in svc.quarantined_months()[str(month)]
+        assert svc.state.n_months == t          # last-known-good untouched
+
+        # STILL QUOTABLE from the previous state, same answer
+        after = svc.query(t - 1, x[t - 1, 0])
+        assert after == pytest.approx(before)
+
+        # a clean re-ingest of the same month heals the quarantine
+        y_ok = np.full(n, np.nan, np.float32)   # start-of-month: no returns
+        assert svc.ingest_month(y_ok, x[t - 1], np.ones(n, bool), month)
+        assert not svc.degraded and svc.state.n_months == t + 1
+        assert np.isfinite(svc.query(month, x[t - 1, 0]))
+        stats = svc.stats()
+        assert stats["n_ingest_failed"] == 1 and stats["n_ingested"] == 1
+
+
+def test_shape_mismatch_and_merge_divergence_quarantined():
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _tiny_state()
+    t, n = state.n_months, x.shape[1]
+    with ERService(state, max_batch=8, warm=False, auto_flush=False,
+                   merge_tolerance=1e-6) as svc:
+        # wrong predictor width → rejected, not raised to the caller
+        bad = np.zeros((n, state.n_predictors + 2), np.float32)
+        assert not svc.ingest_month(np.zeros(n), bad, np.ones(n, bool),
+                                    np.datetime64("2071-04-30", "ns"))
+        assert svc.degraded and svc.stats()["n_ingest_failed"] == 1
+
+        # merge re-ingest of the LAST month with wildly different rows →
+        # divergence beyond tolerance → quarantined, state unchanged
+        last = state.months[-1]
+        rng = np.random.default_rng(0)
+        y2 = rng.standard_normal(n).astype(np.float32) * 10
+        x2 = rng.standard_normal((n, state.n_predictors)).astype(np.float32)
+        old_coef = svc.state.coef.copy()
+        assert not svc.ingest_month(y2, x2, np.ones(n, bool), last)
+        np.testing.assert_array_equal(svc.state.coef, old_coef)
+        assert str(np.datetime64(last, "ns")) in svc.quarantined_months()
+
+
+# -- mid-pipeline crash: resume skips completed stages ---------------------
+
+def test_pipeline_crash_resumes_at_last_completed_stage(tmp_path, monkeypatch):
+    """Crash injected in the serving-state stage; the rerun loads Table 1
+    and Table 2 from their stage checkpoints (builders not re-entered) and
+    recomputes only the crashed stage."""
+    import fm_returnprediction_tpu.pipeline as pl
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+
+    kw = dict(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=20, n_months=36),
+        make_figure=False, make_deciles=False, make_serving=True,
+        compile_pdf=False, checkpoint_dir=tmp_path,
+    )
+    with FaultPlan({"pipeline.serving_state": FaultSpec(times=1)}):
+        with pytest.raises(OSError, match="injected fault"):
+            pl.run_pipeline(**kw)
+
+    calls = {"table_1": 0, "table_2": 0}
+    orig_t1, orig_t2 = pl.build_table_1, pl.build_table_2
+
+    def count(name, orig):
+        def inner(*a, **k):
+            calls[name] += 1
+            return orig(*a, **k)
+        return inner
+
+    monkeypatch.setattr(pl, "build_table_1", count("table_1", orig_t1))
+    monkeypatch.setattr(pl, "build_table_2", count("table_2", orig_t2))
+    res = pl.run_pipeline(**kw)
+    assert calls == {"table_1": 0, "table_2": 0}   # resumed, not refit
+    assert res.serving_state is not None
+    assert res.table_1 is not None and res.table_2 is not None
+
+    # and the resumed tables equal a from-scratch run's
+    monkeypatch.undo()
+    fresh = pl.run_pipeline(**{**kw, "checkpoint_dir": None})
+    pd.testing.assert_frame_equal(res.table_1, fresh.table_1)
+    pd.testing.assert_frame_equal(res.table_2, fresh.table_2)

@@ -1,0 +1,264 @@
+"""Unit tests for the resilience layer's building blocks.
+
+Policy math (deterministic jitter), retry loop semantics (allowlist,
+exhaustion, injectable sleep), fault-plan determinism and nesting, atomic
+cache writes, checksum-on-load, and the stage checkpointer's
+load/recompute/invalidate contract. The end-to-end recovery paths live in
+``tests/test_chaos.py``.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.resilience import (
+    CorruptArtifactError,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    RetryExhaustedError,
+    RetryPolicy,
+    StageCheckpointer,
+    call_with_retry,
+    fault_site,
+)
+from fm_returnprediction_tpu.utils import cache
+
+
+# -- retry policy ----------------------------------------------------------
+
+def test_delay_schedule_deterministic_and_bounded():
+    pol = RetryPolicy(backoff_s=1.0, multiplier=2.0, max_backoff_s=5.0,
+                      jitter=0.25, seed=7)
+    delays = [pol.delay_s(k, "site") for k in range(1, 6)]
+    assert delays == [pol.delay_s(k, "site") for k in range(1, 6)]  # pure
+    for k, d in enumerate(delays, start=1):
+        base = min(1.0 * 2.0 ** (k - 1), 5.0)
+        assert base * 0.75 <= d <= base * 1.25
+    # a different label/seed jitters differently (retrier spreading)
+    assert pol.delay_s(1, "site") != pol.delay_s(1, "other")
+    assert RetryPolicy(jitter=0.0).delay_s(3) == pytest.approx(0.4)
+
+
+def test_retry_allowlist_and_exhaustion():
+    calls = {"n": 0}
+
+    def flaky(budget):
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < budget:
+                raise OSError("transient")
+            return "ok"
+        return fn
+
+    slept = []
+    pol = RetryPolicy(max_attempts=3, backoff_s=0.01, jitter=0.0)
+    assert call_with_retry(flaky(3), pol, sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+
+    # non-allowlisted errors propagate untouched, first try
+    calls["n"] = 0
+    with pytest.raises(KeyError):
+        call_with_retry(lambda: (_ for _ in ()).throw(KeyError("x")), pol,
+                        sleep=slept.append)
+
+    # exhaustion raises the typed error with the last failure as cause
+    with pytest.raises(RetryExhaustedError, match="after 2 attempts") as exc:
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            RetryPolicy(max_attempts=2, backoff_s=0.0),
+            label="pull", sleep=lambda s: None,
+        )
+    assert isinstance(exc.value.__cause__, OSError)
+
+
+def test_on_retry_callback_sees_each_failure():
+    seen = []
+    with pytest.raises(RetryExhaustedError):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("x")),
+            RetryPolicy(max_attempts=3, backoff_s=0.0),
+            sleep=lambda s: None,
+            on_retry=lambda n, err: seen.append(n),
+        )
+    assert seen == [1, 2]  # no callback after the final attempt
+
+
+# -- fault plan ------------------------------------------------------------
+
+def test_fault_site_noop_without_plan():
+    payload = object()
+    assert fault_site("anything", payload=payload) is payload
+
+
+def test_fault_plan_times_skip_and_heal():
+    spec = FaultSpec(times=2, skip=1)
+    with FaultPlan({"s": spec}) as plan:
+        fault_site("s")                      # call 1: skipped
+        for _ in range(2):                   # calls 2-3: fire
+            with pytest.raises(InjectedFault):
+                fault_site("s")
+        fault_site("s")                      # call 4: healed
+    assert plan.calls["s"] == 4 and plan.fired["s"] == 2
+
+
+def test_fault_plan_probability_deterministic():
+    def fired_pattern(seed):
+        with FaultPlan({"p": FaultSpec(times=-1, probability=0.5)},
+                       seed=seed) as plan:
+            out = []
+            for _ in range(20):
+                try:
+                    fault_site("p")
+                    out.append(False)
+                except InjectedFault:
+                    out.append(True)
+        return out
+
+    a, b = fired_pattern(3), fired_pattern(3)
+    assert a == b                      # same seed → same chaos
+    assert any(a) and not all(a)       # p=0.5 over 20 calls does both
+    assert fired_pattern(4) != a       # a different seed differs
+
+
+def test_fault_plan_mutate_and_nesting():
+    outer = FaultPlan({"x": FaultSpec(times=-1)})
+    inner = FaultPlan(
+        {"x": FaultSpec(times=-1, mutate=lambda p: p + 1)}
+    )
+    with outer:
+        with inner:
+            assert fault_site("x", payload=1) == 2  # inner poisons
+        with pytest.raises(InjectedFault):
+            fault_site("x")                          # outer restored
+    assert fault_site("x", payload=1) == 1           # uninstalled
+
+
+def test_fault_plan_delay_only_stalls_without_raising():
+    import time
+
+    with FaultPlan({"slow": FaultSpec(times=1, delay_s=0.05)}):
+        t0 = time.perf_counter()
+        assert fault_site("slow", payload="p") == "p"
+        assert time.perf_counter() - t0 >= 0.05
+
+
+# -- atomic cache writes ---------------------------------------------------
+
+def test_write_cache_data_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A writer crash mid-write must leave the OLD file intact and no temp
+    litter — never a truncated parquet that poisons the next run."""
+    path = tmp_path / "x.parquet"
+    cache.write_cache_data(pd.DataFrame({"a": [1]}), path)
+
+    def torn_write(self, fp, index=False):
+        with open(fp, "wb") as f:
+            f.write(b"PAR1garbage")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(pd.DataFrame, "to_parquet", torn_write)
+    with pytest.raises(OSError):
+        cache.write_cache_data(pd.DataFrame({"a": [1, 2]}), path)
+    monkeypatch.undo()
+    out = cache.read_cached_data(path)          # old content survives
+    assert list(out["a"]) == [1]
+    assert [f for f in os.listdir(tmp_path) if "tmp" in f] == []
+
+
+def test_save_array_bundle_atomic_and_no_tmp_litter(tmp_path):
+    p = cache.save_array_bundle(tmp_path / "b", {"a": np.arange(4.0)})
+    assert p.suffix == ".npz"
+    assert [f for f in os.listdir(tmp_path) if "tmp" in f] == []
+
+
+# -- checksum-on-load ------------------------------------------------------
+
+def test_bundle_checksum_roundtrip_and_corruption(tmp_path):
+    arrays = {"a": np.arange(6.0).reshape(2, 3), "b": np.array([1, 2, 3])}
+    p = cache.save_array_bundle(tmp_path / "b", arrays, {"k": "v"})
+    got, meta = cache.load_array_bundle(p)
+    assert meta == {"k": "v"}  # the stored hash never leaks into meta
+    np.testing.assert_array_equal(got["a"], arrays["a"])
+
+    # truncation (torn write shape) → typed error, not a numpy crash
+    data = p.read_bytes()
+    p.write_bytes(data[: len(data) // 2])
+    with pytest.raises(CorruptArtifactError):
+        cache.load_array_bundle(p)
+
+    # a flipped payload byte in an intact zip container → hash mismatch
+    p2 = cache.save_array_bundle(tmp_path / "c", arrays)
+    raw = bytearray(p2.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    p2.write_bytes(bytes(raw))
+    with pytest.raises(CorruptArtifactError):
+        cache.load_array_bundle(p2)
+
+
+def test_bundle_meta_hash_key_reserved(tmp_path):
+    with pytest.raises(ValueError, match="reserved"):
+        cache.save_array_bundle(
+            tmp_path / "b", {"a": np.zeros(1)}, {"__sha256__": "spoof"}
+        )
+
+
+def test_pre_checksum_bundle_still_loads(tmp_path):
+    """Bundles written before the checksum existed (no stored hash) load
+    unverified — old artifacts must not be bricked by the upgrade."""
+    import json
+
+    p = tmp_path / "old.npz"
+    np.savez_compressed(
+        p, __meta__=np.asarray(json.dumps({"k": 1})), a=np.arange(3.0)
+    )
+    arrays, meta = cache.load_array_bundle(p)
+    assert meta == {"k": 1} and "a" in arrays
+
+
+# -- stage checkpointer ----------------------------------------------------
+
+def test_checkpointer_load_or_compute(tmp_path):
+    calls = {"n": 0}
+
+    def compute():
+        calls["n"] += 1
+        return pd.DataFrame({"v": [calls["n"]]})
+
+    ck = StageCheckpointer(tmp_path, "fp1")
+    first = ck.frame("t", compute)
+    assert calls["n"] == 1 and ck.completed("t")
+
+    again = StageCheckpointer(tmp_path, "fp1").frame("t", compute)
+    assert calls["n"] == 1                      # loaded, not recomputed
+    pd.testing.assert_frame_equal(first, again)
+
+
+def test_checkpointer_fingerprint_invalidates(tmp_path):
+    calls = {"n": 0}
+
+    def compute():
+        calls["n"] += 1
+        return pd.DataFrame({"v": [calls["n"]]})
+
+    StageCheckpointer(tmp_path, "fp1").frame("t", compute)
+    other = StageCheckpointer(tmp_path, "fp2")
+    assert not other.completed("t")             # different data → invalid
+    other.frame("t", compute)
+    assert calls["n"] == 2
+
+
+def test_checkpointer_corrupt_stage_recomputes(tmp_path):
+    calls = {"n": 0}
+
+    def compute():
+        calls["n"] += 1
+        return pd.DataFrame({"v": [7]})
+
+    ck = StageCheckpointer(tmp_path, "fp")
+    ck.frame("t", compute)
+    (tmp_path / "t.pkl").write_bytes(b"garbage")  # bit-rot / torn write
+    with pytest.warns(UserWarning, match="recomputing"):
+        out = StageCheckpointer(tmp_path, "fp").frame("t", compute)
+    assert calls["n"] == 2 and list(out["v"]) == [7]

@@ -281,6 +281,113 @@ def test_failure_preserves_last_success_timing(tmp_path):
         assert not r.is_up_to_date(task)          # but task is stale
 
 
+def test_keep_going_runs_disjoint_subgraphs_and_skips_dependents(tmp_runner):
+    """Engine failure semantics: a failed node fails its dependents
+    (marked skipped in the failure ledger) while an independent subgraph
+    completes; without keep_going the first failure halts."""
+    ran = []
+
+    def boom():
+        raise RuntimeError("nope")
+
+    tasks = [
+        Task("bad", [boom]),
+        Task("child", [lambda: ran.append("child")], task_dep=["bad"]),
+        Task("grandchild", [lambda: ran.append("gc")], task_dep=["child"]),
+        Task("island", [lambda: ran.append("island")]),
+    ]
+    with tmp_runner(tasks) as r:
+        assert not r.run(keep_going=True)
+        assert ran == ["island"]                  # disjoint subgraph ran
+        failures = {f["task"]: f["error"] for f in r.failures()}
+        assert "nope" in failures["bad"]
+        assert "dependency 'bad' failed" in failures["child"]
+        assert "dependency 'child' failed" in failures["grandchild"]
+        assert "island" not in failures
+
+
+def test_task_retry_exhausts_then_fails_and_succeeds_within_budget(tmp_runner):
+    calls = {"n": 0}
+
+    def flaky_until(k):
+        def action():
+            calls["n"] += 1
+            if calls["n"] < k:
+                raise OSError("transient")
+        return action
+
+    always = Task("t", [flaky_until(99)], retries=2, retry_backoff_s=0.0)
+    with tmp_runner([always]) as r:
+        assert not r.run()
+        assert calls["n"] == 3                    # 1 try + 2 retries
+        assert "after 3 attempts" in r.failures()[-1]["error"]
+        assert not r.is_up_to_date(always)
+
+    calls["n"] = 0
+    heals = Task("t2", [flaky_until(3)], retries=2, retry_backoff_s=0.0)
+    with tmp_runner([heals]) as r:
+        assert r.run()                            # third attempt lands
+        assert calls["n"] == 3
+        assert not [f for f in r.failures() if f["task"] == "t2"]
+
+
+def test_task_timeout_kills_sleeping_action(tmp_runner):
+    import time as _time
+
+    t0 = _time.perf_counter()
+    task = Task("sleepy", [lambda: _time.sleep(30)], timeout_s=0.2)
+    with tmp_runner([task]) as r:
+        assert not r.run()
+        assert _time.perf_counter() - t0 < 5      # failed fast, not in 30s
+        assert "exceeded 0.2s" in r.failures()[-1]["error"]
+
+
+def test_forget_after_failure_reruns_cleanly(tmp_runner):
+    state = {"fail": True}
+
+    def action():
+        if state["fail"]:
+            raise RuntimeError("boom")
+
+    task = Task("t", [action])
+    with tmp_runner([task]) as r:
+        assert not r.run()
+        assert len(r.failures()) == 1
+        r.forget(["t"])
+        assert r.failures() == []                 # ledger cleared with state
+        state["fail"] = False
+        assert r.run()
+        assert r.is_up_to_date(task) or True      # bare task: ran cleanly
+
+
+def test_keyboard_interrupt_records_failure_and_closes_db(tmp_path):
+    """An aborted run must report the failure and close the sqlite
+    connection — no locked .sqlite left behind for the next run."""
+    import sqlite3 as _sqlite3
+
+    failed = []
+
+    class Spy(PlainReporter):
+        def fail(self, task, err):
+            failed.append((task.name, err))
+
+    def interrupt():
+        raise KeyboardInterrupt
+
+    db = tmp_path / "db.sqlite"
+    r = TaskRunner([Task("t", [interrupt])], db_path=db, reporter=Spy())
+    with pytest.raises(KeyboardInterrupt):
+        r.run()
+    assert failed and isinstance(failed[0][1], KeyboardInterrupt)
+    with pytest.raises(_sqlite3.ProgrammingError):
+        r._db.execute("SELECT 1")                 # connection closed
+    # the failure was durably recorded before the close
+    with TaskRunner([Task("t", [interrupt])], db_path=db,
+                    reporter=PlainReporter()) as r2:
+        assert [f["task"] for f in r2.failures()] == ["t"]
+    r.close()                                     # idempotent
+
+
 def test_build_docs_site(tmp_path):
     """Static-site builder renders markdown pages + notebook HTML with nav
     links and the GitHub Pages marker (reference docs_src equivalent)."""
