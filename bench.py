@@ -24,6 +24,10 @@ over-claim without (round-1 VERDICT "What's weak" #1-2):
 - ``rolling_std_pallas_ms`` / ``rolling_std_xla_ms`` — the fused pallas
   kernel vs the XLA cumsum path on a (12608, 4096) strip, recording the
   speedup claimed at ``ops/rolling.py`` (TPU only; null on CPU).
+- ``specgrid_*``             — the spec-grid subsystem: the Table-2-shaped
+  3×3 grid from Gram sufficient statistics (one fused program) vs the
+  per-cell batched-QR route, with compiled-program/referee counts and the
+  Gram-vs-stacked footprint estimates.
 
 All timings synchronize by pulling a result to the host (``np.asarray``
 or a scalar device-side reduction), not ``block_until_ready`` alone — on
@@ -502,9 +506,14 @@ def _bench_fuseprobe(fast: bool):
     Compiles the FULL fused Table 2 sweep (all three models, subset-vmapped)
     at increasing firm counts, each in a crash-isolated child process — the
     observed failure mode wedges the in-process client, which is exactly
-    why the production policy exists. TPU-only (the XLA:CPU compiler does
-    not share the failure mode) and budget-capped; records the largest
-    shape that compiled and the smallest that did not."""
+    why the production policy exists. On TPU the probe covers the
+    real-shape ladder up to the N22k crash shape. On CPU rounds (r5
+    VERDICT weak #3: a measurement that only runs under conditions that
+    never occur is not a measurement) a SMALL-shape ladder runs instead —
+    the XLA:CPU compiler does not share the TPU failure mode, so the CPU
+    numbers chart compile cost vs footprint, labelled
+    ``fuseprobe_device: cpu`` / ``fuseprobe_scale: small`` so they can
+    never be read as the TPU boundary."""
     import subprocess
     import sys
 
@@ -512,25 +521,33 @@ def _bench_fuseprobe(fast: bool):
 
     if fast or os.environ.get("FMRP_BENCH_FUSEPROBE", "1") == "0":
         return {}
-    if jax.devices()[0].platform != "tpu":
-        return {}
-    budget = float(os.environ.get("FMRP_BENCH_FUSEPROBE_BUDGET_S", 900))
-    per_probe = float(os.environ.get("FMRP_BENCH_FUSEPROBE_PROBE_S", 240))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        ladder = (2000, 5000, 10000, 16000, 22000)
+        budget = float(os.environ.get("FMRP_BENCH_FUSEPROBE_BUDGET_S", 900))
+        per_probe = float(os.environ.get("FMRP_BENCH_FUSEPROBE_PROBE_S", 240))
+    else:
+        ladder = (500, 1000, 2000)
+        budget = float(os.environ.get("FMRP_BENCH_FUSEPROBE_BUDGET_S", 360))
+        per_probe = float(os.environ.get("FMRP_BENCH_FUSEPROBE_PROBE_S", 150))
     repo_root = os.path.dirname(os.path.abspath(__file__))
     # stacked_design_bytes(3, 600, n, 14, 4) = 115200·n: 2k ≈ 230 MB …
     # 22k ≈ 2.5 GB (the shape that crashed the r4 compile helper)
     results = {}
+    probe_s = {}
     t_start = time.perf_counter()
-    for n in (2000, 5000, 10000, 16000, 22000):
+    for n in ladder:
         if time.perf_counter() - t_start > budget - per_probe:
             results[str(n)] = "budget-exhausted"
             break
         try:
+            t0 = time.perf_counter()
             proc = subprocess.run(
                 [sys.executable, "-c", _FUSEPROBE_CHILD, str(n)],
                 timeout=per_probe, capture_output=True, text=True,
-                cwd=repo_root,
+                cwd=repo_root, env=None if on_tpu else _child_env(repo_root),
             )
+            probe_s[str(n)] = round(time.perf_counter() - t0, 2)
             ok = proc.returncode == 0 and "FUSEPROBE_OK" in proc.stdout
             results[str(n)] = "ok" if ok else (
                 "fail: " + (proc.stderr or proc.stdout)[-150:])
@@ -543,10 +560,119 @@ def _bench_fuseprobe(fast: bool):
     ok_ns = [int(k) for k, v in results.items() if v == "ok"]
     return {
         "fuseprobe_results": results,
+        "fuseprobe_probe_s": probe_s,
+        "fuseprobe_device": "tpu" if on_tpu else "cpu",
+        "fuseprobe_scale": "real" if on_tpu else "small",
         "fuseprobe_largest_ok_mb": (
             round(stacked_design_bytes(3, 600, max(ok_ns), 14, 4) / 2**20)
             if ok_ns else 0
         ),
+    }
+
+
+def _bench_specgrid(fast: bool):
+    """The spec-grid estimation subsystem (``fm_returnprediction_tpu/
+    specgrid``): the full Table-2-shaped 3×3 grid (3 nested models × 3
+    nested universes) solved from shared Gram sufficient statistics as ONE
+    fused program, vs the same 9 cells through the per-cell batched-QR
+    route — on the same synthetic panel. Records both wall-clocks, the
+    grid's compiled-program count (the subsystem's trace counters: the
+    acceptance evidence for "≤2 programs for the 3×3 grid"), the QR
+    referee fallback count, the max |coef| disagreement between the two
+    routes, and the Gram-vs-stacked peak-footprint estimate at both the
+    bench shape and real CRSP shape. FMRP_BENCH_SPECGRID=0 skips."""
+    if os.environ.get("FMRP_BENCH_SPECGRID", "1") == "0":
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu import specgrid
+    from fm_returnprediction_tpu.models.lewellen import MODELS
+    from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+    from fm_returnprediction_tpu.reporting.fusion import stacked_design_bytes
+
+    t = int(os.environ.get("FMRP_BENCH_SPECGRID_MONTHS", 120 if fast else 600))
+    n = int(os.environ.get("FMRP_BENCH_SPECGRID_FIRMS", 300 if fast else 4000))
+    p = 14
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    model_sizes = [len(m.predictors) for m in MODELS]  # 3, 7, 14
+    grid = specgrid.SpecGrid(tuple(
+        specgrid.Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in model_sizes for u in masks
+    ))
+
+    before = specgrid.program_trace_counts()
+    t0 = time.perf_counter()
+    res = specgrid.run_spec_grid(y, x, masks, grid)
+    grid_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = specgrid.run_spec_grid(y, x, masks, grid)
+    grid_warm = time.perf_counter() - t0
+    after = specgrid.program_trace_counts()
+    programs = (after.get("specgrid_program", 0)
+                - before.get("specgrid_program", 0))
+    referee = (after.get("specgrid_referee_calls", 0)
+               - before.get("specgrid_referee_calls", 0))
+
+    # the incumbent: per-cell batched-QR dispatches (the split route)
+    yd, xd = jnp.asarray(y), jnp.asarray(x)
+    subs = [jnp.asarray(m) for m in masks.values()]
+    fm_jit = jax.jit(fama_macbeth, static_argnames=("solver",))
+
+    def percell():
+        out = []
+        for k in model_sizes:
+            for sub in subs:
+                _, fm = fm_jit(yd, xd[..., :k], sub)
+                out.append(np.asarray(fm.coef))  # host pull = sync
+        return out
+
+    t0 = time.perf_counter()
+    qr_coefs = percell()
+    percell_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    qr_coefs = percell()
+    percell_warm = time.perf_counter() - t0
+
+    diffs = []
+    nan_mismatches = 0
+    for s, spec in enumerate(grid.specs):
+        pos = grid.column_positions(spec)
+        a, b = res.coef[s, pos], qr_coefs[s]
+        # a one-sided NaN is a ROUTE DISAGREEMENT (the month/min_months
+        # gates diverged) — counted in its own key (inf inside the max
+        # would serialize as non-RFC 'Infinity' and break strict JSON
+        # consumers of the one-line artifact)
+        nan_mismatches += int((np.isnan(a) != np.isnan(b)).sum())
+        d = np.abs(a - b)
+        diffs.append(np.max(np.where(np.isnan(a) | np.isnan(b), 0.0, d)))
+    itemsize = x.dtype.itemsize
+    q = p + 1
+    p_sum = sum(k + 2 for k in model_sizes)
+    gram_mb = len(grid) * t * q * q * itemsize / 2**20
+    real_gram_mb = len(grid) * 600 * q * q * itemsize / 2**20
+    return {
+        "specgrid_grid_cold_s": round(grid_cold, 4),
+        "specgrid_grid_warm_s": round(grid_warm, 4),
+        "specgrid_percell_cold_s": round(percell_cold, 4),
+        "specgrid_percell_warm_s": round(percell_warm, 4),
+        "specgrid_speedup_warm": round(percell_warm / grid_warm, 2),
+        "specgrid_programs": programs,
+        "specgrid_referee_cells": referee,
+        "specgrid_suspect_months": int(res.suspect_months.sum()),
+        "specgrid_max_abs_coef_diff": float(np.max(diffs)),
+        "specgrid_nan_pattern_mismatches": nan_mismatches,
+        "specgrid_gram_mb": round(gram_mb, 2),
+        "specgrid_stacked_mb": round(
+            stacked_design_bytes(3, t, n, p_sum - 2, itemsize) / 2**20, 1
+        ),
+        "specgrid_real_gram_mb": round(real_gram_mb, 2),
+        "specgrid_real_stacked_mb": round(
+            stacked_design_bytes(3, 600, 22000, p_sum - 2, itemsize) / 2**20, 1
+        ),
+        "specgrid_shape": f"T{t}_N{n}_S{len(grid)}",
     }
 
 
@@ -735,43 +861,70 @@ def _jax_cache_stats() -> dict:
 
 
 def _bench_mesh8(fast: bool):
-    """Full real-shape pipeline over a VIRTUAL 8-device CPU mesh — the
-    multi-chip perf story as a durable artifact (round-4 VERDICT item 7:
-    narrated in architecture.md but recorded in no ``BENCH_r*.json``).
+    """Full pipeline over a VIRTUAL 8-device CPU mesh — the multi-chip
+    perf story as a durable artifact (round-4 VERDICT item 7: narrated in
+    architecture.md but recorded in no ``BENCH_r*.json``).
 
     Runs in a fresh subprocess: ``xla_force_host_platform_device_count``
     must be set before backend init, and the parent may hold a TPU
-    client. Default ON only when the round has a working accelerator (on
-    a CPU-fallback round the host is the sole compute and a second
-    real-shape run could blow the driver's bench window);
-    ``FMRP_BENCH_MESH8=1/0`` overrides either way."""
+    client. ``FMRP_BENCH_MESH8=1`` runs the REAL-shape pipeline off the
+    benchscale cache (defaulted on for TPU rounds by ``main``, where the
+    host-CPU child is cheap relative to the window); unset on a CPU round
+    it runs a SMALL-shape synthetic pipeline instead — labelled
+    ``mesh8_scale: small`` — so CPU rounds emit sharded-path artifact
+    data rather than zero data (r5 VERDICT weak #3). ``0`` skips."""
+    mode = os.environ.get("FMRP_BENCH_MESH8", "")
+    if fast or mode == "0":
+        return {}
+    if mode == "1":
+        return _mesh8_child_run(real_shape=True)
+    return _mesh8_child_run(real_shape=False)
+
+
+def _mesh8_child_run(real_shape: bool):
     import subprocess
     import sys
 
-    if fast or os.environ.get("FMRP_BENCH_MESH8", "0") == "0":
-        return {}
-    t = int(os.environ.get("FMRP_BENCH_REAL_MONTHS", 600))
-    n = int(os.environ.get("FMRP_BENCH_REAL_FIRMS", 22000))
-    budget = float(os.environ.get("FMRP_BENCH_MESH8_BUDGET_S", 900))
     repo_root = os.path.dirname(os.path.abspath(__file__))
-    raw_dir = os.path.join(repo_root, "_cache", f"benchscale_T{t}_N{n}")
-    if not os.path.isdir(raw_dir):
-        return {"mesh8_skipped": "no benchscale cache (real section ran?)"}
+    if real_shape:
+        t = int(os.environ.get("FMRP_BENCH_REAL_MONTHS", 600))
+        n = int(os.environ.get("FMRP_BENCH_REAL_FIRMS", 22000))
+        budget = float(os.environ.get("FMRP_BENCH_MESH8_BUDGET_S", 900))
+        raw_dir = os.path.join(repo_root, "_cache", f"benchscale_T{t}_N{n}")
+        if not os.path.isdir(raw_dir):
+            return {"mesh8_skipped": "no benchscale cache (real section ran?)"}
+        child = (
+            "import json, sys, bench\n"
+            "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
+            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+        )
+        argv = [sys.executable, "-c", child, raw_dir]
+    else:
+        t = int(os.environ.get("FMRP_BENCH_MESH8_MONTHS", 120))
+        n = int(os.environ.get("FMRP_BENCH_MESH8_FIRMS", 400))
+        budget = float(os.environ.get("FMRP_BENCH_MESH8_BUDGET_S", 600))
+        child = (
+            "import json, sys, tempfile, bench\n"
+            "from fm_returnprediction_tpu.data.synthetic import (\n"
+            "    SyntheticConfig, write_synthetic_cache)\n"
+            "t, n = int(sys.argv[1]), int(sys.argv[2])\n"
+            "with tempfile.TemporaryDirectory() as raw:\n"
+            "    write_synthetic_cache(raw, SyntheticConfig(\n"
+            "        n_firms=n, n_months=t))\n"
+            "    wall, stages = bench._run_pipeline_timed(raw)\n"
+            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+        )
+        argv = [sys.executable, "-c", child, str(t), str(n)]
 
     env = _child_env(repo_root)
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
     env["MESH_DEVICES"] = "8"
-    child = (
-        "import json, sys, bench\n"
-        "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
-        "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
-    )
     global _CHILD_PROC
     try:
         proc = subprocess.Popen(
-            [sys.executable, "-c", child, raw_dir],
+            argv,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=repo_root,
         )
@@ -799,6 +952,7 @@ def _bench_mesh8(fast: bool):
             k: round(v, 3) for k, v in got["stages"].items()
         },
         "mesh8_shape": f"T{t}_N{n}",
+        "mesh8_scale": "real" if real_shape else "small",
         "mesh8_device": "cpu-virtual-8",
     }
 
@@ -990,10 +1144,10 @@ def main() -> None:
     # Every section has an off switch so a short accelerator window can be
     # spent on exactly the missing measurement (the tunnel comes and goes;
     # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
-    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _RESIL /
-    # _MESH8 = 0.
-    # Default: all on except _MESH8, which defaults on only with a live
-    # accelerator.
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _SPECGRID /
+    # _RESIL / _FUSEPROBE / _MESH8 = 0.
+    # Default: all on. mesh8 and fuseprobe run their real-shape ladders on
+    # TPU rounds and disclosed small-shape variants on CPU rounds.
     sections = []
     if os.environ.get("FMRP_BENCH_PIPE", "1") == "1":
         sections.append(_bench_pipeline)
@@ -1006,9 +1160,10 @@ def main() -> None:
         sections.append(_bench_pallas)
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
+    sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
-    sections.append(_bench_fuseprobe)  # TPU-only, gated in-section
-    sections.append(_bench_mesh8)  # _MESH8 gate handled in-section
+    sections.append(_bench_fuseprobe)  # real ladder on TPU, small on CPU
+    sections.append(_bench_mesh8)  # real shape when _MESH8=1, small else
 
     # Global deadline: a section hanging in an uninterruptible C call (a
     # backend that died mid-run) must cost only the REMAINING sections, not

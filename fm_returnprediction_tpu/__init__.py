@@ -36,6 +36,11 @@ Architecture (TPU-first, not a translation of the reference):
 - ``serving``    — the online E[r] query layer (no reference analog):
                    frozen fitted state, microbatched shape-bucketed query
                    execution, incremental month ingest.
+- ``specgrid``   — Gram-contracted many-spec estimation (no reference
+                   analog): the panel contracts once into per-month
+                   sufficient statistics and arbitrary specification grids
+                   (universe × regressors × window × winsor × weighting)
+                   solve as one fused program, batched-QR referee included.
 - ``taskgraph``  — a file-dependency DAG runner standing in for ``doit``
                    (reference: ``dodo.py``).
 

@@ -11,7 +11,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List
 
-__all__ = ["ModelSpec", "MODELS", "FIGURE1_VARS", "model_by_name"]
+__all__ = ["ModelSpec", "MODELS", "FIGURE1_VARS", "model_by_name",
+           "model_columns"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +68,19 @@ FIGURE1_VARS: Dict[str, str] = {
     "accruals_final": "Accruals",
     "log_assets_growth": "Log AG",
 }
+
+
+def model_columns(model: ModelSpec, variables_dict: Dict[str, str]) -> List[str]:
+    """Panel column names for a model's display-label predictors, validated
+    — the ONE label→column resolution every route shares (Table 2's
+    stacked/mesh paths and the spec-grid presets must agree on columns by
+    construction, not by parallel lookups)."""
+    xvars = []
+    for label in model.predictors:
+        if label not in variables_dict:
+            raise ValueError(f"'{label}' not found in variables_dict!")
+        xvars.append(variables_dict[label])
+    return xvars
 
 
 def model_by_name(name: str) -> ModelSpec:

@@ -109,6 +109,9 @@ class PipelineResult:
     timer: StageTimer
     decile_table: Optional[pd.DataFrame] = None
     bootstrap_table: Optional[pd.DataFrame] = None
+    # the spec-grid robustness sweep (specgrid.run_scenarios): one tidy
+    # row per (model, universe, window, winsor, weight, predictor)
+    specgrid_scenarios: Optional[pd.DataFrame] = None
     # the fitted artifacts the online service consumes (serving.state):
     # lagged rolling-mean slopes/intercepts, support bounds, additive OLS
     # sufficient statistics — so serving never re-runs the fit
@@ -301,6 +304,7 @@ def run_pipeline(
     make_deciles: bool = True,
     make_bootstrap: bool = False,
     make_serving: bool = True,
+    make_specgrid: bool = False,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
     checkpoint_dir=None,
@@ -504,6 +508,18 @@ def run_pipeline(
                     suffix=".npz",
                 )
 
+    specgrid_scenarios = None
+    if make_specgrid:
+        from fm_returnprediction_tpu.specgrid import run_scenarios
+
+        with timer.stage("specgrid"):
+            # subperiod halves × all three universes × all models on the
+            # Gram engine (one fused program per winsor/weight variant)
+            specgrid_scenarios = _frame_stage(
+                "specgrid_scenarios",
+                lambda: run_scenarios(panel, subset_masks, factors_dict),
+            )
+
     bootstrap_table = None
     if make_bootstrap:
         from fm_returnprediction_tpu.parallel import as_flat_mesh
@@ -532,6 +548,10 @@ def run_pipeline(
                 save_decile_table(decile_table, output_dir)
             if serving_state is not None:
                 serving_state.save(Path(output_dir) / "serving_state.npz")
+            if specgrid_scenarios is not None:
+                specgrid_scenarios.to_csv(
+                    Path(output_dir) / "specgrid_scenarios.csv", index=False
+                )
             if bootstrap_table is not None:
                 from fm_returnprediction_tpu.reporting.bootstrap_table import (
                     save_bootstrap_table,
@@ -553,6 +573,7 @@ def run_pipeline(
         decile_table=decile_table,
         bootstrap_table=bootstrap_table,
         serving_state=serving_state,
+        specgrid_scenarios=specgrid_scenarios,
     )
 
 
@@ -580,6 +601,12 @@ def _main() -> None:
         help="per-stage checkpoint directory: a rerun after a crash "
              "resumes at the last completed reporting stage",
     )
+    parser.add_argument(
+        "--specgrid", action="store_true",
+        help="also run the spec-grid robustness sweep (subperiods × "
+             "universes × models via Gram contraction) and save "
+             "specgrid_scenarios.csv",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -600,6 +627,7 @@ def _main() -> None:
         synthetic=args.synthetic,
         synthetic_config=cfg if args.synthetic else None,
         make_bootstrap=args.bootstrap > 0,
+        make_specgrid=args.specgrid,
         bootstrap_replicates=args.bootstrap or 10_000,
         checkpoint_dir=args.checkpoint_dir,
     )

@@ -101,6 +101,81 @@ _subset_one_device = functools.partial(jax.jit,
 )
 
 
+@functools.partial(jax.jit, static_argnames=("window", "min_periods"))
+def _rolling_batched(slopes, month_valid, window, min_periods):
+    """Calendar-placed rolling slope means for every subset at once —
+    (S, T, P) in, (S, T, P) out; the tensors are tiny (no firm axis)."""
+    return jax.vmap(
+        lambda s, v: rolling_over_valid_rows(s, v, window, min_periods)
+    )(slopes, month_valid)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "min_periods", "n_deciles", "min_obs"),
+)
+def _decile_legs(y, x, mask, cs, window, min_periods, n_deciles, min_obs):
+    """Forecast + decile sorts for one subset, reusing a precomputed
+    cross-section (the Gram route's): no stacked design, one (T, N, P)
+    forecast contraction — the shapes are identical across subsets so all
+    three share one compile."""
+    from fm_returnprediction_tpu.models.forecast import (
+        decile_sorts,
+        rolling_er_forecast,
+    )
+
+    fr = rolling_er_forecast(
+        y, x, mask, window=window, min_periods=min_periods, cs=cs
+    )
+    return decile_sorts(
+        fr.er, fr.er_valid, y, n_deciles=n_deciles, min_obs=min_obs
+    )
+
+
+def _subset_sweep_gram(
+    panel, subset_masks, names, return_col, window, min_periods,
+    n_deciles, min_obs, make_deciles,
+) -> Dict[str, SubsetSweepEntry]:
+    """The figure/decile family on the Gram route (``specgrid``): one
+    fused contraction+solve program produces every subset's monthly
+    cross-sections with no stacked design and no per-subset OLS dispatch;
+    the tiny rolling means batch into one more program, and the decile
+    legs (which need per-firm forecasts, not Grams) reuse the shared
+    cross-sections through one compile for all subsets."""
+    from fm_returnprediction_tpu.specgrid import figure1_grid, run_spec_grid
+
+    xvars = list(FIGURE1_VARS.keys())
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(xvars))
+    grid = figure1_grid(names)
+    res = run_spec_grid(
+        y, x, {n: subset_masks[n] for n in names}, grid
+    )
+    rolled = jax.device_get(
+        _rolling_batched(
+            jnp.asarray(res.slopes), jnp.asarray(res.month_valid),
+            window, min_periods,
+        )
+    )
+    params = (window, min_periods, n_deciles, min_obs)
+    out = {}
+    for i, name in enumerate(names):
+        cs_np = res.spec_cs(grid, i)
+        dec = None
+        if make_deciles:
+            cs_dev = jax.tree.map(jnp.asarray, cs_np)
+            dec = jax.device_get(
+                _decile_legs(
+                    y, x, jnp.asarray(subset_masks[name]), cs_dev,
+                    window, min_periods, n_deciles, min_obs,
+                )
+            )
+        out[name] = SubsetSweepEntry(
+            cs_np, rolled[i], dec, params if dec is not None else None
+        )
+    return out
+
+
 def subset_sweep(
     panel: DensePanel,
     subset_masks: Dict,
@@ -111,13 +186,26 @@ def subset_sweep(
     n_deciles: int = 10,
     min_obs: int = 50,
     make_deciles: bool = True,
+    route: str = None,
 ) -> Dict[str, SubsetSweepEntry]:
     """Run the fused figure/decile program over ``names`` and return numpy
-    results per subset (one ``device_get`` for everything)."""
+    results per subset (one ``device_get`` for everything).
+
+    ``route`` (``specgrid.resolve_route``): "gram" (default) derives the
+    monthly cross-sections from shared Gram sufficient statistics —
+    compile-safe at real shape with no fusion-budget split; "stacked" is
+    the pre-existing QR sweep under the ``reporting.fusion`` policy."""
     xvars = list(FIGURE1_VARS.keys())
     names = [n for n in names if n in subset_masks]
     if not names:
         return {}
+    from fm_returnprediction_tpu.specgrid.specs import resolve_route
+
+    if resolve_route(route) == "gram":
+        return _subset_sweep_gram(
+            panel, subset_masks, names, return_col, window, min_periods,
+            n_deciles, min_obs, make_deciles,
+        )
     y = jnp.asarray(panel.var(return_col))
     x = jnp.asarray(panel.select(xvars))
     stacked = jnp.stack([jnp.asarray(subset_masks[n]) for n in names])

@@ -24,11 +24,16 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from fm_returnprediction_tpu.models.lewellen import MODELS, ModelSpec
+from fm_returnprediction_tpu.models.lewellen import (
+    MODELS,
+    ModelSpec,
+    model_columns,
+)
 from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
 from fm_returnprediction_tpu.panel.dense import DensePanel
 from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
 from fm_returnprediction_tpu.reporting.fusion import fuse_over_subsets
+from fm_returnprediction_tpu.specgrid.specs import resolve_route as _resolve_route
 
 __all__ = ["build_table_2", "run_model_fm"]
 
@@ -74,14 +79,9 @@ def _fm_sweep(y, x_all, masks, idxs, nw_lags, solver, min_months, weight):
     return tuple(out)
 
 
-def _model_columns(model: ModelSpec, variables_dict: Dict[str, str]) -> list:
-    """Panel column names for a model's predictors, validated."""
-    xvars = []
-    for label in model.predictors:
-        if label not in variables_dict:
-            raise ValueError(f"'{label}' not found in variables_dict!")
-        xvars.append(variables_dict[label])
-    return xvars
+# the one shared label→column resolution (models.lewellen.model_columns);
+# kept under the historical private name for this module's callers
+_model_columns = model_columns
 
 
 def run_model_fm(
@@ -159,9 +159,19 @@ def build_table_2(
     models: Optional[list] = None,
     mesh=None,
     return_col: str = "retx",
+    route: Optional[str] = None,
 ) -> pd.DataFrame:
     """Assemble the formatted reference-layout Table 2. ``mesh`` runs every
-    (model, subset) FM with the firm axis sharded across devices."""
+    (model, subset) FM with the firm axis sharded across devices.
+
+    ``route`` (single-device only; ``specgrid.resolve_route``): "gram"
+    (default) solves all 9 cells from shared per-month Gram sufficient
+    statistics — one fused program, ~4 MB of (S, T, Q, Q) instead of the
+    ~2.5 GB stacked designs, with the batched-QR path as a per-cell
+    conditioning referee (``specgrid.solve``); "stacked" is the
+    pre-existing QR route under the ``reporting.fusion`` split/fuse
+    policy. The mesh path ignores ``route`` (the firm axis is sharded;
+    dispatch count is already minimal there)."""
     models = models if models is not None else MODELS
 
     # Push the predictor union and the regressand to the device ONCE and
@@ -177,7 +187,26 @@ def build_table_2(
     col_idx = {c: i for i, c in enumerate(needed)}
     subset_names = list(subset_masks)
 
-    if mesh is None:
+    if mesh is None and _resolve_route(route) == "gram":
+        from fm_returnprediction_tpu.specgrid import run_spec_grid, table2_grid
+
+        grid = table2_grid(
+            variables_dict, models=models, subsets=subset_names,
+            nw_lags=TABLE2_NW_LAGS, min_months=TABLE2_MIN_MONTHS,
+            weight=TABLE2_WEIGHT,
+        )
+        # the grid's union order is built by the same first-seen loop as
+        # ``needed`` above, so ``x_all`` is already in engine order
+        assert grid.union_predictors == needed
+        res = run_spec_grid(y, x_all, subset_masks, grid)
+        cells = {
+            (mi, name): res.spec_summary(
+                grid, mi * len(subset_names) + si
+            )
+            for mi in range(len(models))
+            for si, name in enumerate(subset_names)
+        }
+    elif mesh is None:
         idxs = tuple(
             tuple(col_idx[c] for c in _model_columns(model, variables_dict))
             for model in models

@@ -1,0 +1,69 @@
+"""Spec-grid estimation: Gram-contracted many-spec Fama-MacBeth.
+
+The subsystem that turns "one FM regression" into "an arbitrary grid of
+specifications solved as one fused program": contract the dense (T, N, P)
+panel ONCE into stacked per-month Gram sufficient statistics (additive
+over firms — ``ops.ols.NormalStats``'s property, promoted to a first-class
+engine), then solve universe × regressor-subset × window × winsor ×
+weighting cells as masked slices of those Grams, with the batched-QR path
+as a per-cell conditioning referee.
+
+- ``specs``     — declarative ``Spec``/``SpecGrid`` + Table 2/Figure 1
+  presets and the ``route=`` flag resolver.
+- ``grams``     — the mask-einsum panel→Gram contraction (firm-chunked,
+  no stacked designs).
+- ``solve``     — padded batched Gram solve, FM/NW aggregation, the QR
+  referee, and the program-trace counters ``bench.py`` records.
+- ``scenarios`` — robustness grids (subperiods, size universes, winsor
+  levels, NW weights) → one tidy DataFrame.
+"""
+
+from fm_returnprediction_tpu.specgrid.grams import (
+    SpecGramStats,
+    auto_firm_chunk,
+    contract_spec_grams,
+)
+from fm_returnprediction_tpu.specgrid.scenarios import (
+    run_scenarios,
+    scenario_grid,
+    subperiod_windows,
+    winsor_variant,
+)
+from fm_returnprediction_tpu.specgrid.solve import (
+    SpecGridResult,
+    program_trace_counts,
+    run_spec_grid,
+    run_spec_grid_on_panel,
+    run_spec_grid_weights,
+    solve_spec_stats,
+)
+from fm_returnprediction_tpu.specgrid.specs import (
+    Spec,
+    SpecGrid,
+    figure1_grid,
+    product_grid,
+    resolve_route,
+    table2_grid,
+)
+
+__all__ = [
+    "Spec",
+    "SpecGrid",
+    "SpecGramStats",
+    "SpecGridResult",
+    "auto_firm_chunk",
+    "contract_spec_grams",
+    "figure1_grid",
+    "product_grid",
+    "program_trace_counts",
+    "resolve_route",
+    "run_scenarios",
+    "run_spec_grid",
+    "run_spec_grid_on_panel",
+    "run_spec_grid_weights",
+    "scenario_grid",
+    "solve_spec_stats",
+    "subperiod_windows",
+    "table2_grid",
+    "winsor_variant",
+]

@@ -1,0 +1,167 @@
+"""Mask-einsum contraction of the dense panel into per-spec Gram stats.
+
+The stacked-design route materializes a masked ``(T, N, Q)`` design per
+(model, universe) cell — ~2.5 GB for Table 2 at real CRSP shape, the tensor
+whose subset-vmap crashed the r4 TPU compile and forced the
+``reporting.fusion`` split policy. This module replaces it with the
+compression that makes fixest-style many-spec estimation fast: per-month
+OLS sufficient statistics are ADDITIVE over firms (``ops.ols.NormalStats``,
+the property ``parallel.fm_sharded`` psums across chips), so every spec
+cell is a weighted contraction of the SAME augmented design
+
+    G_s[t] = Σ_n  w_s[t,n] · x̃[t,n,:] x̃[t,n,:]ᵀ ,  x̃ = [1 | X_union − c_t]
+
+where ``w_s`` is the spec's 0/1 row-validity (universe mask ∧ finite y ∧
+finite selected predictors ∧ sample window) and ``c_t`` a per-month,
+spec-independent column shift (``SpecGramStats.center``) that
+decollinearizes the intercept column for free. The output is ``(S, T, Q, Q)``
+— ~4 MB for Table 2's 9 cells at real shape, a 600× footprint reduction —
+and the non-finite entries of UNSELECTED columns are zero-filled, so the
+selected block of each Gram is exact and the rest is ignored by the padded
+solve (``specgrid.solve``).
+
+The contraction streams over firm chunks (statically unrolled slices, no
+padding, no transposed copy of the panel): peak temporary is one
+``(T, chunk, Q)`` weighted design per spec instead of any full-panel
+design. Additivity over firms is what makes the chunked accumulation exact
+— ``tests/test_specgrid.py`` pins it as a sharding property test.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SpecGramStats", "contract_spec_grams", "auto_firm_chunk"]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+class SpecGramStats(NamedTuple):
+    """Per-spec, per-month normal-equation sufficient statistics over the
+    AUGMENTED, per-month CENTERED union design ``[1 | X_union − c]``
+    (Q = P_union + 1). The same quantities as ``ops.ols.NormalStats`` with
+    a leading spec axis, in the shifted basis: slopes are shift-invariant,
+    the raw intercept is recovered as ``a − Σ_p b_p·c[t,p]``
+    (``specgrid.solve``), and residuals/R² are identical. Centering exists
+    purely for conditioning: the intercept column is otherwise nearly
+    collinear with any large-mean characteristic (log-size ≈ mean 5,
+    std 1), which costs ~10× in the equilibrated condition number."""
+
+    gram: jnp.ndarray    # (S, T, Q, Q)
+    moment: jnp.ndarray  # (S, T, Q)
+    n: jnp.ndarray       # (S, T) valid rows
+    ysum: jnp.ndarray    # (S, T) Σy over valid rows
+    yy: jnp.ndarray      # (S, T) Σy² over valid rows
+    center: jnp.ndarray  # (T, P) the per-month column shifts used
+
+
+def auto_firm_chunk(t: int, n: int, q: int, itemsize: int,
+                    budget_bytes: int = 128 * 2**20) -> int:
+    """Chunk width so one (T, chunk, Q) weighted design stays under the
+    byte budget — the dominant temporary of the contraction. Rounded to a
+    lane-friendly multiple of 128 (minimum 128)."""
+    per_firm = max(t * q * itemsize, 1)
+    chunk = max(budget_bytes // per_firm, 128)
+    chunk = min(chunk // 128 * 128, n)
+    return max(chunk, min(n, 128))
+
+
+@functools.partial(jax.jit, static_argnames=("firm_chunk",))
+def contract_spec_grams(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    universes: jnp.ndarray,
+    uidx: jnp.ndarray,
+    col_sel: jnp.ndarray,
+    window: jnp.ndarray,
+    firm_chunk: Optional[int] = None,
+    center: Optional[jnp.ndarray] = None,
+) -> SpecGramStats:
+    """Contract the (T, N, P) union panel into (S, T, Q, Q) Gram stats.
+
+    Parameters
+    ----------
+    y : (T, N) regressand.
+    x : (T, N, P) union predictor columns (``SpecGrid.union_predictors``
+        order).
+    universes : (U, T, N) bool universe masks.
+    uidx : (S,) int — each spec's universe row in ``universes``.
+    col_sel : (S, P) bool — each spec's predictor columns.
+    window : (S, T) bool — each spec's sample-window months.
+    firm_chunk : static chunk width; None → ``auto_firm_chunk``.
+    center : (T, P) per-month column shifts; None computes the masked
+        per-month mean over every finite entry. ANY finite values are
+        algebraically valid (the intercept absorbs shifts; slopes and R²
+        are invariant) and shard-additivity holds for a FIXED center, so
+        sharded callers must share one.
+
+    Validity per spec = universe ∧ finite(y) ∧ finite(selected x) ∧ window
+    — exactly ``ops.ols.row_validity`` restricted to the spec's columns,
+    which is what keeps each cell's complete-case sample identical to the
+    per-cell QR route it replaces.
+    """
+    t, n_firms, p = x.shape
+    q = p + 1
+    dtype = x.dtype
+    s_specs = col_sel.shape[0]
+    chunk = firm_chunk or auto_firm_chunk(t, n_firms, q, dtype.itemsize)
+
+    if center is None:
+        fin_all = jnp.isfinite(x)
+        center = (
+            jnp.where(fin_all, x, 0.0).sum(axis=1)
+            / jnp.maximum(fin_all.sum(axis=1), 1).astype(dtype)
+        )                                    # (T, P)
+    else:
+        center = jnp.asarray(center, dtype)
+
+    uni = universes[uidx]                    # (S, T, N) bool
+    sel_f = col_sel.astype(dtype)            # (S, P)
+
+    gram = jnp.zeros((s_specs, t, q, q), dtype)
+    moment = jnp.zeros((s_specs, t, q), dtype)
+    n_acc = jnp.zeros((s_specs, t), dtype)
+    ysum = jnp.zeros((s_specs, t), dtype)
+    yy = jnp.zeros((s_specs, t), dtype)
+
+    for start in range(0, n_firms, chunk):
+        sl = slice(start, min(start + chunk, n_firms))
+        xc, yc = x[:, sl], y[:, sl]
+        finx = jnp.isfinite(xc)              # (T, c, P)
+        finy = jnp.isfinite(yc)              # (T, c)
+        xz = jnp.where(finx, xc - center[:, None, :], 0.0)
+        yz = jnp.where(finy, yc, 0.0)
+        # rows invalid for spec s: any selected column non-finite
+        bad = jnp.einsum("tnp,sp->stn", (~finx).astype(dtype), sel_f,
+                         precision=_PRECISION)
+        valid = (
+            uni[:, :, sl]
+            & finy[None]
+            & (bad == 0)
+            & window[:, :, None]
+        )                                     # (S, T, c)
+        xa = jnp.concatenate([jnp.ones_like(yc)[..., None], xz], axis=-1)
+
+        g_parts, m_parts, n_parts, ys_parts, yy_parts = [], [], [], [], []
+        for s in range(s_specs):              # static: S is a shape
+            w = valid[s].astype(dtype)        # (T, c)
+            b = xa * w[..., None]             # the ONE large temporary
+            g_parts.append(jnp.einsum("tnp,tnq->tpq", b, xa,
+                                      precision=_PRECISION))
+            m_parts.append(jnp.einsum("tnp,tn->tp", b, yz,
+                                      precision=_PRECISION))
+            wy = w * yz
+            n_parts.append(w.sum(-1))
+            ys_parts.append(wy.sum(-1))
+            yy_parts.append((wy * yz).sum(-1))
+        gram = gram + jnp.stack(g_parts)
+        moment = moment + jnp.stack(m_parts)
+        n_acc = n_acc + jnp.stack(n_parts)
+        ysum = ysum + jnp.stack(ys_parts)
+        yy = yy + jnp.stack(yy_parts)
+
+    return SpecGramStats(gram, moment, n_acc, ysum, yy, center)

@@ -1,0 +1,203 @@
+"""Robustness scenario grids — subperiods × universes × winsor × weights.
+
+The ROADMAP's "as many scenarios as you can imagine" workload, built on
+the Gram engine: ONE fused program per winsor variant covers every
+model × universe × sample-window cell, with every NW weight scheme
+re-aggregated inside that same program, and the results land in one tidy
+DataFrame.
+
+Winsor variants: the panel's characteristics are stored winsorized at
+[1%, 99%] (``get_factors``, reference ``src/calc_Lewellen_2014.py:572``).
+The base clip only moves order statistics in the outer 1% tails, so
+re-winsorizing the stored columns at a TIGHTER level (e.g. 5/95) equals
+winsorizing the raw data there whenever the tighter quantile's
+interpolation ranks clear the ranks the base clip altered — for 1%→5%
+that is every month with ≥ 21 valid names (rank ``0.05·(n−1) ≥ 1`` while
+the 1% clip touches only rank 0 below n=101). Thinner months are a
+clip-of-clip approximation; levels looser than the base are not
+recoverable at all and are rejected. The re-clip runs through the batched
+(V, T, N) winsorizer (``ops.quantiles.winsorize_cs_batched``), one
+program per variant.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.specgrid.solve import run_spec_grid_weights
+from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+__all__ = [
+    "subperiod_windows",
+    "winsor_variant",
+    "scenario_grid",
+    "run_scenarios",
+]
+
+
+def subperiod_windows(n_months: int, pieces: int = 2) -> Dict[str, Tuple[int, int]]:
+    """Equal half-open month-index windows, e.g. ``{"half1": (0, 300),
+    "half2": (300, 600)}`` — plus the full sample under ``"full"``."""
+    if pieces < 1:
+        raise ValueError("pieces must be >= 1")
+    out: Dict[str, Optional[Tuple[int, int]]] = {"full": None}
+    edges = np.linspace(0, n_months, pieces + 1).astype(int)
+    if pieces > 1:
+        for i in range(pieces):
+            out[f"sub{i + 1}of{pieces}"] = (int(edges[i]), int(edges[i + 1]))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("lower", "upper"))
+def _rewinsorize(x, mask, lower: float, upper: float):
+    from fm_returnprediction_tpu.ops.quantiles import winsorize_cs_batched
+
+    cols = jnp.moveaxis(x, -1, 0)                 # (V, T, N)
+    win = winsorize_cs_batched(cols, mask, lower, upper)
+    return jnp.moveaxis(win, 0, -1)
+
+
+def winsor_variant(x, mask, level: float, base_level: float = 1.0):
+    """Re-clip the union tensor at ``[level, 100-level]`` percent.
+
+    ``x`` (T, N, P) already winsorized at ``base_level``; tighter levels
+    equal the raw-data variant on months with enough valid names (see
+    module docstring for the rank condition), looser ones are
+    unrecoverable and rejected."""
+    if level < base_level:
+        raise ValueError(
+            f"winsor level {level}% is looser than the panel's base "
+            f"{base_level}% — the clipped tails cannot be undone"
+        )
+    if level == base_level:
+        return jnp.asarray(x)
+    return _rewinsorize(jnp.asarray(x), jnp.asarray(mask),
+                        float(level), float(100.0 - level))
+
+
+def _scenario_cells(
+    variables_dict: Dict[str, str],
+    universes: Sequence[str],
+    n_months: int,
+    models,
+    subperiods: int,
+    tag: str = "",
+) -> Tuple[Tuple[Spec, ...], list]:
+    """Specs plus structured (model_name, universe, window_name) metadata.
+
+    Delegates the cell enumeration to ``specs.product_grid`` (one home for
+    the set × universe × window loop) and derives the metadata from the
+    SAME iteration order; the sweep reads the metadata, never re-parses
+    spec names (which may legitimately contain any separator)."""
+    from fm_returnprediction_tpu.models.lewellen import model_columns
+    from fm_returnprediction_tpu.specgrid.specs import product_grid
+
+    windows = subperiod_windows(n_months, subperiods)
+    regressor_sets = {
+        m.name: tuple(model_columns(m, variables_dict)) for m in models
+    }
+    grid = product_grid(regressor_sets, universes, windows, tag=tag)
+    meta = [
+        (set_name, universe, win_name)
+        for set_name in regressor_sets
+        for universe in universes
+        for win_name in windows
+    ]
+    assert len(meta) == len(grid.specs)
+    return grid.specs, meta
+
+
+def scenario_grid(
+    variables_dict: Dict[str, str],
+    universes: Sequence[str],
+    n_months: int,
+    models=None,
+    subperiods: int = 2,
+    tag: str = "",
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> SpecGrid:
+    """Model × universe × subperiod grid in one ``SpecGrid``."""
+    from fm_returnprediction_tpu.models.lewellen import MODELS
+
+    models = models if models is not None else MODELS
+    specs, _ = _scenario_cells(variables_dict, universes, n_months, models,
+                               subperiods, tag)
+    return SpecGrid(specs, nw_lags=nw_lags,
+                    min_months=min_months, weight=weight)
+
+
+def run_scenarios(
+    panel,
+    subset_masks: Dict[str, object],
+    variables_dict: Dict[str, str],
+    models=None,
+    universes: Optional[Sequence[str]] = None,
+    subperiods: int = 2,
+    winsor_levels: Sequence[float] = (1.0,),
+    weights: Sequence[str] = ("reference",),
+    nw_lags: int = 4,
+    min_months: int = 10,
+    return_col: str = "retx",
+    referee: bool = True,
+) -> pd.DataFrame:
+    """The scenario sweep: one tidy row per (spec, predictor).
+
+    Columns: scenario dimensions (model/universe/window/winsor/nw_weight),
+    the FM estimates (coef/tstat/nw_se), the cell diagnostics
+    (mean_r2/mean_n/n_months) and ``refereed`` (True when the batched-QR
+    referee re-solved the cell). Each (winsor, weight) combination is one
+    fused Gram program; predictors are reported under their display labels.
+    """
+    from fm_returnprediction_tpu.models.lewellen import MODELS
+
+    models = models if models is not None else MODELS
+    universes = list(universes) if universes is not None else list(subset_masks)
+    label_of = {col: label for label, col in variables_dict.items()}
+
+    t = len(panel.months)
+    specs, meta = _scenario_cells(variables_dict, universes, t, models,
+                                  subperiods)
+    grid0 = SpecGrid(specs, nw_lags=nw_lags, min_months=min_months)
+    y = jnp.asarray(panel.var(return_col))
+    x_base = jnp.asarray(panel.select(grid0.union_predictors))
+    mask = jnp.asarray(panel.mask)
+
+    rows = []
+    for level in winsor_levels:
+        x = winsor_variant(x_base, mask, float(level))
+        # ONE contraction+solve program per winsor level: every NW weight
+        # scheme re-aggregates the same Gram solve inside that program
+        results = run_spec_grid_weights(
+            y, x, {n: subset_masks[n] for n in universes}, grid0,
+            tuple(weights), referee=referee,
+        )
+        for weight in weights:
+            res = results[weight]
+            for s, spec in enumerate(grid0.specs):
+                model_name, universe, win_name = meta[s]
+                pos = grid0.column_positions(spec)
+                for col, p in zip(spec.predictors, pos):
+                    rows.append({
+                        "model": model_name,
+                        "universe": universe,
+                        "window": win_name,
+                        "winsor_pct": float(level),
+                        "nw_weight": weight,
+                        "predictor": label_of.get(col, col),
+                        "coef": float(res.coef[s, p]),
+                        "tstat": float(res.tstat[s, p]),
+                        "nw_se": float(res.nw_se[s, p]),
+                        "mean_r2": float(res.mean_r2[s]),
+                        "mean_n": float(res.mean_n[s]),
+                        "n_months": int(res.n_months[s]),
+                        "refereed": s in res.referee_specs,
+                    })
+    return pd.DataFrame(rows)

@@ -1,0 +1,396 @@
+"""Batched Gram solve + FM aggregation for a spec grid, with a QR referee.
+
+One fused program turns the ``(S, T, Q, Q)`` Gram stats
+(``specgrid.grams``) into per-month slopes/R² and Fama-MacBeth summaries
+for EVERY spec: pad each spec's unselected Gram rows/columns to identity,
+Jacobi-equilibrate (symmetric diagonal scaling — removes the scale-induced
+conditioning of raw characteristic units, leaving the correlation-matrix
+condition number), eigendecompose the tiny symmetric systems, and solve
+with a pinv-style eigenvalue cutoff. The eigenvalues are kept: they price
+each month's conditioning for free.
+
+Numerics contract. The Gram route squares the design's condition number
+(``ops/ols.py`` docstring; ``parallel/fm_sharded.py`` measured the drift),
+so months the Gram algebra cannot defend are flagged SUSPECT and any spec
+containing one is re-solved wholesale by the REFEREE — the existing
+per-cell batched-QR ``ops.fama_macbeth`` route. The gate has two tiers,
+both decided at the precision the stats were CONTRACTED in (information
+below an f32 Gram's own rounding is noise no upcast recovers):
+
+- STRUCTURAL (always): rank-deficient at the data-eps pinv cutoff, or
+  exactly determined (n == Q, the near-singular regime the reference's
+  ``n >= P+1`` gate admits) — min-norm tie-breaks differ between routes
+  there, so the incumbent's answer is the contract.
+- CONDITIONING (f64 panels only): equilibrated condition beyond
+  ``1/√eps64`` — keeps the provable ≤1e-6 route differential in the
+  parity configuration. For f32 panels this tier is OFF, by measurement,
+  not oversight: at real shape the f32-QR incumbent's ``rcond = eps·N ≈
+  2.6e-3`` truncates genuine directions and lands 12-24 t-stat units
+  from the f64 truth, while the centered equilibrated Gram solve stays
+  within ~3e-5 on the same cells — conditioning-refereeing would swap a
+  better answer for a worse one (numbers recorded in the PR 3 bench).
+
+Under x64 the tiny stats are upcast to f64 before the solve regardless of
+panel dtype (the contraction stays in panel dtype; the solve is
+O(S·T·Q³), negligible).
+
+``PROGRAM_TRACES`` counts jit traces of the fused program (a Python
+side-effect runs once per trace ≈ once per compile); ``bench.py`` reads it
+to record the compiled-program count of a grid run — the acceptance
+evidence that the 3×3 Table 2 grid is ≤2 programs instead of per-cell
+dispatches.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fm_returnprediction_tpu.ops.fama_macbeth import (
+    FamaMacbethSummary,
+    fama_macbeth,
+    fama_macbeth_summary,
+)
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+from fm_returnprediction_tpu.specgrid.grams import contract_spec_grams
+from fm_returnprediction_tpu.specgrid.specs import SpecGrid
+
+__all__ = [
+    "SpecSolve",
+    "SpecGridResult",
+    "solve_spec_stats",
+    "run_spec_grid",
+    "run_spec_grid_weights",
+    "run_spec_grid_on_panel",
+    "program_trace_counts",
+]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+# name -> number of jit traces since process start (trace ≈ compile for a
+# fixed shape signature; retraces on new shapes count too, which is the
+# honest number for "how many programs did this grid cost")
+PROGRAM_TRACES: collections.Counter = collections.Counter()
+
+
+def program_trace_counts() -> Dict[str, int]:
+    """Snapshot of the specgrid jit-trace counters."""
+    return dict(PROGRAM_TRACES)
+
+
+class SpecSolve(NamedTuple):
+    """Per-month Gram-solve leaves, spec-major."""
+
+    beta: jnp.ndarray         # (S, T, Q) intercept first; 0 on unselected
+    r2: jnp.ndarray           # (S, T)
+    month_valid: jnp.ndarray  # (S, T) bool: n >= q_s
+    suspect: jnp.ndarray      # (S, T) bool: Gram solve not trustworthy
+
+
+class SpecGridResult(NamedTuple):
+    """Host-side result of a grid run (numpy leaves, spec axis leading).
+
+    ``slopes`` is calendar-placed over the UNION predictor columns with
+    NaN in each spec's unselected columns; ``coef``/``tstat``/``nw_se``
+    follow the same layout. ``referee_specs`` lists the spec indices the
+    QR referee re-solved (their leaves are exactly the per-cell route's).
+    """
+
+    slopes: np.ndarray        # (S, T, P)
+    intercept: np.ndarray     # (S, T)
+    r2: np.ndarray            # (S, T)
+    n_obs: np.ndarray         # (S, T)
+    month_valid: np.ndarray   # (S, T)
+    coef: np.ndarray          # (S, P)
+    tstat: np.ndarray         # (S, P)
+    nw_se: np.ndarray         # (S, P)
+    mean_r2: np.ndarray       # (S,)
+    mean_n: np.ndarray        # (S,)
+    n_months: np.ndarray      # (S,)
+    suspect_months: np.ndarray  # (S,) count flagged by the Gram solve
+    referee_specs: Tuple[int, ...]
+
+    def spec_summary(self, grid: SpecGrid, s: int) -> FamaMacbethSummary:
+        """One spec's FM summary restricted to its own predictor order."""
+        pos = grid.column_positions(grid.specs[s])
+        return FamaMacbethSummary(
+            coef=self.coef[s, pos],
+            tstat=self.tstat[s, pos],
+            nw_se=self.nw_se[s, pos],
+            mean_r2=self.mean_r2[s],
+            mean_n=self.mean_n[s],
+            n_months=self.n_months[s],
+        )
+
+    def spec_cs(self, grid: SpecGrid, s: int) -> CSRegressionResult:
+        """One spec's per-month cross-sections in its own predictor order."""
+        pos = grid.column_positions(grid.specs[s])
+        return CSRegressionResult(
+            slopes=self.slopes[s][:, pos],
+            intercept=self.intercept[s],
+            r2=self.r2[s],
+            n_obs=self.n_obs[s],
+            month_valid=self.month_valid[s],
+        )
+
+
+def solve_spec_stats(stats, sel_aug: jnp.ndarray) -> SpecSolve:
+    """Solve every (spec, month) padded Gram system.
+
+    ``sel_aug`` (S, Q) bool selects augmented columns (intercept always
+    True). Unselected rows/columns are replaced by identity so the padded
+    eigendecomposition solves exactly the selected subsystem with zeros
+    elsewhere.
+    """
+    gram, moment, n, ysum, yy, center = stats
+    # Precision policy (measured on the real-shape benchscale panel,
+    # PR 3): the pinv/rank CUTOFF uses the dtype the stats were
+    # CONTRACTED in — information below an f32 Gram's own rounding is
+    # noise no f64 upcast can recover, so truncation and the structural
+    # referee are decided at data precision. The √eps CONDITIONING
+    # referee applies only to f64 panels (the parity configuration,
+    # where the QR referee is truth-grade): for f32 panels the f32-QR
+    # incumbent is measurably FARTHER from f64 truth than the
+    # equilibrated centered Gram solve (t-stat drift 12-24 vs ≤3e-5 on
+    # the well-posed cells), so conditioning-refereeing there would
+    # swap a better answer for a worse one.
+    data_eps = float(jnp.finfo(gram.dtype).eps)
+    data_is_f64 = gram.dtype == jnp.float64
+    if jax.config.jax_enable_x64 and not data_is_f64:
+        gram, moment = gram.astype(jnp.float64), moment.astype(jnp.float64)
+        n, ysum, yy = (a.astype(jnp.float64) for a in (n, ysum, yy))
+        center = center.astype(jnp.float64)
+    dtype = gram.dtype
+    q = gram.shape[-1]
+    eps = jnp.asarray(data_eps, dtype)
+    cond_limit = 1.0 / jnp.sqrt(eps)
+
+    q_s = sel_aug.sum(-1).astype(dtype)                       # (S,)
+    month_valid = n >= q_s[:, None]                           # (S, T)
+
+    sel2 = sel_aug[:, None, :, None] & sel_aug[:, None, None, :]
+    eye = jnp.eye(q, dtype=dtype)
+    g = jnp.where(sel2, gram, eye)
+    g = jnp.where(month_valid[..., None, None], g, eye)
+    m = jnp.where(sel_aug[:, None, :], moment, 0.0)
+    m = jnp.where(month_valid[..., None], m, 0.0)
+
+    # Jacobi equilibration: the selected block's diagonal becomes 1, so the
+    # eigenvalue spread measures the CORRELATION conditioning, not the raw
+    # characteristic scales (log-dollars vs ratios vs returns).
+    dg = jnp.diagonal(g, axis1=-2, axis2=-1)                  # (S, T, Q)
+    scale = jnp.where(dg > 0, jax.lax.rsqrt(jnp.maximum(dg, eps)), 1.0)
+    gs = g * scale[..., :, None] * scale[..., None, :]
+    with jax.default_matmul_precision("highest"):
+        w, v = jnp.linalg.eigh(gs)                            # ascending
+        wmax = w[..., -1]
+        cutoff = q * eps * wmax
+        winv = jnp.where(w > cutoff[..., None], 1.0 / jnp.maximum(w, eps), 0.0)
+        ms = m * scale
+        t1 = jnp.einsum("...qk,...q->...k", v, ms, precision=_PRECISION)
+        beta = scale * jnp.einsum("...qk,...k->...q", v, t1 * winv,
+                                  precision=_PRECISION)
+    beta = jnp.where(sel_aug[:, None, :] & month_valid[..., None], beta, 0.0)
+
+    # rank over the SELECTED block: padded identity rows contribute
+    # eigenvalues of exactly 1, always above the cutoff
+    rank_sel = (w > cutoff[..., None]).sum(-1) - (q - q_s[:, None])
+    rank_deficient = rank_sel < q_s[:, None]
+    # conditioning component only where the referee outranks the Gram
+    # solve in precision (f64 panels; see the policy note above)
+    ill = (w[..., 0] * cond_limit < wmax) if data_is_f64 else False
+    suspect = month_valid & (rank_deficient | ill | (n <= q_s[:, None]))
+
+    # R² as in ops.ols.solve_from_stats — computed in the shifted basis,
+    # where the residuals are identical to the raw-basis regression's
+    bg = jnp.einsum("...p,...pq,...q->...", beta, g, beta, precision=_PRECISION)
+    bm = jnp.einsum("...p,...p->...", beta, m, precision=_PRECISION)
+    sse = yy - 2.0 * bm + bg
+    sst = yy - ysum * ysum / jnp.maximum(n, 1.0)
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
+    r2 = jnp.where(month_valid, r2, 0.0)
+
+    # undo the column shift: y = a_c + Σ b_p (x_p − c_p)  ⇒  raw intercept
+    # a = a_c − Σ b_p c_p (slopes are shift-invariant; unselected slopes
+    # are exact zeros so the dot never picks up padded columns)
+    intercept = beta[..., 0] - jnp.einsum(
+        "stp,tp->st", beta[..., 1:], center, precision=_PRECISION
+    )
+    beta = jnp.concatenate([intercept[..., None], beta[..., 1:]], axis=-1)
+    return SpecSolve(beta, r2, month_valid, suspect)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk"),
+)
+def _spec_grid_program(
+    y, x, universes, uidx, col_sel, window,
+    nw_lags: int, min_months: int, weights: Tuple[str, ...],
+    firm_chunk: Optional[int],
+):
+    """Contraction + padded solve + FM aggregation for the whole grid —
+    ONE compiled program, no stacked designs, no per-cell dispatch.
+
+    ``weights`` is a static tuple of NW weight schemes: the expensive
+    panel contraction and solve run once, and each scheme adds only its
+    own O(S·T·P) aggregation inside the same program (the scenario sweep
+    products over weight schemes without re-contracting the panel)."""
+    PROGRAM_TRACES["specgrid_program"] += 1  # trace-time side effect
+    stats = contract_spec_grams(y, x, universes, uidx, col_sel, window,
+                                firm_chunk=firm_chunk)
+    s_specs = col_sel.shape[0]
+    sel_aug = jnp.concatenate(
+        [jnp.ones((s_specs, 1), bool), col_sel], axis=1
+    )
+    sol = solve_spec_stats(stats, sel_aug)
+    out_dtype = y.dtype
+    # unselected predictor columns carry NaN: the FM summary's per-column
+    # dropna then reports NaN coef/tstat there, and consumers slicing a
+    # spec's own columns never see them
+    slopes = jnp.where(col_sel[:, None, :], sol.beta[..., 1:], jnp.nan)
+    cs = CSRegressionResult(
+        slopes=slopes.astype(out_dtype),
+        intercept=sol.beta[..., 0].astype(out_dtype),
+        r2=sol.r2.astype(out_dtype),
+        n_obs=stats.n.astype(out_dtype),
+        month_valid=sol.month_valid,
+    )
+    fms = tuple(
+        jax.vmap(
+            lambda c, _w=w: fama_macbeth_summary(
+                c, nw_lags=nw_lags, min_months=min_months, weight=_w
+            )
+        )(cs)
+        for w in weights
+    )
+    return cs, fms, sol.suspect
+
+
+def run_spec_grid(
+    y,
+    x,
+    universe_masks: Dict[str, object],
+    grid: SpecGrid,
+    referee: bool = True,
+    firm_chunk: Optional[int] = None,
+) -> SpecGridResult:
+    """Solve a whole spec grid from raw panel tensors.
+
+    ``x`` must hold the grid's union predictor columns in
+    ``grid.union_predictors`` order (``run_spec_grid_on_panel`` builds it
+    from a ``DensePanel``). ``universe_masks`` maps universe name →
+    (T, N) bool. With ``referee=True`` (default) any spec containing a
+    suspect month is re-solved by the per-cell batched-QR route, so its
+    numbers are EXACTLY the existing Table 2 path's.
+    """
+    return run_spec_grid_weights(
+        y, x, universe_masks, grid, (grid.weight,),
+        referee=referee, firm_chunk=firm_chunk,
+    )[grid.weight]
+
+
+def run_spec_grid_weights(
+    y,
+    x,
+    universe_masks: Dict[str, object],
+    grid: SpecGrid,
+    weights: Tuple[str, ...],
+    referee: bool = True,
+    firm_chunk: Optional[int] = None,
+) -> Dict[str, SpecGridResult]:
+    """``run_spec_grid`` for several NW weight schemes at once: the panel
+    contraction and Gram solve run ONCE inside one program; each scheme
+    only re-aggregates the tiny per-month series (``grid.weight`` is
+    ignored in favor of ``weights``)."""
+    names = list(universe_masks)
+    y = jnp.asarray(y)
+    x = jnp.asarray(x)
+    universes = jnp.stack([jnp.asarray(universe_masks[n]) for n in names])
+    t = y.shape[0]
+    uidx = jnp.asarray(grid.universe_index(names))
+    col_sel = jnp.asarray(grid.column_selector())
+    window_np = grid.window_masks(t)
+
+    cs, fms, suspect = jax.device_get(
+        _spec_grid_program(
+            y, x, universes, uidx, col_sel, window_np,
+            nw_lags=grid.nw_lags, min_months=grid.min_months,
+            weights=tuple(weights), firm_chunk=firm_chunk,
+        )
+    )
+    suspect_months = np.asarray(suspect).sum(axis=1).astype(np.int64)
+    flagged = []
+    if referee:
+        flagged = [int(s) for s in np.nonzero(suspect_months > 0)[0]]
+
+    out: Dict[str, SpecGridResult] = {}
+    for w, fm in zip(weights, fms):
+        slopes = np.array(cs.slopes)
+        intercept = np.array(cs.intercept)
+        r2 = np.array(cs.r2)
+        n_obs = np.array(cs.n_obs)
+        month_valid = np.array(cs.month_valid)
+        coef = np.array(fm.coef)
+        tstat = np.array(fm.tstat)
+        nw_se = np.array(fm.nw_se)
+        mean_r2 = np.array(fm.mean_r2)
+        mean_n = np.array(fm.mean_n)
+        n_months = np.array(fm.n_months)
+
+        for s in flagged:
+            spec = grid.specs[s]
+            pos = grid.column_positions(spec)
+            mask = universes[uidx[s]] & jnp.asarray(window_np[s])[:, None]
+            PROGRAM_TRACES["specgrid_referee_calls"] += 1
+            ref_cs, ref_fm = jax.device_get(
+                fama_macbeth(
+                    y, x[:, :, jnp.asarray(pos)], mask,
+                    nw_lags=grid.nw_lags, min_months=grid.min_months,
+                    weight=w, solver="qr",
+                )
+            )
+            slopes[s] = np.nan
+            slopes[s][:, pos] = ref_cs.slopes
+            intercept[s] = ref_cs.intercept
+            r2[s] = ref_cs.r2
+            n_obs[s] = ref_cs.n_obs
+            month_valid[s] = ref_cs.month_valid
+            coef[s] = np.nan
+            coef[s][pos] = ref_fm.coef
+            tstat[s] = np.nan
+            tstat[s][pos] = ref_fm.tstat
+            nw_se[s] = np.nan
+            nw_se[s][pos] = ref_fm.nw_se
+            mean_r2[s] = ref_fm.mean_r2
+            mean_n[s] = ref_fm.mean_n
+            n_months[s] = ref_fm.n_months
+
+        out[w] = SpecGridResult(
+            slopes, intercept, r2, n_obs, month_valid,
+            coef, tstat, nw_se, mean_r2, mean_n, n_months,
+            suspect_months.copy(), tuple(flagged),
+        )
+    return out
+
+
+def run_spec_grid_on_panel(
+    panel,
+    subset_masks: Dict[str, object],
+    grid: SpecGrid,
+    return_col: str = "retx",
+    referee: bool = True,
+    firm_chunk: Optional[int] = None,
+) -> SpecGridResult:
+    """``run_spec_grid`` with the union tensor sliced from a DensePanel."""
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(grid.union_predictors))
+    needed = {s.universe for s in grid.specs}
+    masks = {n: m for n, m in subset_masks.items() if n in needed}
+    return run_spec_grid(y, x, masks, grid, referee=referee,
+                         firm_chunk=firm_chunk)

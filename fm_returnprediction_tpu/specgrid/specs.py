@@ -1,0 +1,224 @@
+"""Declarative specification grids — the "as many scenarios as you can
+imagine" workload surface.
+
+A ``Spec`` names one Fama-MacBeth cell: a regressor subset (panel column
+names), a stock universe (a subset-mask name), an optional sample window
+(month-index range) and a free-form scenario tag. A ``SpecGrid`` is an
+ordered batch of specs sharing the FM hyperparameters (NW lags / weight
+scheme / min-months) — the unit the Gram-contraction engine
+(``specgrid.grams`` / ``specgrid.solve``) solves as ONE fused program.
+
+Grid-level vs spec-level dimensions: regressor subset, universe and window
+vary per spec because they only change WHICH (month, firm) cells and Gram
+columns a solve reads; the NW weight scheme and lag count are control flow
+inside the aggregation (string/int statics), so they live on the grid —
+``scenarios.py`` products over them by running one grid per combination.
+
+Presets: ``table2_grid`` reproduces Table 2's 3 models × 3 universes in the
+exact (model-major) cell order ``reporting.table2`` assembles;
+``figure1_grid`` covers the Figure-1 family (the figure's own 5-variable
+set per universe, ``models.lewellen.FIGURE1_VARS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Spec",
+    "SpecGrid",
+    "table2_grid",
+    "figure1_grid",
+    "product_grid",
+    "resolve_route",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """One estimation cell. ``predictors`` are PANEL column names (not
+    display labels); ``universe`` names a subset mask; ``window`` is a
+    half-open ``[start, stop)`` month-index range (None = full sample)."""
+
+    name: str
+    predictors: Tuple[str, ...]
+    universe: str
+    window: Optional[Tuple[int, int]] = None
+    tag: str = ""
+
+    def __post_init__(self):
+        if len(set(self.predictors)) != len(self.predictors):
+            # duplicated columns make the cell structurally rank-deficient;
+            # catch the authoring mistake here, not as a referee fallback
+            raise ValueError(
+                f"spec {self.name!r} repeats a predictor: {self.predictors}"
+            )
+        if self.window is not None:
+            lo, hi = self.window
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"spec {self.name!r} has malformed window {self.window}"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecGrid:
+    """An ordered batch of specs + the shared FM hyperparameters."""
+
+    specs: Tuple[Spec, ...]
+    nw_lags: int = 4
+    min_months: int = 10
+    weight: str = "reference"
+
+    def __post_init__(self):
+        if not self.specs:
+            raise ValueError("a SpecGrid needs at least one spec")
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    @property
+    def union_predictors(self) -> List[str]:
+        """Union of every spec's predictor columns, first-seen order — the
+        column order of the ``x`` tensor the engine contracts."""
+        union: List[str] = []
+        for spec in self.specs:
+            for col in spec.predictors:
+                if col not in union:
+                    union.append(col)
+        return union
+
+    @property
+    def universe_names(self) -> List[str]:
+        """Distinct universes, first-seen order."""
+        names: List[str] = []
+        for spec in self.specs:
+            if spec.universe not in names:
+                names.append(spec.universe)
+        return names
+
+    def column_selector(self) -> np.ndarray:
+        """(S, P) bool: which union columns each spec selects."""
+        union = {c: i for i, c in enumerate(self.union_predictors)}
+        sel = np.zeros((len(self.specs), len(union)), dtype=bool)
+        for s, spec in enumerate(self.specs):
+            for col in spec.predictors:
+                sel[s, union[col]] = True
+        return sel
+
+    def column_positions(self, spec: Spec) -> List[int]:
+        """Union-column indices of one spec's predictors, in spec order."""
+        union = {c: i for i, c in enumerate(self.union_predictors)}
+        return [union[c] for c in spec.predictors]
+
+    def universe_index(self, names: Sequence[str]) -> np.ndarray:
+        """(S,) index of each spec's universe within ``names``."""
+        pos = {n: i for i, n in enumerate(names)}
+        missing = [s.universe for s in self.specs if s.universe not in pos]
+        if missing:
+            raise KeyError(
+                f"specs reference unknown universes {sorted(set(missing))}; "
+                f"available: {list(names)}"
+            )
+        return np.asarray([pos[s.universe] for s in self.specs], np.int32)
+
+    def window_masks(self, n_months: int) -> np.ndarray:
+        """(S, T) bool month-inclusion masks. A window starting beyond the
+        panel is an authoring error (a stale month range), not an empty
+        cell — it raises rather than silently producing an all-NaN spec."""
+        out = np.ones((len(self.specs), n_months), dtype=bool)
+        for s, spec in enumerate(self.specs):
+            if spec.window is not None:
+                lo, hi = spec.window
+                if lo >= n_months:
+                    raise ValueError(
+                        f"spec {spec.name!r} window {spec.window} starts "
+                        f"at or beyond the panel's {n_months} months"
+                    )
+                out[s, :] = False
+                out[s, lo:min(hi, n_months)] = True
+        return out
+
+
+def table2_grid(
+    variables_dict: Dict[str, str],
+    models=None,
+    subsets: Sequence[str] = None,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> SpecGrid:
+    """Table 2's cells, model-major (the order ``build_table_2`` reads):
+    ``specs[mi * len(subsets) + si]`` is (model mi, subset si)."""
+    from fm_returnprediction_tpu.models.lewellen import MODELS, model_columns
+    from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
+
+    models = models if models is not None else MODELS
+    subsets = list(subsets) if subsets is not None else list(SUBSET_ORDER)
+    specs = []
+    for model in models:
+        cols = tuple(model_columns(model, variables_dict))
+        for name in subsets:
+            specs.append(Spec(f"{model.name} | {name}", cols, name))
+    return SpecGrid(tuple(specs), nw_lags=nw_lags,
+                    min_months=min_months, weight=weight)
+
+
+def figure1_grid(
+    subsets: Sequence[str],
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> SpecGrid:
+    """The Figure-1 family: the figure's own 5-variable set per universe."""
+    from fm_returnprediction_tpu.models.lewellen import FIGURE1_VARS
+
+    cols = tuple(FIGURE1_VARS.keys())
+    specs = tuple(Spec(f"figure1 | {name}", cols, name) for name in subsets)
+    return SpecGrid(specs, nw_lags=nw_lags, min_months=min_months,
+                    weight=weight)
+
+
+def product_grid(
+    regressor_sets: Dict[str, Sequence[str]],
+    universes: Sequence[str],
+    windows: Optional[Dict[str, Optional[Tuple[int, int]]]] = None,
+    tag: str = "",
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+) -> SpecGrid:
+    """Cartesian product regressor-set × universe × window → one grid."""
+    windows = windows or {"full": None}
+    specs = []
+    for set_name, cols in regressor_sets.items():
+        for universe in universes:
+            for win_name, win in windows.items():
+                specs.append(
+                    Spec(
+                        f"{set_name} | {universe} | {win_name}",
+                        tuple(cols), universe, window=win, tag=tag,
+                    )
+                )
+    return SpecGrid(tuple(specs), nw_lags=nw_lags,
+                    min_months=min_months, weight=weight)
+
+
+def resolve_route(route: Optional[str] = None, default: str = "gram") -> str:
+    """The reporting-route flag: ``route=`` argument wins, then the
+    ``FMRP_SPECGRID_ROUTE`` env var, then ``default``. "gram" solves the
+    cells from shared Gram sufficient statistics (one fused program, no
+    stacked designs); "stacked" is the pre-existing QR route under the
+    ``reporting.fusion`` split/fuse policy."""
+    import os
+
+    if route is None:
+        route = os.environ.get("FMRP_SPECGRID_ROUTE", default)
+    if route not in ("gram", "stacked"):
+        raise ValueError(
+            f"route={route!r}: expected 'gram' or 'stacked'"
+        )
+    return route

@@ -22,6 +22,8 @@ Stages (task name → targets):
 - ``serve_state`` → ``serving_state.npz`` in PROCESSED_DATA_DIR — the
   warmed online-serving state (``serving.state``), rebuilt only when the
   panel checkpoint changes
+- ``specgrid``    → ``specgrid_scenarios.csv`` in OUTPUT_DIR — the
+  Gram-contraction robustness sweep (``specgrid.run_scenarios``)
 - ``latex``       → compiled report PDF (``pdflatex`` run twice,
   continue-on-error, ``src/calc_Lewellen_2014.py:1197-1209``)
 
@@ -39,12 +41,13 @@ from fm_returnprediction_tpu.taskgraph.engine import Task
 
 __all__ = [
     "build_tasks", "build_notebook_tasks",
-    "PANEL_FILE", "FACTORS_FILE", "SERVING_FILE",
+    "PANEL_FILE", "FACTORS_FILE", "SERVING_FILE", "SPECGRID_FILE",
 ]
 
 PANEL_FILE = "lewellen_panel.npz"
 FACTORS_FILE = "factors_dict.json"
 SERVING_FILE = "serving_state.npz"
+SPECGRID_FILE = "specgrid_scenarios.csv"
 
 
 def _raw_paths(raw_dir: Path) -> List[Path]:
@@ -240,6 +243,29 @@ def _serve_state(processed_dir: Path) -> None:
     )
 
 
+def _specgrid(processed_dir: Path, output_dir: Path) -> None:
+    """Panel checkpoint → spec-grid robustness sweep CSV.
+
+    Runs the Gram-contraction scenario grids (``specgrid.run_scenarios``:
+    subperiod halves × the three size universes × all models) and writes
+    the tidy result frame. Compute is replicated on every process (same
+    contract as ``_reports``); only the primary writes."""
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.specgrid import run_scenarios
+
+    panel = DensePanel.load(processed_dir / PANEL_FILE)
+    with open(processed_dir / FACTORS_FILE) as f:
+        factors_dict = json.load(f)
+    masks = compute_subset_masks(panel)
+    frame = run_scenarios(panel, masks, factors_dict)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    _primary_writes(
+        "specgrid_saved",
+        lambda: frame.to_csv(output_dir / SPECGRID_FILE, index=False),
+    )
+
+
 def _parity(raw_dir: Path, output_dir: Path) -> None:
     """Real-cache Table 1 vs the published Lewellen oracle; records the full
     diff, then raises on any out-of-tolerance cell."""
@@ -334,6 +360,16 @@ def build_tasks(
             targets=[processed_dir / SERVING_FILE],
             task_dep=["build_panel"],
             doc="Panel checkpoint → warmed online-serving state",
+        ),
+        Task(
+            name="specgrid",
+            actions=[lambda: _specgrid(processed_dir, output_dir)],
+            # reads only the panel checkpoint — a reports-only refresh
+            # must not re-run the scenario sweep
+            file_dep=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
+            targets=[output_dir / SPECGRID_FILE],
+            task_dep=["build_panel"],
+            doc="Panel checkpoint → Gram spec-grid robustness sweep CSV",
         ),
         Task(
             name="latex",

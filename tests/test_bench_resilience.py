@@ -87,7 +87,11 @@ bench._bench_daily_fullscale = lambda fast: {}
 bench._bench_pallas = lambda fast: {}
 bench._bench_mesh8 = lambda fast: {}
 bench.main()
-"""
+""",
+        # keep the un-stubbed sections (serving, specgrid, resilience) at
+        # their fast shapes: this test pins emit-line mechanics, not their
+        # numbers, and the small/fuseprobe CPU ladders are fast-gated off
+        FMRP_BENCH_FAST="1",
     )
     assert len(lines) == 1, proc.stdout + proc.stderr
     got = json.loads(lines[0])

@@ -238,6 +238,9 @@ def test_split_route_compiles_once_per_model_shape(world, monkeypatch):
     )
 
     panel, factors, masks, _ = world
+    # pin the pre-existing stacked-QR route: the fusion split policy only
+    # exists there (the default Gram route has no stacked designs to split)
+    monkeypatch.setenv("FMRP_SPECGRID_ROUTE", "stacked")
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "0")  # force the split route
     fama_macbeth.clear_cache()
     build_table_2(panel, masks, factors)
@@ -255,6 +258,7 @@ def test_fusion_split_routes_match_fused(world, monkeypatch):
     from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
 
     panel, factors, masks, _ = world
+    monkeypatch.setenv("FMRP_SPECGRID_ROUTE", "stacked")  # fusion policy path
     monkeypatch.setenv("FMRP_FUSE_SUBSETS_MB", "1048576")  # force fused
     fused_t1 = build_table_1(panel, masks, factors)
     fused_t2 = build_table_2(panel, masks, factors)

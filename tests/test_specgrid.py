@@ -1,0 +1,483 @@
+"""Spec-grid subsystem: Gram-route differentials, additivity, scenarios.
+
+The contract under test (ISSUE 3 acceptance): the Gram-contracted grid
+solve must be numerically equal (≤1e-6; observed ~1e-14 at f64) to the
+per-cell batched-QR route on synthetic panels — including masked/thin
+months — with rank-deficient cells falling back to the QR referee; and the
+Gram contraction must be additive over firm shards (the property that
+makes the chunked accumulation and any future multi-chip psum exact).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+from fm_returnprediction_tpu.specgrid import (
+    Spec,
+    SpecGrid,
+    contract_spec_grams,
+    program_trace_counts,
+    run_spec_grid,
+    subperiod_windows,
+    table2_grid,
+    winsor_variant,
+)
+
+pytestmark = pytest.mark.specgrid
+
+
+def _panel(rng, t=48, n=90, p=6, nan_frac=0.05):
+    x = rng.standard_normal((t, n, p))
+    beta = rng.standard_normal(p) * 0.1
+    y = x @ beta + 0.2 * rng.standard_normal((t, n))
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan)
+    x[rng.random((t, n, p)) < nan_frac] = np.nan
+    size = rng.random(n)
+    masks = {
+        "All": mask,
+        "Big": mask & (size > 0.4)[None, :],
+        "Huge": mask & (size > 0.7)[None, :],
+    }
+    return y, x, masks
+
+
+def _nested_grid(p_sizes=(3, 6), universes=("All", "Big", "Huge"), **kw):
+    names = [f"x{i}" for i in range(max(p_sizes))]
+    specs = tuple(
+        Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in p_sizes for u in universes
+    )
+    return SpecGrid(specs, **kw)
+
+
+def _percell_reference(y, x, masks, grid):
+    """The incumbent route: one batched-QR ``fama_macbeth`` per cell."""
+    out = []
+    t = y.shape[0]
+    for spec in grid.specs:
+        pos = grid.column_positions(spec)
+        w = np.ones(t, bool)
+        if spec.window is not None:
+            w[:] = False
+            w[spec.window[0]:spec.window[1]] = True
+        mask = jnp.asarray(masks[spec.universe] & w[:, None])
+        cs, fm = jax.device_get(
+            fama_macbeth(
+                jnp.asarray(y), jnp.asarray(x[:, :, pos]), mask,
+                nw_lags=grid.nw_lags, min_months=grid.min_months,
+                weight=grid.weight, solver="qr",
+            )
+        )
+        out.append((cs, fm))
+    return out
+
+
+def _assert_close(a, b, atol=1e-6, msg=""):
+    a, b = np.asarray(a, float), np.asarray(b, float)
+    both_nan = np.isnan(a) & np.isnan(b)
+    np.testing.assert_allclose(
+        np.where(both_nan, 0.0, a), np.where(both_nan, 0.0, b),
+        rtol=1e-6, atol=atol, err_msg=msg,
+    )
+
+
+def test_grid_matches_percell_qr_route():
+    """Every (model, universe) cell from the fused Gram program equals the
+    per-cell QR route to well inside 1e-6 — coef, t-stat, NW SE, monthly
+    slopes, R², and the month gate — including thin months."""
+    rng = np.random.default_rng(7)
+    y, x, masks = _panel(rng)
+    # thin months: barely enough complete-case rows for the wide model
+    for m, extra in ((0, 1), (1, 2), (2, 3)):
+        keep = np.zeros(y.shape[1], bool)
+        keep[: 7 + extra] = True
+        y[m, ~keep] = np.nan
+    grid = _nested_grid()
+    res = run_spec_grid(y, x, masks, grid)
+    for s, (cs, fm) in enumerate(_percell_reference(y, x, masks, grid)):
+        pos = grid.column_positions(grid.specs[s])
+        name = grid.specs[s].name
+        _assert_close(res.coef[s, pos], fm.coef, msg=f"{name} coef")
+        _assert_close(res.tstat[s, pos], fm.tstat, msg=f"{name} tstat")
+        _assert_close(res.nw_se[s, pos], fm.nw_se, msg=f"{name} nw_se")
+        _assert_close(res.mean_r2[s], fm.mean_r2, msg=f"{name} r2")
+        _assert_close(res.mean_n[s], fm.mean_n, msg=f"{name} n")
+        assert res.n_months[s] == fm.n_months, name
+        _assert_close(res.slopes[s][:, pos], cs.slopes, msg=f"{name} slopes")
+        # intercepts pin the centered-basis shift recovery (a = a_c − b·c)
+        _assert_close(res.intercept[s], cs.intercept, msg=f"{name} intercept")
+        _assert_close(res.r2[s], cs.r2, msg=f"{name} r2 series")
+        np.testing.assert_array_equal(
+            res.month_valid[s], cs.month_valid, err_msg=name
+        )
+
+
+def test_rank_deficient_cell_falls_back_to_referee():
+    """A collinear predictor pair makes every month of the affected cells
+    rank-deficient at the pinv cutoff: those specs must be flagged and
+    re-solved by the QR referee, landing EXACTLY on the per-cell route;
+    clean specs must not pay the fallback."""
+    rng = np.random.default_rng(11)
+    y, x, masks = _panel(rng, p=5, nan_frac=0.0)
+    x[:, :, 4] = -1.5 * x[:, :, 3]  # exact collinearity
+    names = [f"x{i}" for i in range(5)]
+    grid = SpecGrid((
+        Spec("clean | All", tuple(names[:3]), "All"),
+        Spec("collinear | All", tuple(names), "All"),
+        Spec("collinear | Big", tuple(names), "Big"),
+    ))
+    res = run_spec_grid(y, x, masks, grid)
+    assert res.referee_specs == (1, 2)
+    assert res.suspect_months[0] == 0
+    assert (res.suspect_months[1:] > 0).all()
+    for s, (cs, fm) in enumerate(_percell_reference(y, x, masks, grid)):
+        pos = grid.column_positions(grid.specs[s])
+        name = grid.specs[s].name
+        # referee'd cells are the SAME computation — exact equality
+        if s in res.referee_specs:
+            np.testing.assert_array_equal(
+                res.coef[s, pos], fm.coef, err_msg=name
+            )
+            np.testing.assert_array_equal(
+                res.slopes[s][:, pos], cs.slopes, err_msg=name
+            )
+        else:
+            _assert_close(res.coef[s, pos], fm.coef, msg=name)
+
+
+def test_window_restricts_the_sample():
+    """A windowed spec equals the per-cell run on the window-ANDed mask,
+    and months outside the window never run."""
+    rng = np.random.default_rng(13)
+    y, x, masks = _panel(rng, t=40, p=3)
+    names = ["x0", "x1", "x2"]
+    grid = SpecGrid((
+        Spec("full", tuple(names), "All"),
+        Spec("late", tuple(names), "All", window=(20, 40)),
+    ))
+    res = run_spec_grid(y, x, masks, grid)
+    assert not res.month_valid[1, :20].any()
+    assert res.n_months[1] < res.n_months[0]
+    _, fm = _percell_reference(y, x, masks, grid)[1]
+    pos = grid.column_positions(grid.specs[1])
+    _assert_close(res.coef[1, pos], fm.coef)
+    _assert_close(res.tstat[1, pos], fm.tstat)
+
+
+def test_gram_contraction_additive_over_firm_shards():
+    """Contracting two disjoint firm shards and summing the stats equals
+    contracting the full panel — the additivity the chunked accumulation
+    and the sharded FM path rely on — and the result is firm-chunk
+    invariant."""
+    rng = np.random.default_rng(17)
+    y, x, masks = _panel(rng, t=24, n=64, p=4)
+    grid = _nested_grid(p_sizes=(2, 4))
+    names = list(masks)
+    uni = jnp.stack([jnp.asarray(masks[n]) for n in names])
+    uidx = jnp.asarray(grid.universe_index(names))
+    col_sel = jnp.asarray(grid.column_selector())
+    window = jnp.asarray(grid.window_masks(y.shape[0]))
+
+    full = jax.device_get(contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), uni, uidx, col_sel, window
+    ))
+    # shards must share ONE center (any fixed shift is algebraically
+    # valid); per-shard recomputed centers would break additivity
+    center = jnp.asarray(full.center)
+    half = 64 // 2
+    parts = [
+        jax.device_get(contract_spec_grams(
+            jnp.asarray(y[:, sl]), jnp.asarray(x[:, sl]), uni[:, :, sl],
+            uidx, col_sel, window, center=center,
+        ))
+        for sl in (slice(0, half), slice(half, None))
+    ]
+    additive = ("gram", "moment", "n", "ysum", "yy")
+    for name in additive:
+        np.testing.assert_allclose(
+            getattr(full, name),
+            getattr(parts[0], name) + getattr(parts[1], name),
+            rtol=1e-12, atol=1e-12, err_msg=name,
+        )
+
+    chunked = jax.device_get(contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), uni, uidx, col_sel, window,
+        firm_chunk=17,
+    ))
+    for name in additive + ("center",):
+        np.testing.assert_allclose(getattr(full, name),
+                                   getattr(chunked, name),
+                                   rtol=1e-12, atol=1e-12, err_msg=name)
+
+
+def test_grid_is_one_fused_program():
+    """A clean grid run costs exactly one specgrid program trace and zero
+    referee dispatches; a repeat run at the same shapes costs zero."""
+    rng = np.random.default_rng(19)
+    y, x, masks = _panel(rng, t=30, n=70, p=4, nan_frac=0.0)
+    grid = _nested_grid(p_sizes=(2, 4), universes=("All", "Big"))
+    before = program_trace_counts()
+    res = run_spec_grid(y, x, masks, grid)
+    mid = program_trace_counts()
+    run_spec_grid(y, x, masks, grid)
+    after = program_trace_counts()
+    assert res.referee_specs == ()
+    assert (mid.get("specgrid_program", 0)
+            - before.get("specgrid_program", 0)) == 1
+    assert (after.get("specgrid_referee_calls", 0)
+            == mid.get("specgrid_referee_calls", 0))
+    assert after["specgrid_program"] == mid["specgrid_program"]
+
+
+def _formatted_frames_close(a: pd.DataFrame, b: pd.DataFrame,
+                            tol: float = 1.5e-3) -> None:
+    """Layout-identical and cell-wise equal up to ONE final-digit rounding
+    step: a raw-value difference of ~1e-9 can still flip a ``%.3f`` cell
+    sitting on a 0.0005 boundary, so exact string equality is too strong a
+    contract for cross-route comparison (the raw-value 1e-6 differential
+    in ``test_grid_matches_percell_qr_route`` is the real one)."""
+    assert a.index.equals(b.index)
+    assert a.columns.equals(b.columns)
+    for col in a.columns:
+        for idx in a.index:
+            va, vb = a.loc[idx, col], b.loc[idx, col]
+            if va == vb:
+                continue
+            assert va != "" and vb != "", (idx, col, va, vb)
+            fa = float(str(va).replace(",", ""))
+            fb = float(str(vb).replace(",", ""))
+            assert abs(fa - fb) <= tol, (idx, col, va, vb)
+
+
+def test_build_table_2_gram_equals_stacked_route(monkeypatch):
+    """The rewired Table 2: the Gram route's formatted frame matches the
+    pre-existing stacked/fusion route's cell for cell (up to a final-digit
+    rounding flip on exact ``%.3f`` boundaries; referee'd thin cells are
+    exact)."""
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.panel.characteristics import get_factors
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.panel.transform_compustat import (
+        add_report_date,
+        calc_book_equity,
+        expand_compustat_annual_to_monthly,
+        merge_CRSP_and_Compustat,
+    )
+    from fm_returnprediction_tpu.panel.transform_crsp import (
+        calculate_market_equity,
+    )
+    from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+
+    wrds = generate_synthetic_wrds(SyntheticConfig(n_firms=35, n_months=72))
+    crsp = calculate_market_equity(wrds["crsp_m"])
+    comp = expand_compustat_annual_to_monthly(
+        calc_book_equity(add_report_date(wrds["comp"].copy()))
+    )
+    merged = merge_CRSP_and_Compustat(crsp, comp, wrds["ccm"])
+    merged["mthcaldt"] = merged["jdate"]
+    panel, factors = get_factors(
+        merged, wrds["crsp_d"], wrds["crsp_index_d"]
+    )
+    masks = compute_subset_masks(panel)
+
+    gram_t2 = build_table_2(panel, masks, factors, route="gram")
+    stacked_t2 = build_table_2(panel, masks, factors, route="stacked")
+    _formatted_frames_close(gram_t2, stacked_t2)
+
+    # the figure/decile sweep: per-month cross-sections agree across routes
+    gram_sweep = subset_sweep(panel, masks, list(masks), route="gram")
+    stacked_sweep = subset_sweep(panel, masks, list(masks), route="stacked")
+    assert list(gram_sweep) == list(stacked_sweep)
+    for name in gram_sweep:
+        g, s = gram_sweep[name], stacked_sweep[name]
+        _assert_close(g.cs.slopes, s.cs.slopes, msg=f"{name} slopes")
+        _assert_close(g.cs.r2, s.cs.r2, msg=f"{name} r2")
+        np.testing.assert_array_equal(g.cs.month_valid, s.cs.month_valid)
+        _assert_close(g.rolled, s.rolled, msg=f"{name} rolled")
+        _assert_close(g.deciles.mean_returns, s.deciles.mean_returns,
+                      atol=1e-8, msg=f"{name} deciles")
+        _assert_close(g.deciles.spread, s.deciles.spread,
+                      atol=1e-8, msg=f"{name} spread")
+        assert g.decile_params == s.decile_params
+
+    # env resolution: the flag routes the default path
+    monkeypatch.setenv("FMRP_SPECGRID_ROUTE", "stacked")
+    env_t2 = build_table_2(panel, masks, factors)
+    pd.testing.assert_frame_equal(env_t2, stacked_t2)
+
+
+def test_table2_grid_preset_orders_cells_model_major():
+    from fm_returnprediction_tpu.models.lewellen import MODELS
+    from fm_returnprediction_tpu.panel.subsets import SUBSET_ORDER
+
+    variables = {label: f"c{i}" for i, label in enumerate(
+        {p for m in MODELS for p in m.predictors}
+    )}
+    grid = table2_grid(variables)
+    assert len(grid) == len(MODELS) * len(SUBSET_ORDER)
+    s = grid.specs[1 * len(SUBSET_ORDER) + 2]  # model 2, subset 3
+    assert s.universe == SUBSET_ORDER[2]
+    assert len(s.predictors) == len(MODELS[1].predictors)
+    # union keeps first-seen (model-major) order and covers every model
+    assert len(grid.union_predictors) == len(MODELS[2].predictors)
+
+
+def test_scenarios_frame_shape_and_subperiods():
+    """The scenario sweep emits one tidy row per (spec, predictor), the
+    subperiod cells see fewer months than the full-sample cells, and the
+    winsor/weight dimensions land as columns."""
+    rng = np.random.default_rng(23)
+
+    class _MiniPanel:
+        """Duck-typed stand-in: var/select/mask/months on raw arrays."""
+
+        def __init__(self, y, x, mask, names):
+            self._y, self._x, self.mask = y, x, mask
+            self._names = names
+            self.months = np.arange(y.shape[0])
+
+        def var(self, name):
+            assert name == "retx"
+            return self._y
+
+        def select(self, cols):
+            idx = [self._names.index(c) for c in cols]
+            return self._x[:, :, idx]
+
+    y, x, masks = _panel(rng, t=36, n=60, p=3)
+    names = ["c0", "c1", "c2"]
+    panel = _MiniPanel(y, x, masks["All"], names)
+    variables = {"V0": "c0", "V1": "c1", "V2": "c2"}
+
+    import dataclasses
+
+    from fm_returnprediction_tpu.models.lewellen import ModelSpec
+    from fm_returnprediction_tpu.specgrid import run_scenarios
+
+    models = [ModelSpec("Model A", ["V0", "V1"]),
+              ModelSpec("Model B", ["V0", "V1", "V2"])]
+    frame = run_scenarios(
+        panel, masks, variables, models=models, universes=["All", "Big"],
+        subperiods=2, winsor_levels=(1.0,), weights=("reference", "textbook"),
+    )
+    # 2 models × 2 universes × 3 windows × 2 weights, rows = Σ predictors
+    assert len(frame) == 2 * 3 * 2 * (2 + 3)
+    assert set(frame["window"]) == {"full", "sub1of2", "sub2of2"}
+    assert set(frame["nw_weight"]) == {"reference", "textbook"}
+    full = frame[(frame.window == "full") & (frame.model == "Model A")
+                 & (frame.universe == "All")]
+    sub = frame[(frame.window == "sub1of2") & (frame.model == "Model A")
+                & (frame.universe == "All")]
+    assert (sub["n_months"].to_numpy() < full["n_months"].to_numpy()).all()
+    # dataclasses untouched by the sweep
+    assert dataclasses.is_dataclass(models[0])
+
+
+def test_subperiod_windows_partition():
+    wins = subperiod_windows(601, 3)
+    assert wins["full"] is None
+    spans = [wins[k] for k in wins if k != "full"]
+    assert spans[0][0] == 0 and spans[-1][1] == 601
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
+
+
+def test_winsor_variant_tighter_only():
+    rng = np.random.default_rng(29)
+    x = rng.standard_normal((24, 200, 2))
+    mask = rng.random((24, 200)) > 0.1
+    x[~mask] = np.nan
+    out = np.asarray(winsor_variant(x, mask, 5.0))
+    # tighter clip: support shrinks, NaNs stay NaN, interior untouched
+    assert np.isnan(out).sum() == np.isnan(x).sum()
+    ok = ~np.isnan(x)
+    assert (np.abs(out[ok]) <= np.abs(np.nanmax(np.abs(x))) + 1e-12).all()
+    assert np.nanmax(out) <= np.nanmax(x)
+    with pytest.raises(ValueError):
+        winsor_variant(x, mask, 0.5)  # looser than the stored base clip
+
+
+def test_pipeline_specgrid_hook(tmp_path):
+    """``run_pipeline(make_specgrid=True)`` runs the scenario sweep on the
+    Gram engine, returns the tidy frame, and saves the CSV artifact."""
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    res = run_pipeline(
+        synthetic=True, synthetic_config=SyntheticConfig(30, 48),
+        make_figure=False, make_deciles=False, make_serving=False,
+        make_specgrid=True, compile_pdf=False, output_dir=tmp_path,
+    )
+    frame = res.specgrid_scenarios
+    assert frame is not None and len(frame) > 0
+    assert {"model", "universe", "window", "coef", "tstat",
+            "refereed"} <= set(frame.columns)
+    assert "specgrid" in res.timer.durations
+    assert (tmp_path / "specgrid_scenarios.csv").exists()
+
+
+def test_winsorize_batched_bit_identical_to_per_column():
+    """The satellite: the batched (V, T, N) winsorizer must reproduce the
+    per-column loop bit-for-bit (including the min_obs pass-through and
+    NaN propagation) — it is the same arithmetic, just one launch."""
+    from fm_returnprediction_tpu.ops.quantiles import (
+        winsorize_cs,
+        winsorize_cs_batched,
+    )
+
+    rng = np.random.default_rng(31)
+    t, n, v = 20, 150, 6
+    vals = rng.standard_normal((v, t, n))
+    vals[rng.random((v, t, n)) < 0.1] = np.nan
+    mask = rng.random((t, n)) > 0.15
+    # a min_obs month: fewer than 5 valid rows must pass through unclipped
+    mask[3, 4:] = False
+    vals_j = jnp.asarray(vals)
+    mask_j = jnp.asarray(mask)
+    batched = np.asarray(winsorize_cs_batched(vals_j, mask_j))
+    for k in range(v):
+        single = np.asarray(winsorize_cs(vals_j[k], mask_j))
+        np.testing.assert_array_equal(batched[k], single, err_msg=f"col {k}")
+
+
+def test_enrich_winsorized_matches_split_helpers():
+    """The fused enrich+winsorize program (now on the batched winsorizer)
+    still equals the split append→winsorize→scatter route — to FMA-level
+    rounding: the two programs give XLA different fusion contexts for the
+    interpolation mul-adds, so a handful of entries differ in the last
+    ulp (≤5e-16 observed); anything larger is a real regression."""
+    from fm_returnprediction_tpu.panel.characteristics import (
+        _append_vars,
+        _enrich_winsorized,
+        _scatter_winsorized,
+        _winsorize_columns,
+    )
+
+    rng = np.random.default_rng(37)
+    t, n, k = 18, 40, 3
+    values = rng.standard_normal((t, n, k))
+    mask = rng.random((t, n)) > 0.2
+    values[~mask] = np.nan
+    extras = [rng.standard_normal((t, n)) for _ in range(2)]
+    win_idx = (1, 3)
+
+    fused = np.asarray(_enrich_winsorized(
+        jnp.asarray(values), jnp.asarray(mask),
+        [jnp.asarray(e) for e in extras], win_idx,
+    ))
+    appended = _append_vars(jnp.asarray(values), [jnp.asarray(e) for e in extras])
+    win = _winsorize_columns(appended[:, :, list(win_idx)], jnp.asarray(mask))
+    split = np.asarray(_scatter_winsorized(appended, win, list(win_idx)))
+    both_nan = np.isnan(fused) & np.isnan(split)
+    np.testing.assert_allclose(
+        np.where(both_nan, 0.0, fused), np.where(both_nan, 0.0, split),
+        rtol=0, atol=1e-14,
+    )
