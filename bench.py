@@ -840,6 +840,83 @@ def _bench_resilience(fast: bool):
     return out
 
 
+def _bench_guard(fast: bool):
+    """The guardrail layer's price tag (``guard`` subsystem) — the numbers
+    the README quotes for "free to leave on":
+
+    - ``guard_panel_check_s``     — the whole per-run panel-stage guard
+      cost (one fused probe program + host rule evaluation) vs the warm
+      panel build it guards → ``guard_overhead_panel_pct``.
+    - ``guard_table2_{on,off}_s`` — warm ``build_table_2`` wall-clock with
+      sentinels armed vs disarmed (each configuration pre-compiled; the
+      armed programs carry the counter reductions as extra outputs) →
+      ``guard_overhead_table2_pct``. Acceptance bound: <5%.
+    - ``guard_drift_check_s``     — summarize + tolerance-band compare of
+      Table 2 against a committed audit manifest (the per-artifact drift
+      sentinel cost).
+
+    FMRP_BENCH_GUARD=0 skips."""
+    if os.environ.get("FMRP_BENCH_GUARD", "1") == "0":
+        return {}
+    import tempfile
+
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
+    )
+    from fm_returnprediction_tpu.guard import checks, contracts, drift
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.pipeline import build_panel, resolve_dtype
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+    from fm_returnprediction_tpu.utils.timing import stage_sync
+
+    t, n = (60, 80) if fast else (240, 800)
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=n, n_months=t))
+    t0 = time.perf_counter()
+    panel, factors = build_panel(data, dtype=resolve_dtype())
+    stage_sync(panel.values)
+    build_s = time.perf_counter() - t0
+    masks = compute_subset_masks(panel)
+
+    contracts.check_panel(panel)  # warm the probe program
+    t0 = time.perf_counter()
+    contracts.check_panel(panel)
+    check_s = time.perf_counter() - t0
+
+    def timed_table2(guard_on: bool):
+        with checks.guards(guard_on):
+            build_table_2(panel, masks, factors)  # warm this configuration
+            t0 = time.perf_counter()
+            tab = build_table_2(panel, masks, factors)
+            return time.perf_counter() - t0, tab
+
+    off_s, table_2 = timed_table2(False)
+    on_s, _ = timed_table2(True)
+
+    with tempfile.TemporaryDirectory() as d:
+        base = drift.DriftSentinel(d, "bench")
+        base.check("table_2", drift.summarize_frame(table_2))
+        base.commit()
+        t0 = time.perf_counter()
+        probe = drift.DriftSentinel(d, "bench")
+        drifted = probe.check("table_2", drift.summarize_frame(table_2))
+        drift_s = time.perf_counter() - t0
+        assert drifted == []  # identical table: sha short-circuit
+
+    return {
+        "guard_panel_build_s": round(build_s, 4),
+        "guard_panel_check_s": round(check_s, 4),
+        "guard_overhead_panel_pct": round(100.0 * check_s / build_s, 2),
+        "guard_table2_off_s": round(off_s, 4),
+        "guard_table2_on_s": round(on_s, 4),
+        "guard_overhead_table2_pct": round(
+            100.0 * (on_s - off_s) / off_s, 2
+        ),
+        "guard_drift_check_s": round(drift_s, 4),
+        "guard_shape": f"T{t}_N{n}",
+    }
+
+
 def _jax_cache_stats() -> dict:
     """Entry count + bytes of the persistent XLA compilation cache
     (``_cache/jax``) — the artifact-side evidence for whether the split
@@ -1162,6 +1239,7 @@ def main() -> None:
         sections.append(_bench_serving)
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
+    sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_fuseprobe)  # real ladder on TPU, small on CPU
     sections.append(_bench_mesh8)  # real shape when _MESH8=1, small else
 

@@ -1,0 +1,95 @@
+"""Data-integrity guardrail layer: contracts, drift sentinels, numerical guards.
+
+The robustness gap PR 2's resilience layer left open is failures that stay
+SILENT — a duplicated permno-month, a stale or permuted cross-section, an
+f32 overflow inside a fused Gram contraction — which flow straight into
+Table 2 t-stats without a tripwire. Three pieces close it:
+
+- :mod:`.contracts` — declarative invariant contracts (schema/dtype, key
+  uniqueness, calendar monotonicity, value/return bounds, NaN budgets,
+  mask sanity) evaluated at every stage boundary of ``run_pipeline`` and
+  the task graph, with a ``fail``/``quarantine``/``warn`` severity ladder
+  that reuses the resilience layer's typed errors and the serving
+  quarantine machinery. The run-level :class:`~.contracts.AuditRecord`
+  collects every violation and counter.
+- :mod:`.drift` — tolerance-banded comparison of each persisted artifact
+  (dense panel stats, tables, ``specgrid_scenarios``, ``serving_state``)
+  against the previous run's audit manifest (sha256 + summary moments), so
+  a change that silently moves slopes beyond band fails loudly with a
+  per-column report (``run_pipeline(audit_dir=...)`` / ``--audit-dir``).
+- :mod:`.checks` — jit-safe numerical sentinels (finite/overflow counters,
+  condition-number taps) riding inside the OLS/FM/NW/Gram programs as
+  extra integer outputs: byte-for-byte no-ops when ``FMRP_GUARD=off``,
+  zero extra programs/retraces when on.
+
+Everything is free to leave enabled: contracts price one fused probe
+program per guarded stage, sentinels a few integer reductions inside
+programs that already exist (measured by ``bench.py``'s ``guard_*``
+section), and a clean run's artifacts are bit-identical guarded or not.
+"""
+
+from fm_returnprediction_tpu.guard.checks import (
+    counters,
+    drain,
+    guard_active,
+    guards,
+    reset,
+    set_guard,
+)
+from fm_returnprediction_tpu.guard.contracts import (
+    AuditRecord,
+    GuardWarning,
+    Rule,
+    Violation,
+    check_frame,
+    check_panel,
+    cross_section_rules,
+    enforce,
+    evaluate,
+    frame_rules,
+    panel_probe,
+    panel_rules,
+    screen_artifact,
+    serving_state_rules,
+)
+from fm_returnprediction_tpu.guard.drift import (
+    DriftBand,
+    DriftSentinel,
+    compare_summaries,
+    summarize_arrays,
+    summarize_frame,
+)
+from fm_returnprediction_tpu.resilience.errors import (
+    ContractViolationError,
+    DriftDetectedError,
+)
+
+__all__ = [
+    "AuditRecord",
+    "ContractViolationError",
+    "DriftBand",
+    "DriftDetectedError",
+    "DriftSentinel",
+    "GuardWarning",
+    "Rule",
+    "Violation",
+    "check_frame",
+    "check_panel",
+    "compare_summaries",
+    "counters",
+    "cross_section_rules",
+    "drain",
+    "enforce",
+    "evaluate",
+    "frame_rules",
+    "guard_active",
+    "guards",
+    "panel_probe",
+    "panel_rules",
+    "reset",
+    "screen_artifact",
+    "serving_state_rules",
+    "set_guard",
+    "summarize_arrays",
+    "summarize_frame",
+]

@@ -1,0 +1,231 @@
+"""Jit-safe numerical sentinels for the OLS/FM/NW/Gram hot paths.
+
+The silent numerical failures the contracts layer cannot see from the host
+— an f32 Gram contraction overflowing to ``inf``, a month whose solve went
+non-finite, a design whose conditioning ate the answer — happen INSIDE
+compiled programs. The sentinels here ride along in those programs as
+extra (tiny, integer) outputs and fold into the process-wide audit
+counters at the host boundary:
+
+- when guards are OFF the sentinel helpers are never traced at all — the
+  hot-path modules gate on :func:`guard_active` at TRACE time, so the
+  guard-off jaxpr is byte-for-byte the unguarded program (verified by the
+  ``guard`` property tests, which also pin bit-identical outputs and
+  unchanged trace counts either way);
+- when guards are ON the counters are computed inside the SAME compiled
+  program (no extra programs, no callbacks, no host syncs) and recorded
+  lazily as device scalars; :func:`drain` pulls them in one
+  ``device_get`` when the audit record is assembled;
+- a guarded entry point called INSIDE another trace (``fama_macbeth``'s
+  program calls ``monthly_cs_ols``) sees tracer counters and skips the
+  record — the outermost host boundary owns the accounting and the inner
+  counter math is dead code the compiler eliminates. That is what makes
+  the sentinels safe to leave in jitted code unconditionally.
+
+The switch is ``FMRP_GUARD`` (default on; ``off``/``0``/``false``
+disables), overridable per call via the ``guard=`` parameter the
+instrumented entry points expose and per block via :func:`guards`.
+Because the flag is a STATIC argument of the instrumented programs,
+toggling it selects a different cached executable instead of silently
+serving a stale trace.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "guard_active",
+    "guards",
+    "set_guard",
+    "record",
+    "record_cs_host",
+    "record_fm_host",
+    "drain",
+    "counters",
+    "reset",
+    "nonfinite_count",
+    "cs_counters",
+    "fm_counters",
+    "cond_limit",
+]
+
+
+def _env_default() -> bool:
+    raw = os.environ.get("FMRP_GUARD", "on").strip().lower()
+    return raw not in ("off", "0", "false", "no")
+
+
+_ENABLED: bool = _env_default()
+_LOCK = threading.Lock()
+# (site, {counter_name: scalar}) pairs; values may be live device scalars —
+# folded (one device_get) by drain(). Bounded: record() folds eagerly past
+# _PENDING_CAP so a long guarded run cannot hoard device buffers.
+_PENDING: list = []
+_PENDING_CAP = 1024
+_COUNTERS: collections.Counter = collections.Counter()
+
+
+def guard_active() -> bool:
+    """Whether numerical sentinels are armed (trace-time read)."""
+    return _ENABLED
+
+
+def set_guard(enabled: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(enabled)
+
+
+@contextlib.contextmanager
+def guards(enabled: bool):
+    """Force sentinels on/off for a block (``run_pipeline``'s ``guard=`` and
+    the bench's guarded-vs-unguarded comparison both use this)."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(enabled)
+    try:
+        yield
+    finally:
+        _ENABLED = prev
+
+
+# -- the audit accumulator -------------------------------------------------
+
+
+def record(site: str, values: Dict[str, object]) -> None:
+    """Queue one guarded call's counters under ``site``.
+
+    ``values`` maps counter name → scalar (device array, numpy, or int).
+    Tracer values mean the caller is being inlined inside an outer trace —
+    the outer host boundary owns the accounting, so the record is skipped
+    (and the counter math is unused → dead-code-eliminated)."""
+    import jax
+
+    if any(isinstance(v, jax.core.Tracer) for v in values.values()):
+        return
+    with _LOCK:
+        _PENDING.append((site, values))
+        overflow = len(_PENDING) >= _PENDING_CAP
+    if overflow:
+        drain()
+
+
+def record_cs_host(site: str, cs) -> None:
+    """Host-side solve sentinel over a device-pulled (numpy-leaf)
+    ``CSRegressionResult`` — the accounting for FUSED sweep programs whose
+    inner ``monthly_cs_ols`` records were skipped under the outer trace
+    (the figure/decile sweep, the stacked Table 2 route). Handles extra
+    leading batch axes (subset-stacked leaves)."""
+    if not guard_active():
+        return
+    import numpy as np
+
+    valid = np.asarray(cs.month_valid)
+    bad = np.any(~np.isfinite(np.asarray(cs.slopes)), axis=-1) | ~np.isfinite(
+        np.asarray(cs.intercept)
+    )
+    record(site, {
+        "nonfinite_solve_months": int((valid & bad).sum()),
+        "nonfinite_r2_months": int(
+            (valid & ~np.isfinite(np.asarray(cs.r2))).sum()
+        ),
+    })
+
+
+def record_fm_host(site: str, fm) -> None:
+    """Host-side NW tap over a device-pulled ``FamaMacbethSummary`` (same
+    counting rule as :func:`fm_counters`: INFINITE t-stats only)."""
+    if not guard_active():
+        return
+    import numpy as np
+
+    record(site, {
+        "infinite_tstat_cols": int(np.isinf(np.asarray(fm.tstat)).sum()),
+    })
+
+
+def drain() -> Dict[str, int]:
+    """Fold every pending record into the process counters (ONE
+    ``device_get`` for all pending device scalars) and return a snapshot.
+    Counter keys are ``"<site>.<name>"``; zero counts are dropped — the
+    audit record lists violations, not visits."""
+    import jax
+
+    with _LOCK:
+        pending, _PENDING[:] = list(_PENDING), []
+    if pending:
+        pulled = jax.device_get([v for _, v in pending])
+        with _LOCK:
+            for (site, _), values in zip(pending, pulled):
+                for name, val in values.items():
+                    count = int(val)
+                    if count:
+                        _COUNTERS[f"{site}.{name}"] += count
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the accumulated sentinel counters (drains first)."""
+    return drain()
+
+
+def reset() -> None:
+    """Clear pending records and accumulated counters (test isolation)."""
+    with _LOCK:
+        _PENDING[:] = []
+        _COUNTERS.clear()
+
+
+# -- traced counter helpers (call only from inside guarded programs) -------
+
+
+def cond_limit(dtype) -> float:
+    """The shared conditioning threshold: ``1/sqrt(eps)`` of the compute
+    dtype — beyond it a Gram/QR solve has lost half the mantissa
+    (same policy as the specgrid referee's f64 tier)."""
+    import math
+
+    import jax.numpy as jnp
+
+    return 1.0 / math.sqrt(float(jnp.finfo(dtype).eps))
+
+
+def nonfinite_count(x):
+    """Number of non-finite entries of ``x`` (overflow/poison sentinel)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(~jnp.isfinite(x))
+
+
+def cs_counters(cs) -> Dict[str, object]:
+    """Sentinels over a ``CSRegressionResult``: months that RAN but whose
+    solve or R² came back non-finite (a month skipped for thinness is
+    legal and not counted)."""
+    import jax.numpy as jnp
+
+    valid = cs.month_valid
+    bad_solve = jnp.any(~jnp.isfinite(cs.slopes), axis=-1) | ~jnp.isfinite(
+        cs.intercept
+    )
+    return {
+        "nonfinite_solve_months": jnp.sum(valid & bad_solve),
+        "nonfinite_r2_months": jnp.sum(valid & ~jnp.isfinite(cs.r2)),
+    }
+
+
+def fm_counters(fm) -> Dict[str, object]:
+    """Sentinel over a ``FamaMacbethSummary`` (the NW-path tap): INFINITE
+    t-stats — a zero long-run variance, i.e. a degenerate slope series
+    (the signature a stale repeated cross-section leaves behind). NaN
+    t-stats are deliberately NOT counted: a negative small-sample HAC
+    variance estimate legally yields NaN (the reference's blank cell)."""
+    import jax.numpy as jnp
+
+    return {
+        "infinite_tstat_cols": jnp.sum(jnp.isinf(fm.tstat)),
+    }

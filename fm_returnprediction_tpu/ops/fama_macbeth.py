@@ -23,6 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from fm_returnprediction_tpu.guard import checks as _guard
 from fm_returnprediction_tpu.ops.newey_west import nw_mean_se
 from fm_returnprediction_tpu.ops.ols import CSRegressionResult, monthly_cs_ols
 
@@ -87,8 +88,31 @@ def fama_macbeth_summary(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("nw_lags", "min_months", "weight", "solver")
+    jax.jit, static_argnames=("nw_lags", "min_months", "weight", "solver", "guard")
 )
+def _fama_macbeth(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    weight: str = "reference",
+    solver: str = "qr",
+    guard: bool = False,
+):
+    """The compiled program behind :func:`fama_macbeth`. ``guard`` is
+    static: the sentinel counters (OLS solve finiteness + the NW-path
+    t-stat tap) ride along as extra integer outputs; with ``guard=False``
+    the jaxpr is the unguarded program."""
+    cs = monthly_cs_ols(y, x, mask, solver=solver)
+    fm = fama_macbeth_summary(
+        cs, nw_lags=nw_lags, min_months=min_months, weight=weight
+    )
+    if guard:
+        return cs, fm, {**_guard.cs_counters(cs), **_guard.fm_counters(fm)}
+    return cs, fm
+
+
 def fama_macbeth(
     y: jnp.ndarray,
     x: jnp.ndarray,
@@ -97,9 +121,27 @@ def fama_macbeth(
     min_months: int = 10,
     weight: str = "reference",
     solver: str = "qr",
+    guard=None,
 ) -> tuple[CSRegressionResult, FamaMacbethSummary]:
-    """End-to-end FM: batched monthly OLS + aggregation, one jittable call."""
-    cs = monthly_cs_ols(y, x, mask, solver=solver)
-    return cs, fama_macbeth_summary(
-        cs, nw_lags=nw_lags, min_months=min_months, weight=weight
+    """End-to-end FM: batched monthly OLS + aggregation, one jittable call.
+
+    ``guard=None`` follows the global ``FMRP_GUARD`` switch
+    (``guard.checks``): when armed, non-finite solves and NW t-stat
+    failures accumulate into the process audit counters — same program,
+    bit-identical estimates, recording skipped under an outer trace."""
+    guard = _guard.guard_active() if guard is None else bool(guard)
+    out = _fama_macbeth(
+        y, x, mask, nw_lags=nw_lags, min_months=min_months, weight=weight,
+        solver=solver, guard=guard,
     )
+    if guard:
+        cs, fm, counters = out
+        _guard.record("ols.fama_macbeth", counters)
+        return cs, fm
+    return out
+
+
+# jit-object conveniences forwarded for callers that manage the cache
+# (``tests/test_reporting.py`` pins the split route's compile count)
+fama_macbeth.clear_cache = _fama_macbeth.clear_cache
+fama_macbeth._cache_size = _fama_macbeth._cache_size

@@ -27,11 +27,14 @@ f32 matmuls out of bf16 truncation so single-chip f32 runs stay within the
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from fm_returnprediction_tpu.guard import checks as _guard
 
 __all__ = [
     "CSRegressionResult",
@@ -45,6 +48,12 @@ __all__ = [
 ]
 
 _PRECISION = jax.lax.Precision.HIGHEST
+
+# name -> jit traces since process start (trace ≈ compile for a fixed shape
+# signature) — the guard property tests pin that arming the sentinels does
+# not retrace the hot path (same counting discipline as
+# ``specgrid.solve.PROGRAM_TRACES``).
+TRACES: collections.Counter = collections.Counter()
 
 
 class CSRegressionResult(NamedTuple):
@@ -145,7 +154,7 @@ def solve_from_stats(stats: NormalStats):
     return beta[..., 1:], beta[..., 0], r2, n, month_valid
 
 
-def _solve_month(y, x, valid, solver="qr"):
+def _solve_month(y, x, valid, solver="qr", guard=False):
     """One month's masked OLS. Shapes: y (N,), x (N, P), valid (N,) bool.
 
     ``solver="lstsq"``: SVD least squares on the zero-padded design
@@ -175,9 +184,24 @@ def _solve_month(y, x, valid, solver="qr"):
     multi-chip path psums). One big MXU einsum + tiny (P+1)² pinv — much
     faster, but squares the condition number, so ill-conditioned months can
     drift from the reference.
+
+    ``guard`` (trace-time static) appends a dict of numerical-sentinel
+    scalars — non-finite Gram entries on the normal route, a triangular
+    condition proxy ``max|r_ii|/min|r_ii|`` on the QR route — consumed by
+    the guarded ``monthly_cs_ols`` program (``guard.checks``). With
+    ``guard=False`` nothing here changes: the jaxpr is the unguarded one.
     """
     if solver == "normal":
-        return solve_from_stats(sufficient_stats(y, x, valid))
+        stats = sufficient_stats(y, x, valid)
+        out = solve_from_stats(stats)
+        if guard:
+            extras = {
+                "gram_nonfinite": _guard.nonfinite_count(stats.gram)
+                + _guard.nonfinite_count(stats.moment),
+                "cond_proxy": jnp.zeros((), x.dtype),
+            }
+            return (*out, extras)
+        return out
     if solver not in ("lstsq", "qr"):
         raise ValueError(f"Unknown solver: {solver}")
 
@@ -213,12 +237,64 @@ def _solve_month(y, x, valid, solver="qr"):
     r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
     r2 = jnp.where(month_valid, r2, 0.0)  # NaN sse (non-finite solve) flows
 
+    if guard:
+        if solver == "qr":
+            # triangular condition proxy: cond(R_x) ≥ max|r_ii|/min|r_ii|
+            # — the design's conditioning, priced from the R factor the
+            # solve already computed
+            rd = jnp.abs(jnp.diagonal(r[:, :-1]))
+            tiny = jnp.asarray(jnp.finfo(x_aug.dtype).tiny, x_aug.dtype)
+            cond_proxy = rd.max() / jnp.maximum(rd.min(), tiny)
+        else:
+            cond_proxy = jnp.zeros((), x_aug.dtype)
+        extras = {
+            "gram_nonfinite": jnp.zeros((), jnp.int32),
+            "cond_proxy": cond_proxy,
+        }
+        return beta[1:], beta[0], r2, n, month_valid, extras
     return beta[1:], beta[0], r2, n, month_valid
 
 
-@functools.partial(jax.jit, static_argnames=("solver",))
+@functools.partial(jax.jit, static_argnames=("solver", "guard"))
+def _monthly_cs_ols(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    solver: str = "qr",
+    guard: bool = False,
+):
+    """The compiled program behind :func:`monthly_cs_ols`. ``guard`` is a
+    STATIC argument, so arming the sentinels selects a different cached
+    executable (one trace per configuration) instead of silently reusing a
+    sentinel-less trace; with ``guard=False`` the jaxpr is byte-for-byte
+    the unguarded program (pinned by the guard property tests)."""
+    TRACES["monthly_cs_ols"] += 1  # trace-time side effect
+    valid = row_validity(y, x, mask)
+    out = jax.vmap(
+        lambda yy, xx, vv: _solve_month(yy, xx, vv, solver=solver, guard=guard)
+    )(y, x, valid)
+    if guard:
+        slopes, intercept, r2, n_obs, month_valid, extras = out
+        cs = CSRegressionResult(slopes, intercept, r2, n_obs, month_valid)
+        limit = _guard.cond_limit(x.dtype)
+        counters = {
+            **_guard.cs_counters(cs),
+            "gram_nonfinite_entries": extras["gram_nonfinite"].sum(),
+            "cond_exceeded_months": jnp.sum(
+                month_valid & (extras["cond_proxy"] > limit)
+            ),
+        }
+        return cs, counters
+    slopes, intercept, r2, n_obs, month_valid = out
+    return CSRegressionResult(slopes, intercept, r2, n_obs, month_valid)
+
+
 def monthly_cs_ols(
-    y: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray, solver: str = "qr"
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    solver: str = "qr",
+    guard=None,
 ) -> CSRegressionResult:
     """Run every month's cross-sectional regression in one batched call
     (jitted: one compiled program, one dispatch — library calls stay off the
@@ -229,6 +305,13 @@ def monthly_cs_ols(
     y : (T, N) returns per month × firm slot.
     x : (T, N, P) lagged predictors.
     mask : (T, N) bool, firm-month row exists.
+    guard : arm the numerical sentinels (``guard.checks``): non-finite
+        solves/R², Gram overflow, condition-proxy exceedances accumulate
+        into the process audit counters. ``None`` follows the global
+        ``FMRP_GUARD`` switch. Sentinels ride the same compiled program as
+        extra integer outputs — results are bit-identical either way, and
+        recording is skipped (counter math dead-code-eliminated) when this
+        call is inlined inside an outer trace.
 
     Returns
     -------
@@ -236,8 +319,16 @@ def monthly_cs_ols(
     ``month_valid=False`` (downstream reductions gate on it, mirroring the
     reference's "skip month" continue at ``src/regressions.py:52-54``).
     """
-    valid = row_validity(y, x, mask)
-    slopes, intercept, r2, n_obs, month_valid = jax.vmap(
-        lambda yy, xx, vv: _solve_month(yy, xx, vv, solver=solver)
-    )(y, x, valid)
-    return CSRegressionResult(slopes, intercept, r2, n_obs, month_valid)
+    guard = _guard.guard_active() if guard is None else bool(guard)
+    out = _monthly_cs_ols(y, x, mask, solver=solver, guard=guard)
+    if guard:
+        cs, counters = out
+        _guard.record("ols.monthly_cs_ols", counters)
+        return cs
+    return out
+
+
+# jit-object conveniences forwarded for callers that manage the cache
+# (e.g. compile-count tests); both names address the SAME executable cache
+monthly_cs_ols.clear_cache = _monthly_cs_ols.clear_cache
+monthly_cs_ols._cache_size = _monthly_cs_ols._cache_size

@@ -116,6 +116,10 @@ class PipelineResult:
     # lagged rolling-mean slopes/intercepts, support bounds, additive OLS
     # sufficient statistics — so serving never re-runs the fit
     serving_state: Optional[object] = None
+    # the guard ledger (guard.contracts.AuditRecord): every contract
+    # violation, numerical sentinel counter, and quarantined artifact the
+    # run's guardrails recorded (empty when clean or guards disabled)
+    audit: Optional[object] = None
 
 
 # The daily stage consumes only (permno, dlycaldt, retx); the universe
@@ -308,6 +312,8 @@ def run_pipeline(
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
     checkpoint_dir=None,
+    guard: Optional[bool] = None,
+    audit_dir=None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
 
@@ -323,7 +329,74 @@ def run_pipeline(
     corrupt stage artifacts (checksum-verified) silently degrade to
     recompute. The panel build itself is covered by the prepared-inputs
     checkpoint (``data.prepared``); Figure 1 is not checkpointed (a
-    matplotlib artifact whose cross-sections ride the shared sweep)."""
+    matplotlib artifact whose cross-sections ride the shared sweep).
+
+    ``guard`` arms the data-integrity guardrails (``guard`` subsystem;
+    ``None`` follows ``FMRP_GUARD``, default on): stage-boundary invariant
+    contracts on the panel and every report artifact, plus the numerical
+    sentinels inside the OLS/FM/Gram programs. Violations apply the
+    severity ladder — ``fail`` raises ``ContractViolationError``,
+    ``quarantine`` drops the optional artifact and continues degraded,
+    ``warn`` warns — and everything lands in ``PipelineResult.audit``.
+    Guards change NO numbers: a clean run's artifacts are bit-identical
+    with guards on or off (pinned by the ``guard`` property tests).
+
+    ``audit_dir`` additionally arms the drift sentinel (``guard.drift``):
+    this run's artifact summaries (sha256 + per-column moments) are
+    compared against the previous same-fingerprint run's audit manifest —
+    any moment outside the tolerance band raises ``DriftDetectedError``
+    with a per-column report (after artifacts are saved, and without
+    overwriting the trusted manifest) — then the manifest is updated."""
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+
+    if guard is None:
+        guard = _guard_checks.guard_active()
+    with _guard_checks.guards(bool(guard)):
+        return _run_pipeline_guarded(
+            raw_data_dir=raw_data_dir,
+            output_dir=output_dir,
+            synthetic=synthetic,
+            synthetic_config=synthetic_config,
+            dtype=dtype,
+            make_figure=make_figure,
+            compile_pdf=compile_pdf,
+            make_deciles=make_deciles,
+            make_bootstrap=make_bootstrap,
+            make_serving=make_serving,
+            make_specgrid=make_specgrid,
+            bootstrap_replicates=bootstrap_replicates,
+            use_mesh=use_mesh,
+            checkpoint_dir=checkpoint_dir,
+            guard=bool(guard),
+            audit_dir=audit_dir,
+        )
+
+
+def _run_pipeline_guarded(
+    raw_data_dir,
+    output_dir,
+    synthetic,
+    synthetic_config,
+    dtype,
+    make_figure,
+    compile_pdf,
+    make_deciles,
+    make_bootstrap,
+    make_serving,
+    make_specgrid,
+    bootstrap_replicates,
+    use_mesh,
+    checkpoint_dir,
+    guard,
+    audit_dir,
+) -> PipelineResult:
+    """``run_pipeline``'s body, entered with the sentinel switch already
+    pinned to ``guard`` for the whole run (``guard.checks.guards``)."""
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.guard import contracts as _contracts
+
+    audit = _contracts.AuditRecord()
+    counters_before = _guard_checks.drain() if guard else {}
     if dtype is None:
         dtype = resolve_dtype()
     timer = StageTimer()
@@ -372,11 +445,48 @@ def run_pipeline(
             raw_data_dir, dtype=dtype, mesh=mesh, timer=timer
         )
 
+    from fm_returnprediction_tpu.resilience.faults import fault_site
+
+    # chaos payload site: a plan can hand back a CORRUPTED panel here
+    # (duplicated permno, permuted firm axis, stale month, scale spike) —
+    # the contract boundary right below must catch it at its declared
+    # severity (tests/test_chaos.py)
+    panel = fault_site("pipeline.panel", payload=panel)
+
+    panel_stats = None
+    if guard:
+        with timer.stage("guard/panel_contracts"):
+            # one fused probe program; the summary doubles as the drift
+            # sentinel's panel_stats artifact
+            panel_stats = _contracts.check_panel(panel, dtype=dtype,
+                                                 audit=audit)
+
     with timer.stage("subset_masks"):
         subset_masks = compute_subset_masks(panel)
         stage_sync(subset_masks)
 
-    from fm_returnprediction_tpu.resilience.faults import fault_site
+    _salt_cache = {}
+
+    def _provenance_salt():
+        """Data-provenance salt shared by the stage checkpointer and the
+        drift sentinel: same raw data + config ⇒ same fingerprint slot.
+        Memoized — on real data this hashes the raw parquet files, which
+        must not be paid twice when both consumers are armed."""
+        if "salt" not in _salt_cache:
+            if synthetic:
+                import json as _json
+
+                cfg = synthetic_config or SyntheticConfig()
+                _salt_cache["salt"] = _json.dumps(
+                    vars(cfg), sort_keys=True, default=str
+                )
+            else:
+                from fm_returnprediction_tpu.data.prepared import (
+                    raw_fingerprint,
+                )
+
+                _salt_cache["salt"] = raw_fingerprint(raw_data_dir, dtype)
+        return _salt_cache["salt"]
 
     ckpt = None
     if checkpoint_dir is not None:
@@ -399,21 +509,12 @@ def run_pipeline(
                 )
                 checkpoint_dir = None
     if checkpoint_dir is not None:
-        import json as _json
-
         from fm_returnprediction_tpu.resilience.checkpoint import (
             StageCheckpointer,
         )
 
-        if synthetic:
-            cfg = synthetic_config or SyntheticConfig()
-            salt = _json.dumps(vars(cfg), sort_keys=True, default=str)
-        else:
-            from fm_returnprediction_tpu.data.prepared import raw_fingerprint
-
-            salt = raw_fingerprint(raw_data_dir, dtype)
         ckpt = StageCheckpointer(
-            checkpoint_dir, _pipeline_fingerprint(panel, dtype, salt)
+            checkpoint_dir, _pipeline_fingerprint(panel, dtype, _provenance_salt())
         )
 
     def _frame_stage(name, compute):
@@ -433,12 +534,16 @@ def run_pipeline(
         table_1 = _frame_stage(
             "table_1", lambda: build_table_1(panel, subset_masks, factors_dict)
         )
+        if guard:  # contract applies to checkpoint-loaded frames too
+            _contracts.check_frame(table_1, "table_1", audit)
 
     with timer.stage("table_2"):
         table_2 = _frame_stage(
             "table_2",
             lambda: build_table_2(panel, subset_masks, factors_dict, mesh=mesh),
         )
+        if guard:
+            _contracts.check_frame(table_2, "table_2", audit)
 
     # The figure and decile paths share the same per-subset batched OLS on
     # the figure's 5-variable set — ONE fused program computes OLS, rolling
@@ -476,6 +581,14 @@ def run_pipeline(
                     panel, subset_masks, cs_cache=cs_cache
                 ),
             )
+            if guard:
+                decile_table = _contracts.screen_artifact(
+                    "decile_table", decile_table,
+                    _contracts.frame_rules(
+                        "decile_table", blocking="quarantine"
+                    ),
+                    audit,
+                )
 
     serving_state = None
     if make_serving and "All stocks" in subset_masks:
@@ -507,6 +620,13 @@ def run_pipeline(
                     loader=ServingState.load,
                     suffix=".npz",
                 )
+            if guard:
+                # optional artifact: a quarantine-severity violation drops
+                # it (run completes degraded, ledgered in the audit)
+                serving_state = _contracts.screen_artifact(
+                    "serving_state", serving_state,
+                    _contracts.serving_state_rules(), audit,
+                )
 
     specgrid_scenarios = None
     if make_specgrid:
@@ -519,6 +639,14 @@ def run_pipeline(
                 "specgrid_scenarios",
                 lambda: run_scenarios(panel, subset_masks, factors_dict),
             )
+            if guard:
+                specgrid_scenarios = _contracts.screen_artifact(
+                    "specgrid_scenarios", specgrid_scenarios,
+                    _contracts.frame_rules(
+                        "specgrid_scenarios", blocking="quarantine"
+                    ),
+                    audit,
+                )
 
     bootstrap_table = None
     if make_bootstrap:
@@ -562,6 +690,52 @@ def run_pipeline(
             if tex is not None and compile_pdf:
                 compile_latex_document(tex)
 
+    if guard:
+        # fold this run's numerical sentinel counters (OLS/FM/Gram
+        # programs) into the audit record — counters are process-global,
+        # so diff against the pre-run snapshot
+        ended = _guard_checks.drain()
+        audit.record_counters({
+            k: v - counters_before.get(k, 0) for k, v in ended.items()
+        })
+
+    if audit_dir is not None and jax.process_index() == 0:
+        # drift sentinel AFTER artifacts are saved: a drifted run's outputs
+        # stay on disk for inspection while the TRUSTED manifest survives
+        from fm_returnprediction_tpu.guard.drift import (
+            DriftSentinel,
+            summarize_arrays,
+            summarize_frame,
+        )
+
+        with timer.stage("guard/drift"):
+            sentinel = DriftSentinel(
+                audit_dir,
+                _pipeline_fingerprint(panel, dtype, _provenance_salt()),
+            )
+            if panel_stats is None:
+                panel_stats = _contracts.panel_probe(panel)
+            sentinel.check("panel_stats", panel_stats)
+            sentinel.check("table_1", summarize_frame(table_1))
+            sentinel.check("table_2", summarize_frame(table_2))
+            if decile_table is not None:
+                sentinel.check("decile_table", summarize_frame(decile_table))
+            if specgrid_scenarios is not None:
+                sentinel.check(
+                    "specgrid_scenarios", summarize_frame(specgrid_scenarios)
+                )
+            if serving_state is not None:
+                sentinel.check("serving_state", summarize_arrays({
+                    "coef": serving_state.coef,
+                    "slopes_bar": serving_state.slopes_bar,
+                    "intercept_bar": serving_state.intercept_bar,
+                    "gram": serving_state.gram,
+                    "moment": serving_state.moment,
+                    "n_obs": serving_state.n_obs,
+                }))
+            sentinel.raise_on_drift(audit)
+            sentinel.commit(audit)
+
     return PipelineResult(
         panel=panel,
         factors_dict=factors_dict,
@@ -574,6 +748,7 @@ def run_pipeline(
         bootstrap_table=bootstrap_table,
         serving_state=serving_state,
         specgrid_scenarios=specgrid_scenarios,
+        audit=audit,
     )
 
 
@@ -607,6 +782,19 @@ def _main() -> None:
              "universes × models via Gram contraction) and save "
              "specgrid_scenarios.csv",
     )
+    parser.add_argument(
+        "--no-guard", action="store_true",
+        help="disable the data-integrity guardrails (stage-boundary "
+             "contracts + in-program numerical sentinels; default follows "
+             "FMRP_GUARD, normally on)",
+    )
+    parser.add_argument(
+        "--audit-dir", default=None,
+        help="arm the drift sentinel: compare this run's artifact "
+             "summaries (sha256 + per-column moments) against the "
+             "previous run's audit manifest in this directory; drift "
+             "beyond band fails loudly, a clean run updates the manifest",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -630,6 +818,8 @@ def _main() -> None:
         make_specgrid=args.specgrid,
         bootstrap_replicates=args.bootstrap or 10_000,
         checkpoint_dir=args.checkpoint_dir,
+        guard=False if args.no_guard else None,
+        audit_dir=args.audit_dir,
     )
     print(result.table_1.round(3).to_string())
     print()

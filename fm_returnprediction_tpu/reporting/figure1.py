@@ -230,6 +230,13 @@ def subset_sweep(
         ])
         out = jax.tree.map(lambda *leaves: np.stack(leaves), *per)
     cs_all, rolled_all, dec_all = out
+    # sentinel accounting at the sweep's HOST boundary: the inner
+    # monthly_cs_ols records were skipped under the fused trace
+    # (guard.checks.record — tracer-context rule), so the pulled leaves
+    # carry the audit here
+    from fm_returnprediction_tpu.guard import checks as _guard
+
+    _guard.record_cs_host("figure.subset_sweep", cs_all)
     params = (window, min_periods, n_deciles, min_obs)
     return {
         name: SubsetSweepEntry(

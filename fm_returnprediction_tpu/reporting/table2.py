@@ -226,6 +226,12 @@ def build_table_2(
                           nw_lags=TABLE2_NW_LAGS, solver=TABLE2_SOLVER,
                           min_months=TABLE2_MIN_MONTHS, weight=TABLE2_WEIGHT)
             )
+            # the fused sweep inlined fama_macbeth, so its sentinel
+            # records were tracer-skipped — account at the host boundary
+            from fm_returnprediction_tpu.guard import checks as _guard
+
+            for fm_model in summaries:
+                _guard.record_fm_host("table2.fm_sweep", fm_model)
             cells = {
                 (mi, name): jax.tree.map(
                     lambda leaf, _si=si: leaf[_si], summaries[mi]

@@ -23,8 +23,10 @@ timeout/keep-going semantics live in ``taskgraph.engine``.
 """
 
 from fm_returnprediction_tpu.resilience.errors import (
+    ContractViolationError,
     CorruptArtifactError,
     DispatchTimeoutError,
+    DriftDetectedError,
     IngestRejectedError,
     InjectedFault,
     ResilienceError,
@@ -51,6 +53,8 @@ __all__ = [
     "DispatchTimeoutError",
     "CorruptArtifactError",
     "IngestRejectedError",
+    "ContractViolationError",
+    "DriftDetectedError",
     "InjectedFault",
     "FaultPlan",
     "FaultSpec",

@@ -20,6 +20,8 @@ __all__ = [
     "DispatchTimeoutError",
     "CorruptArtifactError",
     "IngestRejectedError",
+    "ContractViolationError",
+    "DriftDetectedError",
     "InjectedFault",
 ]
 
@@ -55,6 +57,20 @@ class IngestRejectedError(ResilienceError):
     mismatch, merge divergence beyond tolerance). The serving front-end
     quarantines the month and keeps quoting from the last-known-good
     state."""
+
+
+class ContractViolationError(ResilienceError):
+    """A fail-severity data-integrity contract was breached at a stage
+    boundary (``guard.contracts``): the stage's product is structurally or
+    numerically wrong (duplicated keys, non-monotone calendar, values in
+    overflow territory), so downstream estimates cannot be trusted. The
+    message carries every named violation."""
+
+
+class DriftDetectedError(ContractViolationError):
+    """A persisted artifact moved beyond its tolerance band relative to the
+    previous run's audit manifest (``guard.drift``). The trusted manifest
+    is left unmodified so the regression remains reproducible against it."""
 
 
 class InjectedFault(OSError):

@@ -36,7 +36,18 @@ from typing import Callable, Dict, Optional, Union
 
 from fm_returnprediction_tpu.resilience.errors import InjectedFault
 
-__all__ = ["FaultSpec", "FaultPlan", "fault_site", "truncate_file"]
+__all__ = [
+    "FaultSpec",
+    "FaultPlan",
+    "fault_site",
+    "truncate_file",
+    "poison_nan_flood",
+    "poison_scale_spike",
+    "corrupt_panel_duplicate_id",
+    "corrupt_panel_permute_firms",
+    "corrupt_panel_stale_month",
+    "corrupt_panel_scale_spike",
+]
 
 # The installed plan. Plain module global on purpose: the inactive-path
 # cost must be one read. Installation is guarded by _INSTALL_LOCK; per-site
@@ -168,6 +179,113 @@ class FaultPlan:
                                     and not spec.delay_s):
             raise spec._make_exc(site)
         return payload
+
+
+# -- data-corruption payload mutators --------------------------------------
+#
+# The chaos suite's second fault class: sites that inject BAD DATA rather
+# than exceptions — the silent failures the guard layer (``guard.contracts``
+# / ``guard.checks``) exists to catch. Each mutator is deterministic (no
+# global RNG) and returns a NEW object, so a replayed plan corrupts
+# identically. Used as ``FaultSpec(mutate=...)`` against the payload sites
+# ``"pipeline.panel"`` (a DensePanel) and ``"serving.ingest"`` (a
+# ``(y, x, mask)`` triple); each is asserted caught at its DECLARED
+# severity in ``tests/test_chaos.py``.
+
+
+def poison_nan_flood(payload):
+    """(y, x, mask) → every predictor NaN while the mask claims full
+    presence — the broken-upstream-join shape. Declared catch:
+    ``cs.nan_flood`` at QUARANTINE."""
+    import numpy as np
+
+    y, x, mask = payload
+    x = np.asarray(x)
+    return (
+        np.full(np.asarray(y).shape, np.nan, dtype=x.dtype),
+        np.full(x.shape, np.nan, dtype=x.dtype),
+        np.ones(np.asarray(mask).shape, dtype=bool),
+    )
+
+
+def poison_scale_spike(column: int = 0, scale: float = 1e20):
+    """Mutator factory: (y, x, mask) with one predictor column scaled into
+    f32-Gram-overflow territory (a unit bug upstream — dollars where
+    log-dollars belong). Declared catch: ``cs.value_bounds`` at
+    QUARANTINE."""
+
+    def mutate(payload):
+        import numpy as np
+
+        y, x, mask = payload
+        x = np.array(x, copy=True)
+        x[..., column] = x[..., column] * x.dtype.type(scale)
+        return y, x, mask
+
+    return mutate
+
+
+def _panel_replace(panel, **overrides):
+    import dataclasses as _dc
+
+    return _dc.replace(panel, **overrides)
+
+
+def corrupt_panel_duplicate_id(panel):
+    """A duplicated permno in the firm vocabulary (an upstream dedup
+    regression: one firm's rows land in two slots). Declared catch:
+    ``panel.key_unique`` at FAIL."""
+    import numpy as np
+
+    ids = np.array(np.asarray(panel.ids), copy=True)
+    if len(ids) > 1:
+        ids[-1] = ids[0]
+    return _panel_replace(panel, ids=ids)
+
+
+def corrupt_panel_permute_firms(panel, seed: int = 0):
+    """The firm axis coherently permuted (ids, values and mask together —
+    a shuffled vocabulary upstream). No statistic moves under a coherent
+    relabeling, but the sorted-vocabulary convention positional consumers
+    rely on is broken. Declared catch: ``panel.ids_sorted`` at WARN."""
+    import numpy as np
+
+    n = len(panel.ids)
+    perm = np.random.default_rng(seed).permutation(n)
+    if n > 1 and (perm == np.arange(n)).all():  # pragma: no cover - seed-dependent
+        perm = np.roll(perm, 1)
+    return _panel_replace(
+        panel,
+        ids=np.asarray(panel.ids)[perm],
+        values=np.asarray(panel.values)[:, perm, :],
+        mask=np.asarray(panel.mask)[:, perm],
+    )
+
+
+def corrupt_panel_stale_month(panel):
+    """The last calendar entry overwritten with the previous month's stamp
+    (a stuck feed re-labeling stale data). Declared catch:
+    ``panel.calendar_monotone`` at FAIL."""
+    import numpy as np
+
+    months = np.array(
+        np.asarray(panel.months).astype("datetime64[ns]"), copy=True
+    )
+    if len(months) > 1:
+        months[-1] = months[-2]
+    return _panel_replace(panel, months=months)
+
+
+def corrupt_panel_scale_spike(panel, column: int = -1, scale: float = 1e20):
+    """One characteristic column scaled past the guard's value bound —
+    magnitudes that overflow an f32 Gram contraction. Declared catch:
+    ``panel.value_bounds`` at FAIL (before the numerics silently
+    saturate; the in-program overflow sentinels are the second fence)."""
+    import numpy as np
+
+    values = np.array(np.asarray(panel.values), copy=True)
+    values[:, :, column] = values[:, :, column] * values.dtype.type(scale)
+    return _panel_replace(panel, values=values)
 
 
 def fault_site(site: str, payload=None, path=None):

@@ -45,39 +45,37 @@ from fm_returnprediction_tpu.resilience.errors import IngestRejectedError
 __all__ = ["ingest_month", "validate_cross_section"]
 
 
-def validate_cross_section(state, y_new, x_new, mask_new):
+def validate_cross_section(state, y_new, x_new, mask_new, month=None,
+                           audit=None):
     """Gate a candidate ingest month before it can touch the state.
 
-    Returns the coerced ``(y, x, mask)`` numpy triple or raises
-    :class:`IngestRejectedError` for the poisoned shapes the degraded-mode
-    front-end quarantines: mismatched lengths, wrong predictor width, a
-    cross-section whose masked rows are ALL-non-finite (a NaN flood is an
-    upstream data fault, not a thin month — thin months are legal and stay
-    quotable), and infinite realized returns (NaN y is the start-of-month
-    contract; ±inf is corruption).
+    A thin wrapper over the ONE shared definition of a valid cross-section
+    (``guard.contracts.cross_section_rules`` — the same rules the batch
+    path enforces, so serving and batch ingest cannot drift apart): coerce
+    to the state's dtype, evaluate the contract, enforce its quarantine
+    severities. Returns the coerced ``(y, x, mask)`` numpy triple or
+    raises :class:`IngestRejectedError` naming the violated rule(s):
+    mismatched lengths/width (``cs.shape``/``cs.length``), an all-NaN
+    flood (``cs.nan_flood`` — a thin month is legal and stays quotable),
+    infinite realized returns (``cs.y_bounds`` — NaN y is the
+    start-of-month contract; ±inf is corruption), magnitudes in
+    f32-Gram-overflow territory (``cs.value_bounds``), and — when
+    ``month`` is passed and is NEW — a cross-section bit-identical to the
+    state's last month (``cs.stale_repeat``: a stuck upstream feed).
+
+    ``audit`` (a ``guard.contracts.AuditRecord``) receives every violation.
     """
+    from fm_returnprediction_tpu.guard import contracts
+
     x = np.asarray(x_new, dtype=state.dtype)
     y = np.asarray(y_new, dtype=state.dtype)
     mask = np.asarray(mask_new, dtype=bool)
-    if x.ndim != 2:
-        raise IngestRejectedError(f"x must be (N, P), got shape {x.shape}")
-    if x.shape[-1] != state.n_predictors:
-        raise IngestRejectedError(
-            f"expected {state.n_predictors} predictors ({state.xvars}), "
-            f"got {x.shape[-1]}"
-        )
-    if not (y.shape == mask.shape == x.shape[:1]):
-        raise IngestRejectedError(
-            f"length mismatch: y {y.shape}, x {x.shape}, mask {mask.shape}"
-        )
-    if mask.any():
-        if not np.isfinite(x[mask]).any():
-            raise IngestRejectedError(
-                "all-NaN cross-section: no finite predictor in any "
-                "masked row"
-            )
-        if np.isinf(y[mask]).any():
-            raise IngestRejectedError("infinite realized return in y")
+    contracts.enforce(
+        contracts.evaluate(
+            contracts.cross_section_rules(state, month=month), (y, x, mask)
+        ),
+        audit=audit,
+    )
     return y, x, mask
 
 
@@ -173,6 +171,26 @@ def ingest_month(state, y_new, x_new, mask_new, month):
             state.gram, state.moment, state.n_obs, state.ysum, state.yy
         ))
         new = type(new)(*[a + b for a, b in zip(last, new)])
+
+    from fm_returnprediction_tpu.guard import checks as _guard
+
+    if _guard.guard_active():
+        # post-contraction overflow sentinel: values that individually pass
+        # the bounds contract can still overflow the Gram products at the
+        # state's dtype (f32: x² past 3.4e38) — a non-finite statistic must
+        # never be baked into the state
+        bad = int(
+            (~np.isfinite(new.gram)).sum() + (~np.isfinite(new.moment)).sum()
+        )
+        if bad:
+            _guard.record(
+                "serving.ingest", {"gram_nonfinite_entries": bad}
+            )
+            raise IngestRejectedError(
+                f"[quarantine] cs.nonfinite_stats: {bad} non-finite "
+                f"sufficient-statistic entries after contraction "
+                f"(overflow or poisoned rows)"
+            )
     coef_row, valid_row = _solve(new)
 
     if merge:

@@ -98,6 +98,12 @@ class ERService:
         self._quarantined: dict = {}  # month label → rejection reason
         self._n_ingested = 0
         self._n_ingest_failed = 0
+        # the guard ledger: every contract violation an ingest attempt
+        # tripped (named rules, ``guard.contracts``), queryable alongside
+        # the quarantine dict — "what did the guards see" for this service
+        from fm_returnprediction_tpu.guard.contracts import AuditRecord
+
+        self.audit = AuditRecord()
         # Executor counters must survive ingest swaps (each ingest
         # publishes a FRESH executor): retired executors stay in a short
         # deque and are summed LIVE in stats() — an in-flight batch still
@@ -184,7 +190,10 @@ class ERService:
             y_new, x_new, mask_new = fault_site(
                 "serving.ingest", payload=(y_new, x_new, mask_new)
             )
-            y, x, mask = validate_cross_section(self.state, y_new, x_new, mask_new)
+            y, x, mask = validate_cross_section(
+                self.state, y_new, x_new, mask_new, month=month,
+                audit=self.audit,
+            )
             with self.timer.stage("serving/ingest"):
                 new_state = _ingest(self.state, y, x, mask, month)
             merged = new_state.n_months == self.state.n_months
@@ -262,6 +271,7 @@ class ERService:
             n_ingested=self._n_ingested,
             n_ingest_failed=self._n_ingest_failed,
             dispatch_timeouts=tot["timeouts"],
+            guard_violations=len(self.audit.violations),
         )
         return out
 

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fm_returnprediction_tpu.guard import checks as _guardchk
 from fm_returnprediction_tpu.ops.fama_macbeth import (
     FamaMacbethSummary,
     fama_macbeth,
@@ -139,13 +140,20 @@ class SpecGridResult(NamedTuple):
         )
 
 
-def solve_spec_stats(stats, sel_aug: jnp.ndarray) -> SpecSolve:
+def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
     """Solve every (spec, month) padded Gram system.
 
     ``sel_aug`` (S, Q) bool selects augmented columns (intercept always
     True). Unselected rows/columns are replaced by identity so the padded
     eigendecomposition solves exactly the selected subsystem with zeros
     elsewhere.
+
+    ``guard`` (trace-time static) additionally returns the numerical
+    sentinel counters the eigendecomposition prices for free — months
+    whose equilibrated condition exceeds ``1/√eps`` of the COMPUTE dtype
+    (reported for every dtype; only the f64 tier referees) — as
+    ``(SpecSolve, counters)``; ``guard=False`` keeps the historical
+    single-value return and the unguarded jaxpr.
     """
     gram, moment, n, ysum, yy, center = stats
     # Precision policy (measured on the real-shape benchscale panel,
@@ -222,17 +230,36 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray) -> SpecSolve:
         "stp,tp->st", beta[..., 1:], center, precision=_PRECISION
     )
     beta = jnp.concatenate([intercept[..., None], beta[..., 1:]], axis=-1)
-    return SpecSolve(beta, r2, month_valid, suspect)
+    sol = SpecSolve(beta, r2, month_valid, suspect)
+    if guard:
+        # suspect months are NOT counted here: they are a handled condition
+        # (the QR referee re-solves them; SpecGridResult.suspect_months
+        # discloses the count) — sentinel counters are for failures nothing
+        # downstream absorbs
+        counters = {
+            "gram_nonfinite_entries": _guardchk.nonfinite_count(gram)
+            + _guardchk.nonfinite_count(m),
+            # conditioning beyond 1/√eps on months NO referee will touch:
+            # under f64 ill ⊆ suspect (refereed → excluded), so this fires
+            # only for f32 panels, where the Gram answer is still the
+            # measured-better route but the precision risk belongs in the
+            # audit record
+            "cond_exceeded_months": jnp.sum(
+                month_valid & (w[..., 0] * cond_limit < wmax) & ~suspect
+            ),
+        }
+        return sol, counters
+    return sol
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk"),
+    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk", "guard"),
 )
 def _spec_grid_program(
     y, x, universes, uidx, col_sel, window,
     nw_lags: int, min_months: int, weights: Tuple[str, ...],
-    firm_chunk: Optional[int],
+    firm_chunk: Optional[int], guard: bool = False,
 ):
     """Contraction + padded solve + FM aggregation for the whole grid —
     ONE compiled program, no stacked designs, no per-cell dispatch.
@@ -248,7 +275,11 @@ def _spec_grid_program(
     sel_aug = jnp.concatenate(
         [jnp.ones((s_specs, 1), bool), col_sel], axis=1
     )
-    sol = solve_spec_stats(stats, sel_aug)
+    counters = None
+    if guard:
+        sol, counters = solve_spec_stats(stats, sel_aug, guard=True)
+    else:
+        sol = solve_spec_stats(stats, sel_aug)
     out_dtype = y.dtype
     # unselected predictor columns carry NaN: the FM summary's per-column
     # dropna then reports NaN coef/tstat there, and consumers slicing a
@@ -269,6 +300,8 @@ def _spec_grid_program(
         )(cs)
         for w in weights
     )
+    if guard:
+        return cs, fms, sol.suspect, counters
     return cs, fms, sol.suspect
 
 
@@ -317,13 +350,19 @@ def run_spec_grid_weights(
     col_sel = jnp.asarray(grid.column_selector())
     window_np = grid.window_masks(t)
 
-    cs, fms, suspect = jax.device_get(
+    guard = _guardchk.guard_active()
+    out = jax.device_get(
         _spec_grid_program(
             y, x, universes, uidx, col_sel, window_np,
             nw_lags=grid.nw_lags, min_months=grid.min_months,
-            weights=tuple(weights), firm_chunk=firm_chunk,
+            weights=tuple(weights), firm_chunk=firm_chunk, guard=guard,
         )
     )
+    if guard:
+        cs, fms, suspect, guard_counters = out
+        _guardchk.record("specgrid.grid_program", guard_counters)
+    else:
+        cs, fms, suspect = out
     suspect_months = np.asarray(suspect).sum(axis=1).astype(np.int64)
     flagged = []
     if referee:

@@ -154,6 +154,28 @@ def _pull_data_primary(raw_dir: Path, synthetic: bool, synthetic_config=None) ->
                     data_dir=raw_dir, file_name="CRSP_index_d.parquet")
 
 
+def _guard_panel(panel, context: str, expect_dtype: bool = False) -> None:
+    """Stage-boundary panel contract for the task graph (gated on the
+    global ``FMRP_GUARD`` switch): a fail-severity violation raises the
+    typed ``ContractViolationError``, which the engine's failure machinery
+    records in its sqlite ledger like any other task failure — and
+    ``keep_going`` runs keep disjoint subgraphs alive around it.
+
+    ``expect_dtype`` pins the configured compute dtype — only at BUILD
+    time (a checkpoint legitimately predates a dtype reconfiguration; the
+    consumer tasks check structure, not provenance)."""
+    from fm_returnprediction_tpu.guard import checks, contracts
+
+    if not checks.guard_active():
+        return
+    dtype = None
+    if expect_dtype:
+        from fm_returnprediction_tpu.pipeline import resolve_dtype
+
+        dtype = resolve_dtype()
+    contracts.check_panel(panel, dtype=dtype, context=context)
+
+
 def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     import os
 
@@ -167,6 +189,9 @@ def _build_panel(raw_dir: Path, processed_dir: Path) -> None:
     # still skips the host ingest; dtype resolves inside the shared entry.
     with trace(os.environ.get("FMRP_TRACE")):
         panel, factors_dict = load_or_build_panel(raw_dir)
+    # contract boundary BEFORE the checkpoint write: a corrupted panel must
+    # not become the trusted artifact every downstream task consumes
+    _guard_panel(panel, "build_panel", expect_dtype=True)
 
     def save():
         panel.save(processed_dir / PANEL_FILE)
@@ -198,6 +223,10 @@ def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
     from fm_returnprediction_tpu.reporting.table2 import build_table_2
 
     panel = DensePanel.load(processed_dir / PANEL_FILE)
+    # the checkpoint passed its file checksum; the CONTRACT catches the
+    # semantic corruptions a checksum cannot (the file faithfully stores
+    # duplicated permnos too)
+    _guard_panel(panel, "reports")
     with open(processed_dir / FACTORS_FILE) as f:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
@@ -207,6 +236,12 @@ def _reports_traced(processed_dir: Path, output_dir: Path) -> None:
     # same mesh policy as run_pipeline: 2-D hierarchy on a pod, MESH_DEVICES
     # opt-in single-process
     table_2 = build_table_2(panel, masks, factors_dict, mesh=pipeline_mesh())
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.guard import contracts as _contracts
+
+    if _guard_checks.guard_active():
+        _contracts.check_frame(table_1, "table_1")
+        _contracts.check_frame(table_2, "table_2")
     cs_cache = {name: figure_cs(panel, m) for name, m in masks.items()}
     figure_1 = create_figure_1(panel, masks, cs_cache=cs_cache)
     decile_table = build_decile_table(panel, masks, cs_cache=cs_cache)
@@ -234,8 +269,20 @@ def _serve_state(processed_dir: Path) -> None:
     )
 
     panel = DensePanel.load(processed_dir / PANEL_FILE)
+    _guard_panel(panel, "serve_state")
     masks = compute_subset_masks(panel)
     state = build_serving_state_from_panel(panel, masks["All stocks"])
+
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.guard import contracts as _contracts
+
+    if _guard_checks.guard_active():
+        # fail the TASK (typed, ledgered, keep_going-compatible) rather
+        # than persist a state the service would have to quarantine
+        _contracts.enforce(
+            _contracts.evaluate(_contracts.serving_state_rules(), state),
+            context="serve_state",
+        )
     BucketedExecutor(state).warmup()
     _primary_writes(
         "serve_state_saved",
@@ -255,10 +302,17 @@ def _specgrid(processed_dir: Path, output_dir: Path) -> None:
     from fm_returnprediction_tpu.specgrid import run_scenarios
 
     panel = DensePanel.load(processed_dir / PANEL_FILE)
+    _guard_panel(panel, "specgrid")
     with open(processed_dir / FACTORS_FILE) as f:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
     frame = run_scenarios(panel, masks, factors_dict)
+
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.guard import contracts as _contracts
+
+    if _guard_checks.guard_active():
+        _contracts.check_frame(frame, "specgrid_scenarios")
     output_dir.mkdir(parents=True, exist_ok=True)
     _primary_writes(
         "specgrid_saved",

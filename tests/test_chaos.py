@@ -249,3 +249,176 @@ def test_pipeline_crash_resumes_at_last_completed_stage(tmp_path, monkeypatch):
     fresh = pl.run_pipeline(**{**kw, "checkpoint_dir": None})
     pd.testing.assert_frame_equal(res.table_1, fresh.table_1)
     pd.testing.assert_frame_equal(res.table_2, fresh.table_2)
+
+
+# -- data-corruption sites: bad DATA, not exceptions ------------------------
+#
+# The second chaos class (guard-layer acceptance): each site injects a
+# silently-wrong payload at a production fault site and must be caught at
+# its DECLARED severity with a NAMED violation —
+#
+#   NaN flood            serving.ingest   quarantine  cs.nan_flood
+#   duplicated permno    pipeline.panel   fail        panel.key_unique
+#   stale repeated month serving.ingest   quarantine  cs.stale_repeat
+#   permuted firm axis   pipeline.panel   warn        panel.ids_sorted
+#   f32 scale spike      pipeline.panel   fail        panel.value_bounds
+#
+# (the NaN-flood site is already exercised by
+# test_poisoned_ingest_quarantined_service_stays_quotable above)
+
+
+def _pipeline_kw(**over):
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+
+    kw = dict(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=20, n_months=36),
+        make_figure=False, make_deciles=False, make_serving=False,
+        compile_pdf=False, guard=True,
+    )
+    kw.update(over)
+    return kw
+
+
+def test_chaos_duplicated_permno_fails_panel_contract():
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.resilience.errors import (
+        ContractViolationError,
+    )
+    from fm_returnprediction_tpu.resilience.faults import (
+        corrupt_panel_duplicate_id,
+    )
+
+    plan = FaultPlan({
+        "pipeline.panel": FaultSpec(mutate=corrupt_panel_duplicate_id)
+    })
+    with plan:
+        with pytest.raises(ContractViolationError, match="panel.key_unique"):
+            run_pipeline(**_pipeline_kw())
+    assert plan.fired["pipeline.panel"] == 1
+
+
+def test_chaos_stale_month_fails_calendar_contract():
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.resilience.errors import (
+        ContractViolationError,
+    )
+    from fm_returnprediction_tpu.resilience.faults import (
+        corrupt_panel_stale_month,
+    )
+
+    with FaultPlan({
+        "pipeline.panel": FaultSpec(mutate=corrupt_panel_stale_month)
+    }):
+        with pytest.raises(
+            ContractViolationError, match="panel.calendar_monotone"
+        ):
+            run_pipeline(**_pipeline_kw())
+
+
+def test_chaos_scale_spike_fails_value_bounds():
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.resilience.errors import (
+        ContractViolationError,
+    )
+    from fm_returnprediction_tpu.resilience.faults import (
+        corrupt_panel_scale_spike,
+    )
+
+    with FaultPlan({
+        "pipeline.panel": FaultSpec(
+            mutate=lambda p: corrupt_panel_scale_spike(p, column=-1)
+        )
+    }):
+        with pytest.raises(ContractViolationError, match="panel.value_bounds"):
+            run_pipeline(**_pipeline_kw())
+
+
+def test_chaos_permuted_firm_axis_warns_and_run_completes():
+    """A coherent firm-axis permutation changes NO statistic — the run
+    must COMPLETE (warn severity), emit the named violation into the audit
+    record, and produce the same Table 2 as the unpermuted run."""
+    from fm_returnprediction_tpu.guard.contracts import GuardWarning
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.resilience.faults import (
+        corrupt_panel_permute_firms,
+    )
+
+    clean = run_pipeline(**_pipeline_kw())
+    with FaultPlan({
+        "pipeline.panel": FaultSpec(
+            mutate=lambda p: corrupt_panel_permute_firms(p, seed=4)
+        )
+    }):
+        with pytest.warns(GuardWarning, match="panel.ids_sorted"):
+            res = run_pipeline(**_pipeline_kw())
+    assert "panel.ids_sorted" in res.audit.names()
+    pd.testing.assert_frame_equal(res.table_2, clean.table_2)
+
+
+def test_chaos_stale_repeated_month_quarantined_at_serving():
+    """The upstream feed re-offers the state's last cross-section under a
+    NEW month label: quarantined as cs.stale_repeat, service keeps
+    quoting, and a genuinely fresh month afterwards heals it."""
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    rng = np.random.default_rng(17)
+    t, n, p = 24, 40, 3
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = np.where(
+        rng.random((t, n)) > 0.2, 0.1 * rng.standard_normal((t, n)), np.nan
+    ).astype(np.float32)
+    mask = np.ones((t, n), bool)  # full mask: the support bounds the state
+    # stores for month t-1 are exactly what a re-offered (x, mask) yields
+    state = build_serving_state(y, x, mask, window=t // 2,
+                                min_periods=t // 4)
+    last_x = x[t - 1]
+    stale_month = np.datetime64("2071-05-31", "ns")
+    with ERService(state, max_batch=8, warm=True) as svc:
+        before = svc.query(t - 1, last_x[0])
+
+        # the chaos plan swaps the fresh feed for yesterday's data
+        stale = FaultSpec(times=1, mutate=lambda payload: (
+            np.full(n, np.nan, np.float32), last_x, np.ones(n, bool),
+        ))
+        fresh_x = last_x + np.float32(0.125)
+        with FaultPlan({"serving.ingest": stale}) as plan:
+            ok = svc.ingest_month(
+                np.full(n, np.nan), fresh_x, np.ones(n, bool), stale_month
+            )
+        assert plan.fired["serving.ingest"] == 1
+        assert not ok and svc.degraded
+        assert "cs.stale_repeat" in svc.quarantined_months()[str(stale_month)]
+        assert "cs.stale_repeat" in svc.audit.names()  # named in the ledger
+        assert svc.state.n_months == t
+
+        # still quotable from last-known-good, same answer
+        assert svc.query(t - 1, last_x[0]) == pytest.approx(before)
+
+        # the healed feed (no plan) ingests the genuinely fresh month
+        assert svc.ingest_month(
+            np.full(n, np.nan), fresh_x, np.ones(n, bool), stale_month
+        )
+        assert not svc.degraded and svc.state.n_months == t + 1
+
+
+def test_chaos_nan_flood_names_violation_in_audit():
+    """The pre-existing NaN-flood site, now routed through the shared
+    contract rules: the quarantine reason carries the rule name."""
+    from fm_returnprediction_tpu.resilience.faults import poison_nan_flood
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _tiny_state()
+    t, n = state.n_months, x.shape[1]
+    with ERService(state, max_batch=8, warm=False, auto_flush=False) as svc:
+        with FaultPlan({
+            "serving.ingest": FaultSpec(times=1, mutate=poison_nan_flood)
+        }):
+            ok = svc.ingest_month(
+                np.full(n, np.nan), x[t - 1], np.ones(n, bool),
+                np.datetime64("2071-06-30", "ns"),
+            )
+        assert not ok
+        (reason,) = svc.quarantined_months().values()
+        assert "cs.nan_flood" in reason and "all-NaN" in reason
+        assert "cs.nan_flood" in svc.audit.names()

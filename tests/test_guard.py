@@ -1,0 +1,439 @@
+"""Guardrail layer: contracts, drift sentinels, jit-safe numerical guards.
+
+Three claims under test (ISSUE acceptance):
+
+1. CONTRACTS — each invariant rule catches its corruption class at the
+   declared severity, and the severity ladder maps to the right typed
+   error / warning / quarantine behavior.
+2. DRIFT — a same-fingerprint rerun whose artifact moments moved beyond
+   band fails loudly with a per-column report; an identical rerun
+   short-circuits on the content sha; a different fingerprint
+   re-baselines instead of crying wolf.
+3. SENTINELS ARE SEMANTICALLY FREE — on clean data, outputs are
+   bit-identical with guards on vs off, the guard-off jaxpr contains no
+   guard code at all (proved by making the sentinel helpers explode and
+   tracing anyway), and arming guards costs zero extra traces per
+   configuration on the OLS/Gram hot paths.
+"""
+
+import functools
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.guard import checks, contracts, drift
+from fm_returnprediction_tpu.resilience.errors import (
+    ContractViolationError,
+    DriftDetectedError,
+    IngestRejectedError,
+)
+
+pytestmark = pytest.mark.guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    checks.reset()
+    yield
+    checks.reset()
+
+
+def _tiny_panel(t=10, n=8, seed=3, dtype=np.float64):
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+
+    rng = np.random.default_rng(seed)
+    values = rng.standard_normal((t, n, 3)).astype(dtype) * 0.1
+    mask = np.ones((t, n), dtype=bool)
+    values[~mask] = np.nan
+    months = (
+        np.datetime64("2000-01-31", "ns")
+        + np.arange(t) * np.timedelta64(31, "D").astype("timedelta64[ns]")
+    )
+    return DensePanel(
+        values=values,
+        mask=mask,
+        months=months.astype("datetime64[ns]"),
+        ids=np.arange(100, 100 + n),
+        var_names=["retx", "size", "bm"],
+    )
+
+
+# -- contracts: severity ladder --------------------------------------------
+
+
+def test_clean_panel_passes_all_contracts():
+    panel = _tiny_panel()
+    audit = contracts.AuditRecord()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        probe = contracts.check_panel(panel, dtype=np.float64, audit=audit)
+    assert audit.ok()
+    assert probe["columns"]["retx"]["finite"] > 0  # probe doubles as stats
+
+
+def test_fail_severity_raises_typed_error():
+    import dataclasses
+
+    panel = _tiny_panel()
+    ids = np.asarray(panel.ids).copy()
+    ids[1] = ids[0]  # duplicated permno
+    bad = dataclasses.replace(panel, ids=ids)
+    audit = contracts.AuditRecord()
+    with pytest.raises(ContractViolationError, match="panel.key_unique"):
+        contracts.check_panel(bad, audit=audit)
+    assert "panel.key_unique" in audit.names()  # named in the audit record
+
+
+def test_warn_severity_warns_and_records_but_passes():
+    import dataclasses
+
+    panel = _tiny_panel()
+    perm = np.random.default_rng(0).permutation(len(panel.ids))
+    bad = dataclasses.replace(
+        panel,
+        ids=np.asarray(panel.ids)[perm],
+        values=np.asarray(panel.values)[:, perm, :],
+        mask=np.asarray(panel.mask)[:, perm],
+    )
+    audit = contracts.AuditRecord()
+    with pytest.warns(contracts.GuardWarning, match="panel.ids_sorted"):
+        contracts.check_panel(bad, audit=audit)
+    assert audit.names() == ["panel.ids_sorted"]
+
+
+def test_calendar_and_bounds_rules():
+    import dataclasses
+
+    panel = _tiny_panel()
+    months = np.asarray(panel.months).copy()
+    months[-1] = months[-2]  # stale repeated month stamp
+    with pytest.raises(ContractViolationError, match="panel.calendar_monotone"):
+        contracts.check_panel(dataclasses.replace(panel, months=months))
+
+    vals = np.asarray(panel.values).copy()
+    vals[0, 0, 1] = 1e20  # f32-overflow scale spike
+    with pytest.raises(ContractViolationError, match="panel.value_bounds"):
+        contracts.check_panel(dataclasses.replace(panel, values=vals))
+
+    vals = np.asarray(panel.values).copy()
+    vals[0, 0, 0] = -1.5  # impossible simple return
+    with pytest.raises(ContractViolationError, match="panel.return_bounds_low"):
+        contracts.check_panel(dataclasses.replace(panel, values=vals))
+
+
+def test_infinite_entries_fail_value_bounds():
+    """A literal ±inf is an ALREADY-overflowed value — the finite-moment
+    scan never sees it, so the rule must count infs explicitly."""
+    import dataclasses
+
+    panel = _tiny_panel()
+    vals = np.asarray(panel.values).copy()
+    vals[0, 0, 1] = np.inf
+    with pytest.raises(ContractViolationError, match="panel.value_bounds"):
+        contracts.check_panel(dataclasses.replace(panel, values=vals))
+
+
+def test_unreadable_panel_raises_typed_error():
+    """A panel the probe cannot even reduce (wrong rank — a torn
+    checkpoint) must surface as the typed ContractViolationError the
+    taskgraph ledger expects, not a raw unpacking error."""
+    import dataclasses
+
+    panel = _tiny_panel()
+    bad = dataclasses.replace(
+        panel, values=np.asarray(panel.values)[:, :, 0]
+    )
+    audit = contracts.AuditRecord()
+    with pytest.raises(ContractViolationError, match="unreadable"):
+        contracts.check_panel(bad, audit=audit)
+    assert audit.names() == ["panel.schema"]
+
+
+def test_host_boundary_counters_for_fused_sweeps():
+    """Fused sweep programs inline monthly_cs_ols/fama_macbeth (records
+    tracer-skipped); the host-boundary recorders carry the audit from the
+    pulled numpy leaves — including subset-stacked ones."""
+    from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+
+    t, p, s = 6, 2, 3
+    cs = CSRegressionResult(
+        slopes=np.zeros((s, t, p)),
+        intercept=np.zeros((s, t)),
+        r2=np.zeros((s, t)),
+        n_obs=np.full((s, t), 10.0),
+        month_valid=np.ones((s, t), bool),
+    )
+    bad = np.asarray(cs.slopes)
+    bad[1, 2, 0] = np.nan  # one poisoned month in one subset
+    with checks.guards(True):
+        checks.record_cs_host("sweep.test", cs)
+    assert checks.counters() == {"sweep.test.nonfinite_solve_months": 1}
+
+
+def test_evaluation_short_circuits_on_blocking_violation():
+    """A mis-shaped subject must not crash later rules: evaluation stops at
+    the first blocking violation."""
+    rules = [
+        contracts.Rule("a.first", "fail", lambda s: "broken"),
+        contracts.Rule("a.second", "fail", lambda s: 1 / 0 and None),
+    ]
+    found = contracts.evaluate(rules, object())
+    assert [v.rule for v in found] == ["a.first"]
+
+
+def test_crashed_check_is_reported_not_raised():
+    rules = [contracts.Rule("b.crashy", "warn", lambda s: [][1] and None)]
+    found = contracts.evaluate(rules, object())
+    assert found and "crashed" in found[0].detail
+
+
+def test_screen_artifact_quarantines_and_continues():
+    audit = contracts.AuditRecord()
+    empty = pd.DataFrame()
+    rules = contracts.frame_rules("opt", blocking="quarantine")
+    with pytest.warns(contracts.GuardWarning, match="quarantined"):
+        out = contracts.screen_artifact("opt", empty, rules, audit)
+    assert out is None
+    assert audit.quarantined == ["opt"]
+    # a healthy artifact passes through untouched
+    ok = pd.DataFrame({"x": [1.0]})
+    assert contracts.screen_artifact("opt", ok, rules, audit) is ok
+
+
+def test_frame_rules_on_formatted_table():
+    """The formatted (string-valued) Table 2 coerces: blanks are NaN, a
+    numeric-looking table passes, an all-blank one fails."""
+    good = pd.DataFrame({"a": ["0.123", ""], "b": ["-1.5", "2,000"]})
+    assert contracts.evaluate(contracts.frame_rules("t2"), good) == []
+    flood = pd.DataFrame({"a": ["", ""], "b": ["", ""]})
+    found = contracts.evaluate(contracts.frame_rules("t2"), flood)
+    assert found and found[0].rule == "t2.nonfinite_flood"
+
+
+# -- contracts: shared cross-section definition ----------------------------
+
+
+def _tiny_state(t=12, n=20, p=3, seed=5):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    return build_serving_state(y, x, mask, window=t // 2,
+                               min_periods=t // 4), x, mask
+
+
+def test_validate_cross_section_uses_shared_rules():
+    from fm_returnprediction_tpu.serving.ingest import validate_cross_section
+
+    state, x, _ = _tiny_state()
+    n, p = x.shape[1], x.shape[2]
+    # NaN flood → the shared cs.nan_flood rule, message text preserved
+    with pytest.raises(IngestRejectedError, match="all-NaN"):
+        validate_cross_section(
+            state, np.full(n, np.nan), np.full((n, p), np.nan),
+            np.ones(n, bool),
+        )
+    # value bound → the shared cs.value_bounds rule
+    spiked = x[-1].copy()
+    spiked[:, 0] *= np.float32(1e20)
+    with pytest.raises(IngestRejectedError, match="cs.value_bounds"):
+        validate_cross_section(
+            state, np.full(n, np.nan), spiked, np.ones(n, bool)
+        )
+    # a clean month passes and coerces dtype
+    y, xv, m = validate_cross_section(
+        state, np.full(n, np.nan), x[-1], np.ones(n, bool)
+    )
+    assert xv.dtype == state.dtype
+
+
+def test_stale_repeat_detected_only_for_new_label():
+    from fm_returnprediction_tpu.serving.ingest import validate_cross_section
+
+    state, x, mask = _tiny_state()
+    n = x.shape[1]
+    last_x, last_mask = x[-1], mask[-1]
+    new_month = np.datetime64("2099-01-31", "ns")
+    # the SAME cross-section under a NEW label: stale feed
+    with pytest.raises(IngestRejectedError, match="cs.stale_repeat"):
+        validate_cross_section(
+            state, np.full(n, np.nan), last_x, last_mask, month=new_month
+        )
+    # same label (merge) is legal
+    validate_cross_section(
+        state, np.full(n, np.nan), last_x, last_mask, month=state.months[-1]
+    )
+    # a genuinely different cross-section under the new label is legal
+    other = last_x + np.float32(0.25)
+    validate_cross_section(
+        state, np.full(n, np.nan), other, last_mask, month=new_month
+    )
+
+
+# -- drift sentinel --------------------------------------------------------
+
+
+def test_drift_identical_rerun_short_circuits(tmp_path):
+    df = pd.DataFrame({"coef": [0.1, 0.2], "tstat": [2.0, 3.0]})
+    s1 = drift.DriftSentinel(tmp_path, "fp")
+    s1.check("table_2", drift.summarize_frame(df))
+    s1.raise_on_drift()
+    s1.commit()
+    s2 = drift.DriftSentinel(tmp_path, "fp")
+    assert s2.check("table_2", drift.summarize_frame(df.copy())) == []
+
+
+def test_drift_beyond_band_fails_with_per_column_report(tmp_path):
+    df = pd.DataFrame({"coef": [0.1, 0.2], "tstat": [2.0, 3.0]})
+    s1 = drift.DriftSentinel(tmp_path, "fp")
+    s1.check("table_2", drift.summarize_frame(df))
+    s1.commit()
+
+    moved = df.copy()
+    moved["tstat"] = [2.0, 15.0]  # the silent-regression scenario
+    s2 = drift.DriftSentinel(tmp_path, "fp")
+    found = s2.check("table_2", drift.summarize_frame(moved))
+    assert found and all(v.rule == "drift.table_2" for v in found)
+    assert any("tstat" in v.detail for v in found)  # per-column report
+    with pytest.raises(DriftDetectedError, match="tstat"):
+        s2.raise_on_drift()
+    # the trusted manifest was NOT overwritten by the failing run
+    s3 = drift.DriftSentinel(tmp_path, "fp")
+    assert s3.check("table_2", drift.summarize_frame(df)) == []
+
+
+def test_drift_within_band_passes_and_rebaselines(tmp_path):
+    df = pd.DataFrame({"coef": [0.1, 0.2]})
+    s1 = drift.DriftSentinel(tmp_path, "fp")
+    s1.check("table_2", drift.summarize_frame(df))
+    s1.commit()
+    nudged = df + 1e-9  # far inside the default band
+    s2 = drift.DriftSentinel(tmp_path, "fp")
+    assert s2.check("table_2", drift.summarize_frame(nudged)) == []
+    # different fingerprint: comparison meaningless → re-baseline, no drift
+    s3 = drift.DriftSentinel(tmp_path, "other-data")
+    assert s3.rebaselined
+    moved = df * 100
+    assert s3.check("table_2", drift.summarize_frame(moved)) == []
+
+
+def test_drift_band_env_overrides_are_live(monkeypatch):
+    """FMRP_DRIFT_* must resolve at instantiation, not module import."""
+    monkeypatch.setenv("FMRP_DRIFT_RTOL", "0.25")
+    monkeypatch.setenv("FMRP_DRIFT_ATOL", "0.5")
+    band = drift.DriftBand()
+    assert band.rtol == 0.25 and band.atol == 0.5
+    monkeypatch.delenv("FMRP_DRIFT_RTOL")
+    monkeypatch.delenv("FMRP_DRIFT_ATOL")
+    assert drift.DriftBand().rtol == 1e-3
+
+
+def test_drift_band_overrides():
+    a = {"kind": "frame", "sha256": "x", "shape": [1, 1],
+         "columns": {"c": {"finite": 1, "size": 1, "mean": 1.0, "std": 0.0,
+                           "min": 1.0, "max": 1.0}}}
+    b = {"kind": "frame", "sha256": "y", "shape": [1, 1],
+         "columns": {"c": {"finite": 1, "size": 1, "mean": 1.05, "std": 0.0,
+                           "min": 1.05, "max": 1.05}}}
+    assert drift.compare_summaries("t", a, b)  # default band: drift
+    wide = drift.DriftBand(rtol=0.1, atol=0.0)
+    assert drift.compare_summaries("t", a, b, band=wide) == []
+
+
+def test_pipeline_drift_end_to_end(tmp_path):
+    """run_pipeline(audit_dir=...): first run baselines, identical rerun
+    passes, a tampered manifest (simulating moved numbers) fails loudly."""
+    import json
+
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    kw = dict(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=20, n_months=36),
+        make_figure=False, make_deciles=False, make_serving=False,
+        compile_pdf=False, audit_dir=tmp_path,
+    )
+    run_pipeline(**kw)
+    manifest = tmp_path / drift.MANIFEST_NAME
+    assert manifest.exists()
+    run_pipeline(**kw)  # identical rerun: clean
+
+    # tamper the baseline as if the previous run's slopes were different
+    meta = json.loads(manifest.read_text())
+    col = next(iter(meta["artifacts"]["table_2"]["columns"].values()))
+    col["mean"] = (col["mean"] or 0.0) + 1.0
+    meta["artifacts"]["table_2"]["sha256"] = "not-the-same"
+    manifest.write_text(json.dumps(meta))
+    with pytest.raises(DriftDetectedError, match="table_2"):
+        run_pipeline(**kw)
+
+
+# -- sentinels: violations are counted -------------------------------------
+
+
+def test_overflow_sentinel_counts_nonfinite_gram():
+    from fm_returnprediction_tpu.ops.ols import monthly_cs_ols
+
+    rng = np.random.default_rng(0)
+    t, n, p = 6, 16, 3
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    x[..., 0] *= np.float32(1e25)  # x² overflows f32
+    y = np.zeros((t, n), np.float32)
+    mask = np.ones((t, n), bool)
+    with checks.guards(True):
+        monthly_cs_ols(y, x, mask, solver="normal")
+    got = checks.counters()
+    assert got.get("ols.monthly_cs_ols.gram_nonfinite_entries", 0) > 0
+
+
+def test_ingest_overflow_quarantined_with_named_violation():
+    """Two fences against an f32 scale spike: the service path trips the
+    value-bound contract BEFORE contraction; a direct library ingest that
+    skips validation is still stopped by the post-contraction stats
+    sentinel (x = 1e19 is a finite f32 whose square is inf)."""
+    from fm_returnprediction_tpu.serving import ERService
+    from fm_returnprediction_tpu.serving.ingest import ingest_month
+
+    state, x, _ = _tiny_state()
+    n, p = x.shape[1], x.shape[2]
+    spiked = np.full((n, p), np.float32(1e20))
+    with checks.guards(True):
+        with ERService(state, warm=False, auto_flush=False) as svc:
+            ok = svc.ingest_month(
+                np.full(n, np.nan), spiked, np.ones(n, bool),
+                np.datetime64("2099-03-31", "ns"),
+            )
+            assert not ok and svc.degraded
+            (reason,) = svc.quarantined_months().values()
+            assert "cs.value_bounds" in reason
+            assert "cs.value_bounds" in svc.audit.names()
+
+        # second fence: bypass validation, overflow the contraction
+        # (finite y so the rows are complete-case valid and contract)
+        with pytest.raises(IngestRejectedError, match="cs.nonfinite_stats"):
+            ingest_month(
+                state, np.zeros(n, np.float32),
+                np.full((n, p), np.float32(1e19)), np.ones(n, bool),
+                np.datetime64("2099-03-31", "ns"),
+            )
+    assert checks.counters().get(
+        "serving.ingest.gram_nonfinite_entries", 0
+    ) > 0
+
+
+def test_audit_record_report_roundtrip():
+    audit = contracts.AuditRecord()
+    audit.record([contracts.Violation("x.y", "warn", "d")])
+    audit.record_counters({"a.b": 2, "zero": 0})
+    audit.quarantined.append("specgrid_scenarios")
+    d = audit.as_dict()
+    assert d["violations"][0]["rule"] == "x.y"
+    assert d["counters"] == {"a.b": 2}
+    assert not audit.ok()
+    assert "x.y" in audit.report() and "specgrid_scenarios" in audit.report()
