@@ -53,6 +53,12 @@ import time
 
 import numpy as np
 
+from fm_returnprediction_tpu.telemetry import timed as _timed
+
+# Section timing goes through the telemetry span API (`timed`): one
+# implementation instead of a re-derived perf_counter pair per section,
+# and a bench run under FMRP_TRACE_DIR exports its own sections as spans.
+
 # The live full-scale child pipeline, if any (CPU rescue or mesh8) —
 # published so the deadline watchdog can kill it before hard-exiting the
 # parent: an orphaned real-shape run would burn the host into the next
@@ -115,14 +121,12 @@ def _bench_kernel(fast: bool):
         # host pull = true execution barrier
         return np.asarray(boot.se), [np.asarray(s.coef) for s in results]
 
-    t0 = time.perf_counter()
-    sweep()
-    cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    sweep()
-    warm = time.perf_counter() - t0
-    return {"kernel_fm_boot_cold_s": round(cold, 4),
-            "kernel_fm_boot_warm_s": round(warm, 4),
+    with _timed("bench.kernel_cold") as cold:
+        sweep()
+    with _timed("bench.kernel_warm") as warm:
+        sweep()
+    return {"kernel_fm_boot_cold_s": round(cold.s, 4),
+            "kernel_fm_boot_warm_s": round(warm.s, 4),
             "kernel_shape": f"T{t}_N{n}_B{b}"}
 
 
@@ -138,14 +142,13 @@ def _run_pipeline_timed(raw_dir):
     from fm_returnprediction_tpu.settings import enable_compilation_cache
 
     enable_compilation_cache()
-    t0 = time.perf_counter()
-    res = run_pipeline(
-        raw_data_dir=raw_dir, make_figure=True,
-        make_deciles=True, compile_pdf=False, output_dir=None,
-    )
-    wall = time.perf_counter() - t0
+    with _timed("bench.pipeline_run") as wall:
+        res = run_pipeline(
+            raw_data_dir=raw_dir, make_figure=True,
+            make_deciles=True, compile_pdf=False, output_dir=None,
+        )
     stages = {k: round(v, 3) for k, v in res.timer.durations.items()}
-    return wall, stages
+    return wall.s, stages
 
 
 def _bench_pipeline(fast: bool):
@@ -394,17 +397,15 @@ def _bench_daily_fullscale(fast: bool):
         np.arange(args["n_weeks"]) // 4, m - 1
     ).astype(np.int32)
 
-    t0 = time.perf_counter()
-    daily_characteristics_compact_chunked(**args)
-    cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    daily_characteristics_compact_chunked(**args)
-    warm = time.perf_counter() - t0
+    with _timed("bench.daily_cold") as cold:
+        daily_characteristics_compact_chunked(**args)
+    with _timed("bench.daily_warm") as warm:
+        daily_characteristics_compact_chunked(**args)
     out = {
-        "daily_fullscale_cold_s": round(cold, 4),
-        "daily_fullscale_warm_s": round(warm, 4),
+        "daily_fullscale_cold_s": round(cold.s, 4),
+        "daily_fullscale_warm_s": round(warm.s, 4),
         "daily_fullscale_rows": r,
-        "daily_fullscale_rows_per_s": int(r / warm),
+        "daily_fullscale_rows_per_s": int(r / warm.s),
         "daily_shape": f"D{d_days}_N{n_firms}",
     }
     # In-situ pallas contribution (TPU only, where pallas is the default):
@@ -604,12 +605,11 @@ def _bench_specgrid(fast: bool):
     ))
 
     before = specgrid.program_trace_counts()
-    t0 = time.perf_counter()
-    res = specgrid.run_spec_grid(y, x, masks, grid)
-    grid_cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    res = specgrid.run_spec_grid(y, x, masks, grid)
-    grid_warm = time.perf_counter() - t0
+    with _timed("bench.specgrid_grid_cold") as grid_cold_t:
+        res = specgrid.run_spec_grid(y, x, masks, grid)
+    with _timed("bench.specgrid_grid_warm") as grid_warm_t:
+        res = specgrid.run_spec_grid(y, x, masks, grid)
+    grid_cold, grid_warm = grid_cold_t.s, grid_warm_t.s
     after = specgrid.program_trace_counts()
     programs = (after.get("specgrid_program", 0)
                 - before.get("specgrid_program", 0))
@@ -629,12 +629,11 @@ def _bench_specgrid(fast: bool):
                 out.append(np.asarray(fm.coef))  # host pull = sync
         return out
 
-    t0 = time.perf_counter()
-    qr_coefs = percell()
-    percell_cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    qr_coefs = percell()
-    percell_warm = time.perf_counter() - t0
+    with _timed("bench.specgrid_percell_cold") as percell_cold_t:
+        qr_coefs = percell()
+    with _timed("bench.specgrid_percell_warm") as percell_warm_t:
+        qr_coefs = percell()
+    percell_cold, percell_warm = percell_cold_t.s, percell_warm_t.s
 
     diffs = []
     nan_mismatches = 0
@@ -706,13 +705,13 @@ def _bench_serving(fast: bool):
     firms = rng.integers(0, n, n_queries)
     with ERService(state, max_batch=64, max_latency_ms=1.0, warm=True) as svc:
         base_hits, base_misses = svc.executor.hits, svc.executor.misses
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
-            futs = list(pool.map(
-                lambda q: svc.query(int(months[q]), x[months[q], firms[q]]),
-                range(n_queries),
-            ))
-        wall = time.perf_counter() - t0
+        with _timed("bench.serving_stream") as wall_t:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+                futs = list(pool.map(
+                    lambda q: svc.query(int(months[q]), x[months[q], firms[q]]),
+                    range(n_queries),
+                ))
+        wall = wall_t.s
         stats = svc.stats()
         assert len(futs) == n_queries
     return {
@@ -872,23 +871,23 @@ def _bench_guard(fast: bool):
 
     t, n = (60, 80) if fast else (240, 800)
     data = generate_synthetic_wrds(SyntheticConfig(n_firms=n, n_months=t))
-    t0 = time.perf_counter()
-    panel, factors = build_panel(data, dtype=resolve_dtype())
-    stage_sync(panel.values)
-    build_s = time.perf_counter() - t0
+    with _timed("bench.guard_panel_build") as build_t:
+        panel, factors = build_panel(data, dtype=resolve_dtype())
+        stage_sync(panel.values)
+    build_s = build_t.s
     masks = compute_subset_masks(panel)
 
     contracts.check_panel(panel)  # warm the probe program
-    t0 = time.perf_counter()
-    contracts.check_panel(panel)
-    check_s = time.perf_counter() - t0
+    with _timed("bench.guard_panel_check") as check_t:
+        contracts.check_panel(panel)
+    check_s = check_t.s
 
     def timed_table2(guard_on: bool):
         with checks.guards(guard_on):
             build_table_2(panel, masks, factors)  # warm this configuration
-            t0 = time.perf_counter()
-            tab = build_table_2(panel, masks, factors)
-            return time.perf_counter() - t0, tab
+            with _timed("bench.guard_table2", guard=guard_on) as tt:
+                tab = build_table_2(panel, masks, factors)
+            return tt.s, tab
 
     off_s, table_2 = timed_table2(False)
     on_s, _ = timed_table2(True)
@@ -897,10 +896,10 @@ def _bench_guard(fast: bool):
         base = drift.DriftSentinel(d, "bench")
         base.check("table_2", drift.summarize_frame(table_2))
         base.commit()
-        t0 = time.perf_counter()
-        probe = drift.DriftSentinel(d, "bench")
-        drifted = probe.check("table_2", drift.summarize_frame(table_2))
-        drift_s = time.perf_counter() - t0
+        with _timed("bench.guard_drift_check") as drift_t:
+            probe = drift.DriftSentinel(d, "bench")
+            drifted = probe.check("table_2", drift.summarize_frame(table_2))
+        drift_s = drift_t.s
         assert drifted == []  # identical table: sha short-circuit
 
     return {
@@ -921,20 +920,104 @@ def _jax_cache_stats() -> dict:
     """Entry count + bytes of the persistent XLA compilation cache
     (``_cache/jax``) — the artifact-side evidence for whether the split
     reporting routes' per-cell programs survive across processes/rounds
-    (round-4 VERDICT item 4)."""
-    cache_dir = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "_cache", "jax"
+    (round-4 VERDICT item 4). Promoted into the package
+    (``telemetry.jax_cache_stats``, where it also feeds the registry's
+    derived gauges); this thin alias keeps the bench's historical name."""
+    from fm_returnprediction_tpu.telemetry import jax_cache_stats
+
+    return jax_cache_stats()
+
+
+def _bench_obs(fast: bool):
+    """The telemetry layer's price tag (``telemetry`` subsystem) — the
+    numbers the README quotes for "off is free, on is <5%":
+
+    - ``obs_table2_{off,on}_s``      — warm ``build_table_2`` wall-clock
+      with telemetry disarmed vs armed (spans around every stage/dispatch;
+      the jitted programs are untouched either way — telemetry is
+      host-side only) → ``obs_overhead_table2_pct``. Bound: <5%, same
+      acceptance shape as ``guard_*``.
+    - ``obs_serving_p50_{off,on}_ms`` — sequential single-query p50 on the
+      same warmed service, telemetry off vs on (per-phase samples, not the
+      batcher's cumulative ring — same discipline as the degraded-mode
+      comparison) → ``obs_overhead_serving_p50_pct``.
+    - ``obs_spans_recorded``          — how many spans the armed phases
+      produced (the collector-side evidence the ON phase measured
+      something real).
+
+    FMRP_BENCH_OBS=0 skips."""
+    if os.environ.get("FMRP_BENCH_OBS", "1") == "0":
+        return {}
+    from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.data.synthetic import (
+        SyntheticConfig,
+        generate_synthetic_wrds,
     )
-    try:
-        names = os.listdir(cache_dir)
-        total = sum(
-            os.path.getsize(os.path.join(cache_dir, f))
-            for f in names
-            if os.path.isfile(os.path.join(cache_dir, f))
-        )
-        return {"entries": len(names), "bytes": total}
-    except OSError:
-        return {"entries": 0, "bytes": 0}
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+    from fm_returnprediction_tpu.pipeline import build_panel, resolve_dtype
+    from fm_returnprediction_tpu.reporting.table2 import build_table_2
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    spans_before = telemetry.collector_stats()["spans"]
+
+    # -- warm table_2, telemetry off vs on ---------------------------------
+    t, n = (60, 80) if fast else (240, 800)
+    data = generate_synthetic_wrds(SyntheticConfig(n_firms=n, n_months=t))
+    panel, factors = build_panel(data, dtype=resolve_dtype())
+    masks = compute_subset_masks(panel)
+
+    def timed_table2(tel_on: bool) -> float:
+        with telemetry.enabled(tel_on):
+            build_table_2(panel, masks, factors)  # warm
+            with _timed("bench.obs_table2", telemetry_on=tel_on) as tt:
+                build_table_2(panel, masks, factors)
+            return tt.s
+
+    off_s = timed_table2(False)
+    on_s = timed_table2(True)
+
+    # -- serving p50, telemetry off vs on ----------------------------------
+    ts, ns, p = (48, 80, 5) if fast else (120, 400, 5)
+    n_queries = 200 if fast else 600
+    rng = np.random.default_rng(2017)
+    x = rng.standard_normal((ts, ns, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((ts, ns))).astype(np.float32)
+    mask = rng.random((ts, ns)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(
+        y, x, mask, window=ts // 2, min_periods=ts // 4
+    )
+    months = rng.integers(ts * 3 // 4, ts, n_queries)
+    firms = rng.integers(0, ns, n_queries)
+
+    def p50(svc) -> float:
+        lat = np.empty(n_queries)
+        for q in range(n_queries):
+            t0 = time.perf_counter()
+            svc.query(int(months[q]), x[months[q], firms[q]])
+            lat[q] = time.perf_counter() - t0
+        return float(np.percentile(lat, 50) * 1e3)
+
+    with ERService(state, max_batch=64, max_latency_ms=0.5, warm=True) as svc:
+        with telemetry.enabled(False):
+            p50_off = p50(svc)
+        with telemetry.enabled(True):
+            p50_on = p50(svc)
+
+    return {
+        "obs_table2_off_s": round(off_s, 4),
+        "obs_table2_on_s": round(on_s, 4),
+        "obs_overhead_table2_pct": round(100.0 * (on_s - off_s) / off_s, 2),
+        "obs_serving_p50_off_ms": round(p50_off, 3),
+        "obs_serving_p50_on_ms": round(p50_on, 3),
+        "obs_overhead_serving_p50_pct": round(
+            100.0 * (p50_on - p50_off) / p50_off, 2
+        ),
+        "obs_spans_recorded": (
+            telemetry.collector_stats()["spans"] - spans_before
+        ),
+        "obs_shape": f"T{t}_N{n}_Q{n_queries}",
+    }
 
 
 def _bench_mesh8(fast: bool):
@@ -1240,6 +1323,7 @@ def main() -> None:
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
+    sections.append(_bench_obs)  # _OBS=0 handled in-section
     sections.append(_bench_fuseprobe)  # real ladder on TPU, small on CPU
     sections.append(_bench_mesh8)  # real shape when _MESH8=1, small else
 

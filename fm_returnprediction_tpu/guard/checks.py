@@ -159,12 +159,29 @@ def drain() -> Dict[str, int]:
         pending, _PENDING[:] = list(_PENDING), []
     if pending:
         pulled = jax.device_get([v for _, v in pending])
+        fresh: Dict[str, int] = {}
         with _LOCK:
             for (site, _), values in zip(pending, pulled):
                 for name, val in values.items():
                     count = int(val)
                     if count:
-                        _COUNTERS[f"{site}.{name}"] += count
+                        key = f"{site}.{name}"
+                        _COUNTERS[key] += count
+                        fresh[key] = fresh.get(key, 0) + count
+        if fresh:
+            # mirror into the process metrics registry so the sentinel
+            # trips export alongside every other counter (the guard's own
+            # _COUNTERS stays the audit-record source of truth)
+            from fm_returnprediction_tpu.telemetry import event, registry
+
+            for key, count in fresh.items():
+                registry().counter(
+                    "fmrp_guard_sentinel_total",
+                    help="numerical sentinel trips by site.counter",
+                    sentinel=key,
+                ).inc(count)
+                event("guard.sentinel", cat="guard", sentinel=key,
+                      count=count)
     with _LOCK:
         return dict(_COUNTERS)
 

@@ -269,6 +269,9 @@ def _monthly_cs_ols(
     sentinel-less trace; with ``guard=False`` the jaxpr is byte-for-byte
     the unguarded program (pinned by the guard property tests)."""
     TRACES["monthly_cs_ols"] += 1  # trace-time side effect
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    record_trace("monthly_cs_ols")  # compile-event hook (registry + span)
     valid = row_validity(y, x, mask)
     out = jax.vmap(
         lambda yy, xx, vv: _solve_month(yy, xx, vv, solver=solver, guard=guard)

@@ -49,6 +49,7 @@ from fm_returnprediction_tpu.reporting.latex import (
 )
 from fm_returnprediction_tpu.reporting.table1 import build_table_1
 from fm_returnprediction_tpu.reporting.table2 import build_table_2
+from fm_returnprediction_tpu import telemetry as _telemetry
 from fm_returnprediction_tpu.utils.cache import load_cache_data
 from fm_returnprediction_tpu.utils.timing import StageTimer, stage_sync
 
@@ -171,39 +172,44 @@ def build_panel(
     prepared-inputs checkpoint (``data.prepared``);
     ``build_panel_prepared`` is the matching warm-path entry."""
     timer = timer or StageTimer()
-    with timer.stage("panel/universe_filter"):
-        crsp_m = subset_to_common_stock_and_exchanges(data["crsp_m"])
-        # daily: filter + prune in one shot — copying only the 3 columns the
-        # daily stage reads is ~5x cheaper than copying the full frame
-        crsp_d = subset_to_common_stock_and_exchanges(
-            data["crsp_d"], columns=["permno", "dlycaldt", "retx"]
-        )
-        data = {**data, "crsp_m": crsp_m, "crsp_d": crsp_d}
-    with timer.stage("panel/market_equity"):
-        crsp = calculate_market_equity(data["crsp_m"])
-    with timer.stage("panel/compustat"):
-        comp = add_report_date(data["comp"].copy())
-        comp = calc_book_equity(comp)
-        comp = expand_compustat_annual_to_monthly(comp)
-    with timer.stage("panel/ccm_merge"):
-        merged = merge_CRSP_and_Compustat(crsp, comp, data["ccm"])
-        if "mthcaldt" not in merged.columns:
-            merged["mthcaldt"] = merged["jdate"]
-    with timer.stage("factors/daily_ingest"):
-        from fm_returnprediction_tpu.panel.daily import build_compact_daily
+    # ensure_stage: the "/"-nested sub-stages below must sit under an open
+    # parent (StageTimer's nesting validation) — a no-op when the caller
+    # (run_pipeline / load_or_build_panel) already opened "build_panel",
+    # a real stage for standalone callers (bench sections, tests)
+    with timer.ensure_stage("build_panel"):
+        with timer.stage("panel/universe_filter"):
+            crsp_m = subset_to_common_stock_and_exchanges(data["crsp_m"])
+            # daily: filter + prune in one shot — copying only the 3 columns
+            # the daily stage reads is ~5x cheaper than copying the full frame
+            crsp_d = subset_to_common_stock_and_exchanges(
+                data["crsp_d"], columns=["permno", "dlycaldt", "retx"]
+            )
+            data = {**data, "crsp_m": crsp_m, "crsp_d": crsp_d}
+        with timer.stage("panel/market_equity"):
+            crsp = calculate_market_equity(data["crsp_m"])
+        with timer.stage("panel/compustat"):
+            comp = add_report_date(data["comp"].copy())
+            comp = calc_book_equity(comp)
+            comp = expand_compustat_annual_to_monthly(comp)
+        with timer.stage("panel/ccm_merge"):
+            merged = merge_CRSP_and_Compustat(crsp, comp, data["ccm"])
+            if "mthcaldt" not in merged.columns:
+                merged["mthcaldt"] = merged["jdate"]
+        with timer.stage("factors/daily_ingest"):
+            from fm_returnprediction_tpu.panel.daily import build_compact_daily
 
-        # the month vocabulary long_to_dense will derive from ``merged``
-        months = np.unique(merged["jdate"].to_numpy())
-        cd = build_compact_daily(
-            data["crsp_d"], data["crsp_index_d"], months, dtype=dtype
+            # the month vocabulary long_to_dense will derive from ``merged``
+            months = np.unique(merged["jdate"].to_numpy())
+            cd = build_compact_daily(
+                data["crsp_d"], data["crsp_index_d"], months, dtype=dtype
+            )
+        if capture is not None:
+            capture["compact_daily"] = cd
+        return get_factors(
+            merged, None, None, dtype=dtype, mesh=mesh,
+            timer=timer, include_turnover=include_turnover, compact_daily=cd,
+            capture=capture,
         )
-    if capture is not None:
-        capture["compact_daily"] = cd
-    return get_factors(
-        merged, None, None, dtype=dtype, mesh=mesh,
-        timer=timer, include_turnover=include_turnover, compact_daily=cd,
-        capture=capture,
-    )
 
 
 def build_panel_prepared(
@@ -213,11 +219,13 @@ def build_panel_prepared(
     """Warm-path panel build from the prepared-inputs checkpoint: the
     dense base panel and compact daily strips skip straight to the
     device stages (``data.prepared`` docstring)."""
-    return get_factors(
-        None, None, None, dtype=dtype, mesh=mesh, timer=timer,
-        include_turnover=include_turnover, compact_daily=compact_daily,
-        dense_base=dense_base,
-    )
+    timer = timer or StageTimer()
+    with timer.ensure_stage("build_panel"):
+        return get_factors(
+            None, None, None, dtype=dtype, mesh=mesh, timer=timer,
+            include_turnover=include_turnover, compact_daily=compact_daily,
+            dense_base=dense_base,
+        )
 
 
 def load_or_build_panel(
@@ -286,7 +294,9 @@ def load_or_build_panel(
         )
         stage_sync(panel.values)
         if write_prepared:
-            with timer.stage("save_prepared"):
+            # nested name: this block runs INSIDE the "build_panel" stage,
+            # so a bare top-level name here would double-count in total()
+            with timer.stage("build_panel/save_prepared"):
                 save_prepared(prepared_dir, fingerprint,
                               capture["dense_base"], capture["compact_daily"])
     # The raw frames (the 77M-row daily table in particular) and the
@@ -314,6 +324,7 @@ def run_pipeline(
     checkpoint_dir=None,
     guard: Optional[bool] = None,
     audit_dir=None,
+    trace_dir=None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
 
@@ -346,12 +357,23 @@ def run_pipeline(
     compared against the previous same-fingerprint run's audit manifest —
     any moment outside the tolerance band raises ``DriftDetectedError``
     with a per-column report (after artifacts are saved, and without
-    overwriting the trusted manifest) — then the manifest is updated."""
+    overwriting the trusted manifest) — then the manifest is updated.
+
+    ``trace_dir`` arms the telemetry layer for the run (``telemetry``
+    subsystem; ``None`` follows ``FMRP_TRACE_DIR``, default off): every
+    stage/task/retry/dispatch is recorded as a host span and exported to
+    ``<trace_dir>/events.jsonl`` (structured event log) and
+    ``<trace_dir>/trace.json`` (Chrome trace-event format — load in
+    Perfetto alongside a ``jax.profiler`` device trace). Telemetry is
+    host-side only: with it off OR on, jaxprs and artifacts are
+    bit-identical (pinned by the ``obs`` tests)."""
     from fm_returnprediction_tpu.guard import checks as _guard_checks
 
     if guard is None:
         guard = _guard_checks.guard_active()
-    with _guard_checks.guards(bool(guard)):
+    with _telemetry.tracing(trace_dir), _telemetry.span(
+        "run_pipeline", cat="pipeline"
+    ), _guard_checks.guards(bool(guard)):
         return _run_pipeline_guarded(
             raw_data_dir=raw_data_dir,
             output_dir=output_dir,
@@ -455,7 +477,9 @@ def _run_pipeline_guarded(
 
     panel_stats = None
     if guard:
-        with timer.stage("guard/panel_contracts"):
+        # top-level name (no "/"): this stage has no enclosing parent, so a
+        # nested name would vanish from total() (StageTimer validation)
+        with timer.stage("guard_panel_contracts"):
             # one fused probe program; the summary doubles as the drift
             # sentinel's panel_stats artifact
             panel_stats = _contracts.check_panel(panel, dtype=dtype,
@@ -708,7 +732,7 @@ def _run_pipeline_guarded(
             summarize_frame,
         )
 
-        with timer.stage("guard/drift"):
+        with timer.stage("guard_drift"):
             sentinel = DriftSentinel(
                 audit_dir,
                 _pipeline_fingerprint(panel, dtype, _provenance_salt()),
@@ -795,6 +819,13 @@ def _main() -> None:
              "previous run's audit manifest in this directory; drift "
              "beyond band fails loudly, a clean run updates the manifest",
     )
+    parser.add_argument(
+        "--trace-dir", default=None,
+        help="arm telemetry and export the run's host spans here: "
+             "events.jsonl (structured event log) + trace.json (Chrome "
+             "trace-event format, loads in Perfetto alongside a "
+             "jax.profiler device trace); default follows FMRP_TRACE_DIR",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -820,6 +851,7 @@ def _main() -> None:
         checkpoint_dir=args.checkpoint_dir,
         guard=False if args.no_guard else None,
         audit_dir=args.audit_dir,
+        trace_dir=args.trace_dir,
     )
     print(result.table_1.round(3).to_string())
     print()

@@ -31,11 +31,20 @@ import warnings
 from pathlib import Path
 from typing import Callable, Optional
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
 
 __all__ = ["StageCheckpointer"]
 
 _MANIFEST = "manifest.json"
+
+
+def _checkpoint_counter(outcome: str):
+    return telemetry.registry().counter(
+        "fmrp_checkpoint_total",
+        help="stage checkpoint-resume outcomes by kind",
+        outcome=outcome,
+    )
 
 
 def _file_sha256(path: Path) -> str:
@@ -113,6 +122,8 @@ class StageCheckpointer:
     def _load(self, name: str, loader: Callable[[Path], object]):
         rec = self._stages.get(name)
         if rec is None:
+            _checkpoint_counter("miss").inc()
+            telemetry.event("checkpoint.miss", cat="resilience", stage=name)
             return None
         path = self.dir / rec["file"]
         try:
@@ -122,8 +133,16 @@ class StageCheckpointer:
                 raise CorruptArtifactError(
                     f"checkpoint {name!r} failed its content hash"
                 )
-            return loader(path)
+            got = loader(path)
+            _checkpoint_counter("hit").inc()
+            telemetry.event("checkpoint.hit", cat="resilience", stage=name)
+            return got
         except Exception as exc:  # noqa: BLE001 — any unreadable artifact rebuilds
+            _checkpoint_counter("corrupt").inc()
+            telemetry.event(
+                "checkpoint.corrupt", cat="resilience",
+                stage=name, error=repr(exc)[:200],
+            )
             warnings.warn(
                 f"stage checkpoint {name!r} unreadable, recomputing: {exc!r}",
                 stacklevel=3,
@@ -148,6 +167,8 @@ class StageCheckpointer:
             "file": final.name, "sha256": _file_sha256(final)
         }
         self._write_manifest()
+        _checkpoint_counter("save").inc()
+        telemetry.event("checkpoint.save", cat="resilience", stage=name)
 
     # -- pandas convenience ------------------------------------------------
 

@@ -18,6 +18,7 @@ import hashlib
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.resilience.errors import RetryExhaustedError
 
 __all__ = ["RetryPolicy", "call_with_retry", "retrying"]
@@ -88,14 +89,41 @@ def call_with_retry(
     last_err: Optional[BaseException] = None
     for attempt in range(1, policy.max_attempts + 1):
         try:
-            return fn()
+            # each attempt is its own span (telemetry off: shared no-op),
+            # so a trace shows attempt 3's wall next to attempts 1-2
+            with telemetry.span(
+                f"retry:{label}" if label else "retry",
+                cat="retry", attempt=attempt,
+            ):
+                return fn()
         except policy.retry_on as err:
             last_err = err
+            telemetry.registry().counter(
+                "fmrp_retry_attempts_total",
+                help="retryable attempt failures across every layer",
+            ).inc()
+            telemetry.event(
+                "retry.attempt", cat="retry", label=label,
+                attempt=attempt, error=repr(err)[:200],
+            )
             if attempt == policy.max_attempts:
                 break
             if on_retry is not None:
                 on_retry(attempt, err)
-            sleep(policy.delay_s(attempt, label))
+            delay = policy.delay_s(attempt, label)
+            telemetry.event(
+                "retry.backoff", cat="retry", label=label,
+                attempt=attempt, delay_s=round(delay, 6),
+            )
+            sleep(delay)
+    telemetry.registry().counter(
+        "fmrp_retry_exhausted_total",
+        help="calls that failed after their full attempt budget",
+    ).inc()
+    telemetry.event(
+        "retry.exhausted", cat="retry", label=label,
+        attempts=policy.max_attempts,
+    )
     raise RetryExhaustedError(
         f"{label or getattr(fn, '__name__', 'call')} failed "
         f"after {policy.max_attempts} attempts"

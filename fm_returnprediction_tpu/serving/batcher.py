@@ -33,6 +33,7 @@ from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.serving.executor import bucket_for
 
 __all__ = ["QueueFullError", "MicroBatcher"]
@@ -89,17 +90,44 @@ class MicroBatcher:
         self._pending: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        # quantile RINGS stay instance-local (exact p50/p99 for stats()'s
+        # bit-for-bit contract — a fixed-bucket histogram cannot reproduce
+        # an exact percentile); the HISTOGRAMS feed the process registry /
+        # Prometheus export with the same observations
         self._latencies: deque = deque(maxlen=_METRICS_WINDOW)
         self._occupancy: deque = deque(maxlen=_METRICS_WINDOW)
-        self._n_done = 0
-        self._n_rejected = 0
-        self._n_batches = 0
-        # failure visibility: batches whose runner RAISED (e.g. a stalled
-        # dispatch failed by the executor watchdog) and the requests that
-        # rode them — the error lands on each request's future, the flusher
-        # survives, and these counters make the event observable in stats()
-        self._n_failed_batches = 0
-        self._n_failed = 0
+        # counters live in the process-wide metrics registry (per-instance
+        # instruments aggregated per family); stats() reads .value as the
+        # same plain ints it always returned
+        reg = telemetry.registry()
+        self._m_done = reg.private_counter(
+            "fmrp_serving_requests_done_total",
+            help="requests answered (result or NaN) by the microbatcher",
+        )
+        self._m_rejected = reg.private_counter(
+            "fmrp_serving_requests_rejected_total",
+            help="submissions refused under backpressure (QueueFullError)",
+        )
+        self._m_batches = reg.private_counter(
+            "fmrp_serving_batches_total", help="batches dispatched",
+        )
+        self._m_failed = reg.private_counter(
+            "fmrp_serving_requests_failed_total",
+            help="requests whose batch runner raised",
+        )
+        self._m_failed_batches = reg.private_counter(
+            "fmrp_serving_failed_batches_total",
+            help="batches whose runner raised",
+        )
+        self._m_latency = reg.private_histogram(
+            "fmrp_serving_request_latency_seconds",
+            help="submit-to-result latency per request",
+        )
+        self._m_occupancy = reg.private_histogram(
+            "fmrp_serving_batch_occupancy",
+            help="rows per dispatched bucket slot",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
         self._thread: Optional[threading.Thread] = None
         if auto_flush:
             self._thread = threading.Thread(
@@ -137,13 +165,22 @@ class MicroBatcher:
                     f"got {x.shape}"
                 )
             if len(self._pending) >= self.max_queue:
-                self._n_rejected += 1
+                self._m_rejected.inc()
+                telemetry.event(
+                    "serving.reject", cat="serving",
+                    queue_depth=len(self._pending),
+                )
                 raise QueueFullError(
                     f"serving queue full ({self.max_queue} pending); "
                     "shed load or retry"
                 )
             self._pending.append(req)
+            depth = len(self._pending)
             self._cv.notify_all()
+        telemetry.event(
+            "serving.submit", cat="serving",
+            month_idx=req.month_idx, queue_depth=depth,
+        )
         return fut
 
     # -- consumer side -----------------------------------------------------
@@ -210,14 +247,17 @@ class MicroBatcher:
         # flusher thread (a dead flusher strands every future after it) —
         # everything lands on the batch's futures instead
         try:
-            month_idx = np.asarray([r.month_idx for r in batch], dtype=np.int32)
-            x = np.stack([r.x for r in batch])
-            valid = np.ones(len(batch), dtype=bool)
-            out = self._runner(month_idx, x, valid)
+            with telemetry.span("serving.batch", cat="serving",
+                                rows=len(batch)):
+                month_idx = np.asarray(
+                    [r.month_idx for r in batch], dtype=np.int32
+                )
+                x = np.stack([r.x for r in batch])
+                valid = np.ones(len(batch), dtype=bool)
+                out = self._runner(month_idx, x, valid)
         except Exception as exc:  # noqa: BLE001 - delivered per-request
-            with self._cv:
-                self._n_failed_batches += 1
-                self._n_failed += len(batch)
+            self._m_failed_batches.inc()
+            self._m_failed.inc(len(batch))
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(exc)
@@ -226,12 +266,15 @@ class MicroBatcher:
         occupancy = len(batch) / bucket_for(
             len(batch), self.max_batch, self.min_bucket
         )
+        self._m_occupancy.observe(occupancy)
+        self._m_batches.inc()
+        self._m_done.inc(len(batch))
         with self._cv:
             self._occupancy.append(occupancy)
-            self._n_batches += 1
-            self._n_done += len(batch)
             for r in batch:
-                self._latencies.append(now - r.t_submit)
+                lat = now - r.t_submit
+                self._latencies.append(lat)
+                self._m_latency.observe(lat)
         for r, value in zip(batch, out):
             if not r.future.cancelled():
                 r.future.set_result(float(value))
@@ -277,11 +320,11 @@ class MicroBatcher:
             occ = np.asarray(self._occupancy, dtype=np.float64)
             out = {
                 "queue_depth": len(self._pending),
-                "n_done": self._n_done,
-                "n_rejected": self._n_rejected,
-                "n_batches": self._n_batches,
-                "n_failed": self._n_failed,
-                "n_failed_batches": self._n_failed_batches,
+                "n_done": self._m_done.value,
+                "n_rejected": self._m_rejected.value,
+                "n_batches": self._m_batches.value,
+                "n_failed": self._m_failed.value,
+                "n_failed_batches": self._m_failed_batches.value,
             }
         out["p50_ms"] = float(np.percentile(lat, 50) * 1e3) if len(lat) else None
         out["p99_ms"] = float(np.percentile(lat, 99) * 1e3) if len(lat) else None

@@ -38,6 +38,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.resilience.errors import DispatchTimeoutError
 from fm_returnprediction_tpu.resilience.faults import fault_site
 
@@ -125,7 +126,6 @@ class BucketedExecutor:
         # forever. None (default) = direct dispatch, zero added machinery
         # on the hot path.
         self.dispatch_timeout_s = dispatch_timeout_s
-        self.timeouts = 0  # dispatches failed by the watchdog
         bucket_sizes(self.max_batch, self.min_bucket)  # fail fast, not in run()
         self._dtype = state.dtype
         # one device push of the fitted arrays, shared by every bucket
@@ -139,9 +139,44 @@ class BucketedExecutor:
         self._n_months = state.n_months
         self._exe: Dict[int, object] = {}
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.compiles = 0
+        # per-instance counters registered into the process-wide metrics
+        # registry (family totals aggregate every executor, incl. retired
+        # ones — the registry folds a collected instance's final counts
+        # into its retained base); the ``hits``/``misses``/... attribute
+        # reads below stay plain ints for the service's stats() merge
+        reg = telemetry.registry()
+        self._m_hits = reg.private_counter(
+            "fmrp_serving_executable_cache_hits_total",
+            help="dispatches served by an already-compiled bucket",
+        )
+        self._m_misses = reg.private_counter(
+            "fmrp_serving_executable_cache_misses_total",
+            help="dispatches that had to compile first",
+        )
+        self._m_compiles = reg.private_counter(
+            "fmrp_serving_executable_compiles_total",
+            help="bucket executables built",
+        )
+        self._m_timeouts = reg.private_counter(
+            "fmrp_serving_dispatch_timeouts_total",
+            help="dispatches failed by the watchdog",
+        )
+
+    @property
+    def hits(self) -> int:
+        return self._m_hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._m_misses.value
+
+    @property
+    def compiles(self) -> int:
+        return self._m_compiles.value
+
+    @property
+    def timeouts(self) -> int:
+        return self._m_timeouts.value
 
     def buckets(self) -> Tuple[int, ...]:
         return bucket_sizes(self.max_batch, self.min_bucket)
@@ -168,10 +203,12 @@ class BucketedExecutor:
         with self._lock:
             exe = self._exe.get(bucket)
         if exe is None:
-            built = self._build(bucket)
+            with telemetry.span("serving.compile", cat="compile",
+                                bucket=bucket):
+                built = self._build(bucket)
             with self._lock:
                 exe = self._exe.setdefault(bucket, built)
-                self.compiles += 1
+            self._m_compiles.inc()
         return exe
 
     def warmup(self) -> Tuple[int, ...]:
@@ -194,10 +231,8 @@ class BucketedExecutor:
             valid = np.ones(b, dtype=bool)
         bucket = bucket_for(b, self.max_batch, self.min_bucket)
         with self._lock:
-            if bucket in self._exe:
-                self.hits += 1
-            else:
-                self.misses += 1
+            compiled = bucket in self._exe
+        (self._m_hits if compiled else self._m_misses).inc()
         exe = self._ensure(bucket)
         pad = bucket - b
         if pad:
@@ -206,7 +241,9 @@ class BucketedExecutor:
             valid = np.concatenate([valid, np.zeros(pad, bool)])
         # month_idx 0 on padding rows is a safe gather; valid=False makes
         # the row an exact no-op (masking discipline).
-        out = self._dispatch(exe, bucket, month_idx, x, valid)
+        with telemetry.span("serving.dispatch", cat="serving",
+                            bucket=bucket, rows=b):
+            out = self._dispatch(exe, bucket, month_idx, x, valid)
         return np.asarray(out)[:b]
 
     def _dispatch(self, exe, bucket: int, month_idx, x, valid):
@@ -225,10 +262,12 @@ class BucketedExecutor:
         if self.dispatch_timeout_s is None:
             return call()
         result: Dict[str, object] = {}
+        parent = telemetry.capture()  # threads do not inherit the context
 
         def target() -> None:
             try:
-                result["out"] = call()
+                with telemetry.attach(parent):
+                    result["out"] = call()
             except BaseException as exc:  # noqa: BLE001 — relayed below
                 result["err"] = exc
 
@@ -238,8 +277,11 @@ class BucketedExecutor:
         worker.start()
         worker.join(self.dispatch_timeout_s)
         if worker.is_alive():
-            with self._lock:
-                self.timeouts += 1
+            self._m_timeouts.inc()
+            telemetry.event(
+                "serving.dispatch_timeout", cat="serving", bucket=bucket,
+                timeout_s=self.dispatch_timeout_s,
+            )
             raise DispatchTimeoutError(
                 f"bucket {bucket} dispatch exceeded "
                 f"{self.dispatch_timeout_s}s (runner stalled; worker abandoned)"

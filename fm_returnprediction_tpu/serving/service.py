@@ -46,6 +46,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.resilience.errors import IngestRejectedError
 from fm_returnprediction_tpu.resilience.faults import fault_site
 from fm_returnprediction_tpu.serving.batcher import MicroBatcher
@@ -81,10 +82,13 @@ class ERService:
         # data fault. None disables (legitimate late data can move a thin
         # month's fit a lot; the knob is for callers who know their feed).
         self.merge_tolerance = merge_tolerance
-        with self.timer.stage("serving/build_executor"):
+        # stage names are TOP-LEVEL on this timer (no "/": StageTimer's
+        # nesting validation — a "/"-name with no enclosing stage would be
+        # silently dropped from total())
+        with self.timer.stage("serving_build_executor"):
             self.executor = self._build_executor(state)
         if warm:
-            with self.timer.stage("serving/warmup"):
+            with self.timer.stage("serving_warmup"):
                 self.executor.warmup()
         self.batcher = MicroBatcher(
             self._dispatch,
@@ -194,7 +198,7 @@ class ERService:
                 self.state, y_new, x_new, mask_new, month=month,
                 audit=self.audit,
             )
-            with self.timer.stage("serving/ingest"):
+            with self.timer.stage("serving_ingest"):
                 new_state = _ingest(self.state, y, x, mask, month)
             merged = new_state.n_months == self.state.n_months
             if merged and self.merge_tolerance is not None:
@@ -207,12 +211,21 @@ class ERService:
                         f"merge divergence: coefficient moved "
                         f"{moved.max():.3g} > tolerance"
                     )
-            with self.timer.stage("serving/ingest_warmup"):
+            with self.timer.stage("serving_ingest_warmup"):
                 new_exec = self._build_executor(new_state)
                 new_exec.warmup()
         except Exception as exc:  # noqa: BLE001 — quarantine, keep serving
             self._quarantined[key] = repr(exc)[:300]
             self._n_ingest_failed += 1
+            telemetry.registry().counter(
+                "fmrp_serving_quarantines_total",
+                help="ingest months quarantined (service kept quoting "
+                     "from last-known-good)",
+            ).inc()
+            telemetry.event(
+                "serving.quarantine", cat="serving",
+                month=key, error=repr(exc)[:200],
+            )
             return False
         # publish: attribute assignment is atomic under the GIL, and
         # append-only month slots mean an in-flight request resolved on the
@@ -265,7 +278,7 @@ class ERService:
             executable_cache_misses=tot["misses"],
             executable_compiles=tot["compiles"],
             buckets_compiled=buckets,
-            warmup_s=self.timer.durations.get("serving/warmup"),
+            warmup_s=self.timer.durations.get("serving_warmup"),
             degraded=self.degraded,
             quarantined_months=sorted(self._quarantined),
             n_ingested=self._n_ingested,
@@ -283,10 +296,68 @@ class ERService:
         ]
         return "\n".join([self.timer.report(), *lines])
 
+    # -- metrics endpoint hook ---------------------------------------------
+
+    def prometheus_metrics(self) -> str:
+        """The process metrics registry plus this service's ``stats()``
+        (numeric entries, ``fmrp_serving_service_*`` gauges) in Prometheus
+        text exposition format — the payload a scrape endpoint serves."""
+        return telemetry.prometheus_text(
+            extra=self.stats(), extra_prefix="fmrp_serving_service_"
+        )
+
+    def start_metrics_server(self, port: int = 0, host: str = "127.0.0.1"):
+        """Serve :meth:`prometheus_metrics` over HTTP (``GET /metrics``) on
+        a daemon thread; returns the bound ``(host, port)``. ``port=0``
+        picks a free port. The server dies with :meth:`close`."""
+        import http.server
+        import threading
+
+        if getattr(self, "_metrics_server", None) is not None:
+            raise RuntimeError(
+                "metrics server already running; close() the service "
+                "first (a second bind would orphan the first server's "
+                "daemon thread and socket)"
+            )
+        service = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib naming
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = service.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._metrics_server = http.server.ThreadingHTTPServer(
+            (host, port), Handler
+        )
+        threading.Thread(
+            target=self._metrics_server.serve_forever,
+            name="fmrp-serving-metrics", daemon=True,
+        ).start()
+        return self._metrics_server.server_address
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
         self.batcher.close()
+        server = getattr(self, "_metrics_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            self._metrics_server = None
+        # a trace-dir-armed run picks up the serving spans too
+        telemetry.flush()
 
     def __enter__(self) -> "ERService":
         return self

@@ -269,6 +269,9 @@ def _spec_grid_program(
     own O(S·T·P) aggregation inside the same program (the scenario sweep
     products over weight schemes without re-contracting the panel)."""
     PROGRAM_TRACES["specgrid_program"] += 1  # trace-time side effect
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    record_trace("specgrid_program")  # compile-event hook (registry + span)
     stats = contract_spec_grams(y, x, universes, uidx, col_sel, window,
                                 firm_chunk=firm_chunk)
     s_specs = col_sel.shape[0]
@@ -387,6 +390,9 @@ def run_spec_grid_weights(
             pos = grid.column_positions(spec)
             mask = universes[uidx[s]] & jnp.asarray(window_np[s])[:, None]
             PROGRAM_TRACES["specgrid_referee_calls"] += 1
+            from fm_returnprediction_tpu.telemetry import record_trace
+
+            record_trace("specgrid_referee")  # compile-event hook
             ref_cs, ref_fm = jax.device_get(
                 fama_macbeth(
                     y, x[:, :, jnp.asarray(pos)], mask,

@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     parser.add_argument("--db", default=None, help="state db path")
     parser.add_argument("--backend", choices=["cpu", "tpu"], default=None,
                         help="override the BACKEND setting")
+    parser.add_argument("--trace-dir", default=None,
+                        help="arm telemetry: export task/stage spans to "
+                             "events.jsonl + trace.json in this directory "
+                             "(default follows FMRP_TRACE_DIR)")
     args = parser.parse_args(argv)
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -47,7 +51,12 @@ def main(argv=None) -> int:
         tasks += build_notebook_tasks()
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"
 
-    with TaskRunner(tasks, db_path=db) as runner:
+    from fm_returnprediction_tpu import telemetry
+    from contextlib import ExitStack
+
+    with ExitStack() as stack:
+        stack.enter_context(telemetry.tracing(args.trace_dir))
+        runner = stack.enter_context(TaskRunner(tasks, db_path=db))
         if args.list:
             for t in tasks:
                 state = "up-to-date" if runner.is_up_to_date(t) else "stale"

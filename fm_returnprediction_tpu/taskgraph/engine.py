@@ -32,6 +32,7 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Union
 
+from fm_returnprediction_tpu import telemetry
 from fm_returnprediction_tpu.resilience.errors import TaskTimeoutError
 
 __all__ = ["Task", "TaskRunner", "Reporter", "GreenReporter", "PlainReporter"]
@@ -384,10 +385,15 @@ class TaskRunner:
         # build). Callers whose actions must run on the main thread
         # (signal handlers) should not set timeout_s.
         result: Dict[str, object] = {}
+        # the worker thread does not inherit this thread's context — hand
+        # the task span across explicitly so everything the action records
+        # (pipeline stages, retries) stays in the task's trace
+        parent = telemetry.capture()
 
         def target() -> None:
             try:
-                result["ok"] = action()
+                with telemetry.attach(parent):
+                    result["ok"] = action()
             except BaseException as exc:  # noqa: BLE001 — relayed below
                 result["err"] = exc
 
@@ -397,6 +403,10 @@ class TaskRunner:
         worker.start()
         worker.join(task.timeout_s)
         if worker.is_alive():
+            telemetry.event(
+                "task.timeout", cat="taskgraph",
+                task=task.name, timeout_s=task.timeout_s,
+            )
             raise TaskTimeoutError(
                 f"task {task.name!r} action exceeded {task.timeout_s}s "
                 "(worker abandoned)"
@@ -450,6 +460,12 @@ class TaskRunner:
             (task.name, error, time.time()),
         )
         self._db.commit()
+        # the structured twin of the sqlite ledger row — the trace and the
+        # failure_log must agree (differential-tested in test_telemetry)
+        telemetry.event(
+            "task.failure", cat="taskgraph",
+            task=task.name, error=error, ran=ran,
+        )
 
     def run(
         self,
@@ -489,12 +505,23 @@ class TaskRunner:
             stale = force or not self.is_up_to_date(task)
             if not self._consensus(stale, _np.any):
                 self.reporter.skip(task)
+                telemetry.event(
+                    "task.skip", cat="taskgraph", task=name,
+                    reason="up-to-date",
+                )
                 continue
             self.reporter.start(task)
             start = time.perf_counter()
             err: Optional[BaseException] = None
             try:
-                self._execute_actions(task)
+                # one span per executed task: retries (retry:<name> child
+                # spans), the watchdogged worker, and everything the action
+                # itself records nest under it in the exported trace
+                with telemetry.span(
+                    f"task:{name}", cat="task", task=name,
+                    keep_going=keep_going,
+                ):
+                    self._execute_actions(task)
             except BaseException as exc:  # noqa: BLE001 — recorded below
                 err = exc
             if not self._consensus(err is None, _np.all):

@@ -1,0 +1,150 @@
+"""Unified telemetry: structured spans, a metrics registry, trace export.
+
+The observability layer (L-obs) the rest of the stack instruments into:
+
+- :mod:`.spans`   — nested, context-propagated host spans with explicit
+  cross-thread hand-off (``capture``/``attach``), point events, and the
+  ``device_sync`` barrier that subsumes ``utils.timing.stage_sync``.
+  ``StageTimer`` is now a thin view over these spans; ``run_pipeline``
+  stages, task-graph tasks (including watchdogged worker threads), retry
+  attempts, and serving request→microbatch→bucket dispatch all emit them.
+- :mod:`.metrics` — typed counters/gauges/histograms in one process-wide
+  registry. The serving batcher/executor counters, the retry policy, the
+  guard sentinels, jit-trace counts and the persistent XLA compile-cache
+  probe (promoted from ``bench.py``) all register here; the pre-existing
+  ``stats()`` dict APIs read the same instruments.
+- :mod:`.export`  — a JSONL structured event log and a Chrome trace file
+  (Perfetto-loadable, epoch-anchored so ``jax.profiler`` device traces
+  line up beside the host spans), plus Prometheus text format for the
+  ``ERService`` metrics endpoint hook.
+
+Discipline (same stance as the guard layer's static flag): telemetry off —
+the default — is near-zero overhead (one global read per instrumented
+site) and changes nothing: jaxprs are byte-identical either way because
+spans are host-side only, and pipeline artifacts are bit-identical
+(pinned by ``tests/test_telemetry.py``). On, the cost is measured and
+bounded <5% by ``bench.py``'s ``obs_overhead`` section.
+
+Knobs: ``FMRP_TELEMETRY=1`` arms span collection; ``FMRP_TRACE_DIR=<dir>``
+(or ``run_pipeline(trace_dir=...)`` / ``--trace-dir``) arms it AND exports
+``events.jsonl`` + ``trace.json`` there on flush/exit.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+from typing import Optional
+
+from fm_returnprediction_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    jax_cache_stats,
+    record_trace,
+    registry,
+)
+from fm_returnprediction_tpu.telemetry.spans import (
+    Span,
+    active,
+    attach,
+    capture,
+    collector_stats,
+    current_span,
+    device_sync,
+    enabled,
+    event,
+    finished_spans,
+    reset,
+    set_enabled,
+    set_trace_dir,
+    span,
+    standalone_events,
+    timed,
+    trace_dir,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "active",
+    "attach",
+    "capture",
+    "collector_stats",
+    "current_span",
+    "device_sync",
+    "enabled",
+    "event",
+    "finished_spans",
+    "flush",
+    "jax_cache_stats",
+    "prometheus_text",
+    "record_trace",
+    "registry",
+    "reset",
+    "set_enabled",
+    "set_trace_dir",
+    "span",
+    "standalone_events",
+    "timed",
+    "trace_dir",
+    "tracing",
+]
+
+
+def prometheus_text(extra=None, extra_prefix: str = "") -> str:
+    """Registry (+ optional extra gauges) in Prometheus text format."""
+    from fm_returnprediction_tpu.telemetry import export
+
+    return export.prometheus_text(extra=extra, extra_prefix=extra_prefix)
+
+
+def flush() -> Optional[tuple]:
+    """Export the collector to the configured trace dir (``events.jsonl``
+    + ``trace.json``); no-op returning None when no dir is armed. Safe to
+    call repeatedly — whole-file rewrites, each flush extends the artifact
+    with whatever ran since the last one."""
+    directory = trace_dir()
+    if directory is None:
+        return None
+    from fm_returnprediction_tpu.telemetry import export
+
+    return export.export_all(directory)
+
+
+@contextlib.contextmanager
+def tracing(directory=None):
+    """Arm telemetry for a block and flush exports on exit.
+
+    ``directory`` (or, when None, the ambient ``FMRP_TRACE_DIR``) becomes
+    the export sink. With neither set and telemetry not otherwise enabled
+    this is a pure pass-through — ``run_pipeline`` wraps its whole body in
+    it unconditionally."""
+    prev_dir = trace_dir()
+    directory = directory or prev_dir
+    if directory is None and not active():
+        yield
+        return
+    if directory is not None:
+        set_trace_dir(directory)
+    with enabled(True):
+        try:
+            yield
+        finally:
+            flush()
+            # restore, don't leak: one traced run must not leave tracing
+            # armed (and its export dir targeted) for every later run in
+            # the process
+            set_trace_dir(prev_dir)
+
+
+@atexit.register
+def _flush_at_exit() -> None:  # pragma: no cover - interpreter shutdown
+    try:
+        flush()
+    except Exception:  # noqa: BLE001 — never fail shutdown over telemetry
+        pass

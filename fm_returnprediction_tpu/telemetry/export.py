@@ -1,0 +1,276 @@
+"""Trace/metrics exporters: JSONL event log, Chrome trace, Prometheus text.
+
+Three consumers, three formats, one span store:
+
+- :func:`write_jsonl` — the structured event log (``events.jsonl``): one
+  JSON object per line, ``type`` in {``span``, ``event``, ``meta``,
+  ``metrics``}, timestamps in epoch microseconds, deterministic key order
+  and record order (sorted by start time then span id) so two exports of
+  the same collector state are byte-identical — the diffable artifact the
+  resilience differential test compares against the sqlite
+  ``failure_log``.
+- :func:`write_chrome_trace` — Chrome trace-event format (``trace.json``):
+  ``X`` complete events for spans, ``i`` instants for events, ``M``
+  metadata rows naming threads. Loads in Perfetto / ``chrome://tracing``;
+  because span timestamps are epoch-anchored, a ``jax.profiler`` device
+  trace of the same run lines up alongside the host spans on one
+  timeline.
+- :func:`prometheus_text` — the registry in Prometheus exposition format;
+  :class:`ERService`'s metrics endpoint hook serves it.
+
+:func:`export_all` writes both trace files into a directory (the
+``FMRP_TRACE_DIR`` / ``--trace-dir`` sink). It rewrites whole files from
+the collector on every call, so repeated flushes (end of ``run_pipeline``,
+``ERService.close``, atexit) are idempotent and each one extends the
+artifact with whatever ran since.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import List, Optional
+
+from fm_returnprediction_tpu.telemetry import metrics as _metrics
+from fm_returnprediction_tpu.telemetry import spans as _spans
+
+__all__ = [
+    "span_record",
+    "event_record",
+    "write_jsonl",
+    "chrome_trace_events",
+    "write_chrome_trace",
+    "export_all",
+    "prometheus_text",
+    "JSONL_NAME",
+    "CHROME_TRACE_NAME",
+]
+
+JSONL_NAME = "events.jsonl"
+CHROME_TRACE_NAME = "trace.json"
+
+
+def _ts_us(t_ns: int) -> float:
+    """perf_counter_ns → epoch microseconds (one anchor per process)."""
+    return (t_ns + _spans.EPOCH_ANCHOR_NS) / 1e3
+
+
+def _clean(attrs: dict) -> dict:
+    """JSON-safe attrs: anything non-primitive goes through repr."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[str(k)] = v
+        else:
+            out[str(k)] = repr(v)[:200]
+    return out
+
+
+def span_record(s: "_spans.Span") -> dict:
+    end_ns = s.t1_ns if s.t1_ns is not None else s.t0_ns
+    return {
+        "type": "span",
+        "name": s.name,
+        "cat": s.cat,
+        "trace_id": s.trace_id,
+        "span_id": s.span_id,
+        "parent_id": s.parent_id,
+        "ts_us": round(_ts_us(s.t0_ns), 3),
+        "dur_us": round((end_ns - s.t0_ns) / 1e3, 3),
+        "thread_id": s.thread_id,
+        "thread_name": s.thread_name,
+        "attrs": _clean(s.attrs),
+        "events": [
+            {
+                "name": name,
+                "ts_us": round(_ts_us(t_ns), 3),
+                "attrs": _clean(attrs),
+            }
+            for name, t_ns, attrs in s.events
+        ],
+    }
+
+
+def event_record(e: dict) -> dict:
+    return {
+        "type": "event",
+        "name": e["name"],
+        "cat": e["cat"],
+        "ts_us": round(_ts_us(e["t_ns"]), 3),
+        "thread_id": e["thread_id"],
+        "thread_name": e["thread_name"],
+        "attrs": _clean(e["attrs"]),
+    }
+
+
+def _ordered_records() -> List[dict]:
+    """Every collected span/event as records, deterministically ordered
+    (start time, then span id — ties cannot reorder across exports)."""
+    spans = sorted(
+        _spans.finished_spans(), key=lambda s: (s.t0_ns, s.span_id)
+    )
+    events = sorted(
+        _spans.standalone_events(), key=lambda e: (e["t_ns"], e["name"])
+    )
+    return [span_record(s) for s in spans] + [event_record(e) for e in events]
+
+
+def write_jsonl(path, include_metrics: bool = True) -> Path:
+    """The structured event log: a ``meta`` header line, one line per
+    span/standalone event, and (by default) a final ``metrics`` snapshot
+    of the registry."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    stats = _spans.collector_stats()
+    lines = [
+        json.dumps(
+            {
+                "type": "meta",
+                "schema": 1,
+                "pid": os.getpid(),
+                "spans": stats["spans"],
+                "events": stats["events"],
+                "dropped": stats["dropped"],
+            },
+            sort_keys=True,
+        )
+    ]
+    lines += [json.dumps(r, sort_keys=True) for r in _ordered_records()]
+    if include_metrics:
+        collected = _metrics.registry().collect()
+        flat = {}
+        for name, series in collected.items():
+            for key, value in sorted(series.items()):
+                label = ",".join(f"{k}={v}" for k, v in key)
+                flat[f"{name}{{{label}}}" if label else name] = value
+        lines.append(
+            json.dumps({"type": "metrics", "values": flat}, sort_keys=True)
+        )
+    tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
+    tmp.write_text("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def chrome_trace_events(pid: Optional[int] = None) -> List[dict]:
+    """Chrome trace-event dicts for every collected span and event."""
+    pid = os.getpid() if pid is None else pid
+    out: List[dict] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": "fmrp-host"},
+        }
+    ]
+    threads = {}
+    spans = sorted(
+        _spans.finished_spans(), key=lambda s: (s.t0_ns, s.span_id)
+    )
+    for s in spans:
+        threads.setdefault(s.thread_id, s.thread_name)
+    for e in _spans.standalone_events():
+        threads.setdefault(e["thread_id"], e["thread_name"])
+    for tid, name in sorted(threads.items()):
+        out.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": name},
+            }
+        )
+    for s in spans:
+        end_ns = s.t1_ns if s.t1_ns is not None else s.t0_ns
+        out.append(
+            {
+                "ph": "X",
+                "name": s.name,
+                "cat": s.cat,
+                "ts": round(_ts_us(s.t0_ns), 3),
+                "dur": round((end_ns - s.t0_ns) / 1e3, 3),
+                "pid": pid,
+                "tid": s.thread_id,
+                "args": {
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
+                    **_clean(s.attrs),
+                },
+            }
+        )
+        for name, t_ns, attrs in s.events:
+            out.append(
+                {
+                    "ph": "i",
+                    "name": name,
+                    "cat": "event",
+                    "ts": round(_ts_us(t_ns), 3),
+                    "pid": pid,
+                    "tid": s.thread_id,
+                    "s": "t",
+                    "args": {"span_id": s.span_id, **_clean(attrs)},
+                }
+            )
+    for e in sorted(
+        _spans.standalone_events(), key=lambda e: (e["t_ns"], e["name"])
+    ):
+        out.append(
+            {
+                "ph": "i",
+                "name": e["name"],
+                "cat": e["cat"],
+                "ts": round(_ts_us(e["t_ns"]), 3),
+                "pid": pid,
+                "tid": e["thread_id"],
+                "s": "t",
+                "args": _clean(e["attrs"]),
+            }
+        )
+    return out
+
+
+def write_chrome_trace(path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    doc = {
+        "traceEvents": chrome_trace_events(),
+        "displayTimeUnit": "ms",
+    }
+    tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
+    tmp.write_text(json.dumps(doc, sort_keys=True))
+    os.replace(tmp, path)
+    return path
+
+
+def export_all(trace_dir) -> tuple:
+    """Write ``events.jsonl`` + ``trace.json`` into ``trace_dir``; returns
+    the two paths. Idempotent: whole-file rewrites from the collector."""
+    trace_dir = Path(trace_dir)
+    jsonl = write_jsonl(trace_dir / JSONL_NAME)
+    chrome = write_chrome_trace(trace_dir / CHROME_TRACE_NAME)
+    return jsonl, chrome
+
+
+def prometheus_text(extra: Optional[dict] = None,
+                    extra_prefix: str = "") -> str:
+    """The registry in Prometheus text format, optionally followed by
+    ``extra`` numeric gauges (an ``ERService`` renders its ``stats()``
+    dict through this — bools as 0/1, non-numerics skipped)."""
+    text = _metrics.registry().to_prometheus()
+    if not extra:
+        return text
+    lines = [text.rstrip("\n")]
+    for key in sorted(extra):
+        value = extra[key]
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)) or value != value:
+            continue  # skip None/lists/NaN
+        name = _metrics.sanitize(f"{extra_prefix}{key}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

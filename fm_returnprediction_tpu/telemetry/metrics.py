@@ -1,0 +1,455 @@
+"""Typed metrics registry: counters, gauges, histograms — one per process.
+
+Before this module the framework kept four ad-hoc counter stores: the
+bucketed executor's ``hits``/``misses``/``compiles`` ints, the
+microbatcher's ``_n_*`` ints plus latency rings, the guard layer's
+process ``Counter`` of sentinel trips, and ``bench.py``'s private
+``_jax_cache_stats`` probe. Each had its own read path and none were
+exportable. This registry is the single process-wide store they all
+register into; the existing ``stats()`` dict APIs stay bit-for-bit as thin
+views over the same instruments.
+
+Instrument model (small on purpose — three types, Prometheus-compatible):
+
+- :class:`Counter` — monotonic float/int, ``inc(n)``;
+- :class:`Gauge`   — settable value, or a callable sampled at collect time
+  (``gauge_fn`` — e.g. the persistent XLA compile-cache size);
+- :class:`Histogram` — fixed cumulative buckets + sum/count (latencies,
+  batch occupancy).
+
+Two ownership modes cover the codebase's two shapes:
+
+- ``registry().counter(name, **labels)`` returns THE shared instrument for
+  that (name, labels) — process-wide totals (retry attempts, guard
+  sentinel trips, jit traces);
+- ``registry().private_counter(name, **labels)`` returns a FRESH
+  instrument aggregated under the same family — per-instance counters
+  (one executor's cache hits) whose owner reads ``.value`` for its own
+  ``stats()`` while the family export sums every live instance plus a
+  retained base folded in when an instance is garbage-collected (a
+  Prometheus counter must never go backwards because a retired executor
+  was dropped).
+
+``to_prometheus()`` renders the whole registry in Prometheus text
+exposition format — the ``ERService`` metrics endpoint hook serves it.
+:func:`jax_cache_stats` is the compile-cache probe promoted out of
+``bench.py`` so the registry (and the bench) share one implementation.
+
+Metrics are always on: an increment is one small lock — the counters here
+replace plain-int bumps the hot paths already performed, and the
+``obs_overhead`` bench section bounds the end-to-end cost. (Span
+*tracing* is the part gated behind ``FMRP_TELEMETRY`` — see ``spans``.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "jax_cache_stats",
+    "record_trace",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+# seconds — tuned for host-side serving latencies (sub-ms to tens of s)
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter. ``value`` is an exact int when only ints were
+    added — the serving ``stats()`` views rely on that."""
+
+    __slots__ = ("_lock", "_cell", "__weakref__")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cell = [0]  # one-element list: outlives the instance via the
+        # registry's GC-fold finalizer closure
+
+    def inc(self, n=1) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._cell[0] += n
+
+    @property
+    def value(self):
+        return self._cell[0]
+
+
+class Gauge:
+    """Settable point-in-time value; ``fn`` variants are sampled lazily."""
+
+    __slots__ = ("_lock", "_cell", "_fn", "__weakref__")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None) -> None:
+        self._lock = threading.Lock()
+        self._cell = [0.0]
+        self._fn = fn
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._cell[0] = v
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — a broken probe reads as 0
+                return 0.0
+        return self._cell[0]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: ``le`` upper
+    bounds, plus ``sum`` and ``count``)."""
+
+    __slots__ = ("_lock", "_cell", "bounds", "__weakref__")
+
+    def __init__(self, bounds=DEFAULT_LATENCY_BUCKETS) -> None:
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError("histogram bounds must be sorted ascending")
+        self._lock = threading.Lock()
+        # counts per bucket (+inf last), then sum, then count
+        self._cell = [[0] * (len(self.bounds) + 1), 0.0, 0]
+
+    def observe(self, v) -> None:
+        v = float(v)
+        idx = len(self.bounds)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._cell[0][idx] += 1
+            self._cell[1] += v
+            self._cell[2] += 1
+
+    @property
+    def count(self) -> int:
+        return self._cell[2]
+
+    @property
+    def sum(self) -> float:
+        return self._cell[1]
+
+
+def _zero_state(kind: str, bounds) -> object:
+    if kind == "histogram":
+        return [[0] * (len(bounds) + 1), 0.0, 0]
+    return [0]
+
+
+def _fold_state(kind: str, base, cell) -> None:
+    """Fold a dead instrument's final cell into the series base (the cell
+    outlives its instrument via the finalizer closure)."""
+    if kind == "histogram":
+        for i, c in enumerate(cell[0]):
+            base[0][i] += c
+        base[1] += cell[1]
+        base[2] += cell[2]
+    else:
+        base[0] += cell[0]
+
+
+class _Series:
+    """One (family, labelset): retained base + live instruments."""
+
+    __slots__ = ("labels", "base", "instruments", "shared")
+
+    def __init__(self, labels: LabelKey, kind: str, bounds) -> None:
+        self.labels = labels
+        self.base = _zero_state(kind, bounds)
+        self.instruments: List[weakref.ref] = []
+        self.shared = None  # the singleton instrument for shared series
+
+
+class _Family:
+    __slots__ = ("name", "kind", "help", "bounds", "series")
+
+    def __init__(self, name, kind, help_, bounds) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.bounds = bounds
+        self.series: Dict[LabelKey, _Series] = {}
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Coerce to a legal Prometheus metric/label name."""
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class MetricsRegistry:
+    """Process-wide instrument store with families aggregated for export."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        # default derived gauges: the persistent XLA compile cache — the
+        # artifact-side evidence for cross-process compile reuse, promoted
+        # from bench.py's private probe
+        self.gauge_fn(
+            "fmrp_jax_compile_cache_entries",
+            lambda: jax_cache_stats()["entries"],
+            help="files in the persistent XLA compilation cache",
+        )
+        self.gauge_fn(
+            "fmrp_jax_compile_cache_bytes",
+            lambda: jax_cache_stats()["bytes"],
+            help="bytes in the persistent XLA compilation cache",
+        )
+
+    # -- instrument creation ----------------------------------------------
+
+    def _series(self, name, kind, help_, labels, bounds=None) -> _Series:
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(name, kind, help_, bounds)
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            series = fam.series.get(key)
+            if series is None:
+                series = fam.series[key] = _Series(key, kind, bounds or ())
+            return series
+
+    def _new_instrument(self, name, kind, help_, labels, bounds=None):
+        series = self._series(name, kind, help_, labels, bounds)
+        if kind == "counter":
+            inst = Counter()
+        elif kind == "gauge":
+            inst = Gauge()
+        else:
+            inst = Histogram(bounds or DEFAULT_LATENCY_BUCKETS)
+        with self._lock:
+            series.instruments.append(weakref.ref(inst))
+            # fold the final counts into the retained base when the owner
+            # (a retired executor, a closed batcher) is collected — family
+            # totals must never go backwards
+            weakref.finalize(inst, _fold_state, kind, series.base, inst._cell)
+        return inst, series
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        """THE shared counter for (name, labels) — created once."""
+        series = self._series(name, "counter", help, labels)
+        with self._lock:
+            if series.shared is None:
+                series.shared = Counter()
+                series.instruments.append(weakref.ref(series.shared))
+            return series.shared
+
+    def private_counter(self, name: str, help: str = "", **labels) -> Counter:
+        """A fresh counter aggregated under the (name, labels) family —
+        per-instance ownership, family-level export."""
+        inst, _ = self._new_instrument(name, "counter", help, labels)
+        return inst
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        series = self._series(name, "gauge", help, labels)
+        with self._lock:
+            if series.shared is None:
+                series.shared = Gauge()
+                series.instruments.append(weakref.ref(series.shared))
+            return series.shared
+
+    def gauge_fn(self, name: str, fn: Callable[[], float],
+                 help: str = "", **labels) -> None:
+        """Register a derived gauge sampled at collect time."""
+        series = self._series(name, "gauge", help, labels)
+        g = Gauge(fn=fn)
+        with self._lock:
+            series.shared = g
+            series.instruments.append(weakref.ref(g))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_LATENCY_BUCKETS, **labels) -> Histogram:
+        series = self._series(name, "histogram", help, labels,
+                              bounds=tuple(buckets))
+        with self._lock:
+            if series.shared is None:
+                series.shared = Histogram(tuple(buckets))
+                series.instruments.append(weakref.ref(series.shared))
+            return series.shared
+
+    def private_histogram(self, name: str, help: str = "",
+                          buckets=DEFAULT_LATENCY_BUCKETS,
+                          **labels) -> Histogram:
+        inst, _ = self._new_instrument(
+            name, "histogram", help, labels, bounds=tuple(buckets)
+        )
+        return inst
+
+    # -- collection --------------------------------------------------------
+
+    def _live_instruments(self, series: _Series) -> list:
+        """Strong refs to the series' live instruments, PRUNING dead
+        weakrefs in place (their counts already folded into the base by
+        the finalizer) — a long-lived process creating instruments per
+        swap/ingest must not grow every collect() linearly forever."""
+        with self._lock:
+            live = [(r, r()) for r in series.instruments]
+            if any(inst is None for _, inst in live):
+                series.instruments[:] = [r for r, inst in live if inst is not None]
+        return [inst for _, inst in live if inst is not None]
+
+    def _series_value(self, fam: _Family, series: _Series):
+        instruments = self._live_instruments(series)
+        if fam.kind == "histogram":
+            bounds = fam.bounds or DEFAULT_LATENCY_BUCKETS
+            total = _zero_state("histogram", bounds)
+            _fold_state("histogram", total, series.base)
+            for inst in instruments:
+                _fold_state("histogram", total, inst._cell)
+            return {
+                "buckets": list(total[0]),
+                "sum": total[1],
+                "count": total[2],
+            }
+        if fam.kind == "gauge":
+            # gauges do not sum dead bases; sample the live instruments
+            vals = [inst.value for inst in instruments]
+            return vals[-1] if vals else 0.0
+        total = series.base[0]
+        for inst in instruments:
+            total += inst._cell[0]
+        return total
+
+    def collect(self) -> Dict[str, Dict[LabelKey, object]]:
+        """name → {labelkey → value} for every family."""
+        with self._lock:
+            fams = {
+                name: (fam, list(fam.series.items()))
+                for name, fam in self._families.items()
+            }
+        out: Dict[str, Dict[LabelKey, object]] = {}
+        for name, (fam, series_items) in fams.items():
+            out[name] = {
+                key: self._series_value(fam, series)
+                for key, series in series_items
+            }
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format for every family."""
+        lines: List[str] = []
+        collected = self.collect()
+        with self._lock:
+            metas = {
+                name: (fam.kind, fam.help, fam.bounds)
+                for name, fam in self._families.items()
+            }
+        for name in sorted(collected):
+            kind, help_, bounds = metas[name]
+            pname = sanitize(name)
+            if help_:
+                lines.append(f"# HELP {pname} {help_}")
+            lines.append(f"# TYPE {pname} {kind}")
+            for key in sorted(collected[name]):
+                value = collected[name][key]
+                label_str = ",".join(
+                    f'{sanitize(k)}="{v}"' for k, v in key
+                )
+                if kind == "histogram":
+                    bnds = list(bounds or DEFAULT_LATENCY_BUCKETS)
+                    cum = 0
+                    for b, c in zip([*bnds, float("inf")], value["buckets"]):
+                        cum += c
+                        le = "+Inf" if b == float("inf") else repr(b)
+                        extra = f'le="{le}"'
+                        ls = f"{label_str},{extra}" if label_str else extra
+                        lines.append(f"{pname}_bucket{{{ls}}} {cum}")
+                    suffix = f"{{{label_str}}}" if label_str else ""
+                    lines.append(f"{pname}_sum{suffix} {value['sum']}")
+                    lines.append(f"{pname}_count{suffix} {value['count']}")
+                else:
+                    suffix = f"{{{label_str}}}" if label_str else ""
+                    lines.append(f"{pname}{suffix} {value}")
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (created on first use)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+def jax_cache_stats(cache_dir=None) -> dict:
+    """Entry count + bytes of the persistent XLA compilation cache —
+    the artifact-side evidence for whether compiled programs survive
+    across processes/rounds. Promoted from ``bench.py`` (which now
+    imports it) so the registry's derived gauges and the bench artifact
+    read one implementation. Resolution mirrors
+    ``settings.enable_compilation_cache``: ``JAX_CACHE_DIR`` else
+    ``BASE_DIR/_cache/jax``."""
+    if cache_dir is None:
+        cache_dir = os.environ.get("JAX_CACHE_DIR")
+        if cache_dir is None:
+            from fm_returnprediction_tpu.settings import config
+
+            cache_dir = os.path.join(str(config("BASE_DIR")), "_cache", "jax")
+    try:
+        names = os.listdir(cache_dir)
+        total = sum(
+            os.path.getsize(os.path.join(cache_dir, f))
+            for f in names
+            if os.path.isfile(os.path.join(cache_dir, f))
+        )
+        return {"entries": len(names), "bytes": total}
+    except OSError:
+        return {"entries": 0, "bytes": 0}
+
+
+def record_trace(program: str) -> None:
+    """Compile-event hook: the hot-path modules call this at their
+    trace-time side-effect sites (``ops.ols.TRACES``,
+    ``specgrid.solve.PROGRAM_TRACES``), so every jit trace lands in the
+    registry (``fmrp_jit_traces_total{program=...}``) and — when tracing
+    is armed — on the current span's timeline as a ``jit_trace`` event
+    (a compile is exactly the kind of wall-clock spike a trace viewer
+    must be able to attribute)."""
+    registry().counter(
+        "fmrp_jit_traces_total",
+        help="jit traces (≈ compiles per shape signature) by program",
+        program=program,
+    ).inc()
+    from fm_returnprediction_tpu.telemetry import spans
+
+    spans.event("jit_trace", cat="compile", program=program)

@@ -5,16 +5,25 @@ execution (``dodo.py:176,189``). The framework's headline metric is
 wall-clock, so every pipeline stage runs under a ``StageTimer`` that records
 per-stage durations, and ``trace`` optionally wraps a region in a
 ``jax.profiler`` trace for TPU profiling.
+
+Since the telemetry layer landed, ``StageTimer`` is a thin VIEW over the
+span tracer (``telemetry.spans``): each ``stage`` block also opens a host
+span (category ``stage``) when telemetry is armed, so the same ``with``
+statements that feed the flat ``durations`` dict feed the exported
+JSONL/Chrome trace — one clock, two read paths. The public API
+(``durations``, ``stage``, ``total``, ``dump``, ``report``) is unchanged.
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
-import os
+import threading
 import time
 from pathlib import Path
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
+
+from fm_returnprediction_tpu.telemetry import spans as _spans
 
 __all__ = ["StageTimer", "stage", "stage_sync", "trace"]
 
@@ -33,34 +42,99 @@ def stage_sync(values) -> None:
     lands in the stage that OWNS the compute, at the cost of
     cross-stage dispatch overlap (~a round trip per coarse stage).
     Default off: production keeps the overlap, the headline wall stays
-    unpadded."""
-    if os.environ.get("FMRP_SYNC_STAGES", "0") == "1":
-        import jax
+    unpadded.
 
-        jax.block_until_ready(values)
+    Delegates to ``telemetry.device_sync``, which additionally records
+    the sync point (and its measured wait) on the current span when
+    telemetry is armed."""
+    _spans.device_sync(values)
 
 
 class StageTimer:
-    """Accumulates named stage durations; can persist them as JSON."""
+    """Accumulates named stage durations; can persist them as JSON.
+
+    Naming convention (enforced — see :meth:`total`): a name containing
+    ``"/"`` is a NESTED sub-stage (``build_panel/ccm_merge``) whose
+    wall-clock is already inside an enclosing top-level stage; a name
+    without ``"/"`` is a top-level stage and must NOT be opened while
+    another stage is open on this timer, or :meth:`total` would count its
+    seconds twice."""
 
     def __init__(self) -> None:
         self.durations: Dict[str, float] = {}
+        self._local = threading.local()
+        # names whose recording violated the nesting convention — total()
+        # refuses to produce a silently-wrong sum over these
+        self._uncovered: set = set()  # "/"-names closed with no parent open
+        self._shadowed: set = set()  # top-level names closed under a parent
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
+        stack = self._stack()
+        nested_ok = bool(stack)
+        stack.append(name)
         start = time.perf_counter()
         try:
-            yield
+            with _spans.span(name, cat="stage"):
+                yield
         finally:
+            stack.pop()
             self.durations[name] = self.durations.get(name, 0.0) + (
                 time.perf_counter() - start
             )
+            if "/" in name and not nested_ok:
+                self._uncovered.add(name)
+            elif "/" not in name and nested_ok:
+                self._shadowed.add(name)
+
+    @contextlib.contextmanager
+    def ensure_stage(self, name: str) -> Iterator[None]:
+        """Open ``name`` only when NO stage is open on this thread — the
+        idiom for helpers that record ``"/"``-nested sub-stages and may be
+        called either under a caller's stage (``run_pipeline``'s
+        ``build_panel`` block) or standalone (a bench section, a test)."""
+        if self._stack():
+            yield
+            return
+        with self.stage(name):
+            yield
 
     def total(self) -> float:
         """Sum of TOP-LEVEL stages only. Names containing "/" are nested
         sub-stages (e.g. ``panel/universe_filter`` inside ``build_panel``)
         whose time is already counted by their parent — summing them too
-        would double-count the largest stages."""
+        would double-count the largest stages.
+
+        The convention is VALIDATED, not just documented: a "/"-named
+        stage recorded with no enclosing stage open (its seconds would
+        silently vanish from the total) or a top-level name recorded
+        inside another stage (its seconds would be counted twice) raises
+        ``ValueError`` here rather than producing a wrong sum."""
+        if self._uncovered or self._shadowed:
+            problems = []
+            if self._uncovered:
+                problems.append(
+                    "nested ('/') stages recorded with no parent stage open "
+                    f"(their time is in no top-level stage): "
+                    f"{sorted(self._uncovered)}"
+                )
+            if self._shadowed:
+                problems.append(
+                    "top-level stages recorded inside another stage (their "
+                    f"time would be counted twice): {sorted(self._shadowed)}"
+                )
+            raise ValueError(
+                "StageTimer.total(): stage nesting convention violated — "
+                + "; ".join(problems)
+                + ". Rename the stage with/without a '/' to match where it "
+                "is opened, or wrap the caller in ensure_stage()."
+            )
         return sum(v for k, v in self.durations.items() if "/" not in k)
 
     def dump(self, path: Path) -> None:

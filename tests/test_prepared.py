@@ -126,7 +126,7 @@ def _tables(res):
 def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     cold = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                         make_deciles=False, compile_pdf=False)
-    assert "save_prepared" in cold.timer.durations
+    assert "build_panel/save_prepared" in cold.timer.durations
     assert (raw_dir / PREPARED_DIRNAME / "meta.json").exists()
 
     warm = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
@@ -135,7 +135,7 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     for skipped in ("load_raw_data", "panel/universe_filter",
                     "panel/market_equity", "panel/ccm_merge",
                     "factors/daily_ingest", "factors/long_to_dense",
-                    "save_prepared"):
+                    "build_panel/save_prepared"):
         assert skipped not in warm.timer.durations, skipped
     assert _tables(warm) == _tables(cold)  # bit-identical reporting
 
@@ -147,7 +147,7 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
         rebuilt = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                                make_deciles=False, compile_pdf=False)
         assert "load_raw_data" in rebuilt.timer.durations
-        assert "save_prepared" in rebuilt.timer.durations
+        assert "build_panel/save_prepared" in rebuilt.timer.durations
         assert _tables(rebuilt) == _tables(cold)
     finally:
         os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns))
@@ -161,4 +161,4 @@ def test_prepared_cache_setting_disables(raw_dir, monkeypatch):
                        make_deciles=False, compile_pdf=False)
     assert "load_raw_data" in res.timer.durations
     assert "load_prepared" not in res.timer.durations
-    assert "save_prepared" not in res.timer.durations
+    assert "build_panel/save_prepared" not in res.timer.durations

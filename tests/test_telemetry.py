@@ -1,0 +1,540 @@
+"""Telemetry layer: spans, metrics registry, exporters — and the property
+that observability is semantically FREE.
+
+The acceptance contract mirrors the guard layer's (``test_guard_property``):
+
+- OFF IS A NO-OP: with telemetry off, ``span`` returns one shared no-op
+  context manager, and — off OR on — the traced jaxprs of the hot paths
+  are byte-identical, because spans are host-side only and never enter a
+  jitted program.
+- ON IS INVISIBLE IN THE NUMBERS: Fama-MacBeth and the serving ``stats()``
+  dicts are bit-identical with telemetry armed vs disarmed.
+- SPANS NEST AND PROPAGATE: parent/trace IDs thread through nesting and
+  across explicit thread hand-offs (``capture``/``attach``) — the task
+  graph's watchdogged workers and the serving dispatch watchdog rely on
+  exactly that.
+- EXPORTS ARE WELL-FORMED AND DETERMINISTIC: the JSONL log round-trips
+  and two exports of the same collector state are byte-identical; the
+  Chrome trace is valid trace-event JSON.
+- THE TRACE AND THE LEDGERS AGREE: the task graph's sqlite
+  ``failure_log`` and the exported ``task.failure`` events describe the
+  same failures (differential), and retry/checkpoint events match their
+  plans.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu import telemetry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+    yield
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+
+
+def _data(t=10, n=24, p=3, seed=7, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(dtype)
+    beta = (rng.standard_normal(p) * 0.05).astype(dtype)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(dtype)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(dtype)
+    return y, x, mask
+
+
+# -- span mechanics ---------------------------------------------------------
+
+
+def test_off_mode_span_is_shared_noop():
+    assert not telemetry.active()
+    cm1, cm2 = telemetry.span("a"), telemetry.span("b", x=1)
+    assert cm1 is cm2  # no allocation on the off path
+    with cm1 as s:
+        assert s is None
+    assert telemetry.finished_spans() == []
+    telemetry.event("ignored", k=1)  # off: dropped
+    assert telemetry.standalone_events() == []
+
+
+def test_span_nesting_and_ids():
+    with telemetry.enabled(True):
+        with telemetry.span("root", cat="stage") as root:
+            telemetry.event("marker", k=1)
+            with telemetry.span("child") as child:
+                with telemetry.span("grandchild") as grand:
+                    pass
+        with telemetry.span("second_root") as r2:
+            pass
+    assert child.parent_id == root.span_id
+    assert grand.parent_id == child.span_id
+    assert child.trace_id == root.trace_id == root.span_id
+    assert r2.parent_id is None and r2.trace_id != root.trace_id
+    # the event landed on the open span, not the standalone list
+    assert [e[0] for e in root.events] == ["marker"]
+    assert telemetry.standalone_events() == []
+    # completion order: children close before parents
+    names = [s.name for s in telemetry.finished_spans()]
+    assert names == ["grandchild", "child", "root", "second_root"]
+    for s in telemetry.finished_spans():
+        assert s.t1_ns >= s.t0_ns
+
+
+def test_span_propagates_across_threads_via_attach():
+    got = {}
+    with telemetry.enabled(True):
+        with telemetry.span("parent") as parent:
+            handoff = telemetry.capture()
+
+            def worker():
+                # a fresh thread has NO ambient span …
+                got["ambient"] = telemetry.current_span()
+                # … until the captured parent is attached explicitly
+                with telemetry.attach(handoff):
+                    with telemetry.span("worker-span"):
+                        pass
+
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+    assert got["ambient"] is None
+    ws = [s for s in telemetry.finished_spans() if s.name == "worker-span"]
+    assert len(ws) == 1
+    assert ws[0].parent_id == parent.span_id
+    assert ws[0].trace_id == parent.trace_id
+    assert ws[0].thread_id != parent.thread_id
+
+
+def test_span_records_exception_and_still_raises():
+    with telemetry.enabled(True):
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("pow")
+    (s,) = telemetry.finished_spans()
+    assert "pow" in s.attrs["error"]
+
+
+# -- off-mode purity: jaxprs and numbers ------------------------------------
+
+
+def test_jaxpr_identical_telemetry_on_vs_off():
+    """Telemetry is host-side only: the traced program is byte-identical
+    with spans armed or not (the analog of the guard layer's off-is-
+    pristine property — but stronger: ON changes nothing either)."""
+    import jax
+
+    from fm_returnprediction_tpu.ops import ols
+
+    y, x, mask = _data()
+    with telemetry.enabled(False):
+        jx_off = str(jax.make_jaxpr(
+            lambda *a: ols._monthly_cs_ols(*a, solver="qr", guard=False)
+        )(y, x, mask))
+    with telemetry.enabled(True):
+        jx_on = str(jax.make_jaxpr(
+            lambda *a: ols._monthly_cs_ols(*a, solver="qr", guard=False)
+        )(y, x, mask))
+    assert jx_on == jx_off
+
+
+def test_fama_macbeth_bit_identical_telemetry_on_vs_off():
+    from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth
+
+    y, x, mask = _data(seed=11)
+    with telemetry.enabled(False):
+        off = fama_macbeth(y, x, mask)
+    with telemetry.enabled(True):
+        on = fama_macbeth(y, x, mask)
+    import jax
+
+    for la, lb in zip(jax.tree.leaves(off), jax.tree.leaves(on)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# -- metrics registry -------------------------------------------------------
+
+
+def test_private_counters_aggregate_and_survive_gc():
+    reg = telemetry.registry()
+    name = "fmrp_test_obs_agg_total"
+    base = reg.collect().get(name, {}).get((), 0)
+    c1 = reg.private_counter(name)
+    c2 = reg.private_counter(name)
+    c1.inc(3)
+    c2.inc(4)
+    assert (c1.value, c2.value) == (3, 4)  # per-instance views
+    assert reg.collect()[name][()] - base == 7
+    del c1  # CPython refcount: folds into the retained base immediately
+    assert reg.collect()[name][()] - base == 7  # family total never drops
+
+
+def test_shared_counter_identity_and_labels():
+    reg = telemetry.registry()
+    a = reg.counter("fmrp_test_obs_shared_total", site="a")
+    b = reg.counter("fmrp_test_obs_shared_total", site="b")
+    assert reg.counter("fmrp_test_obs_shared_total", site="a") is a
+    assert a is not b
+    a.inc(2)
+    text = reg.to_prometheus()
+    assert 'fmrp_test_obs_shared_total{site="a"} 2' in text
+    assert "# TYPE fmrp_test_obs_shared_total counter" in text
+
+
+def test_histogram_prometheus_rendering():
+    reg = telemetry.registry()
+    h = reg.private_histogram(
+        "fmrp_test_obs_lat_seconds", buckets=(0.01, 0.1, 1.0)
+    )
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == pytest.approx(5.555)
+    text = reg.to_prometheus()
+    assert 'fmrp_test_obs_lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'fmrp_test_obs_lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "fmrp_test_obs_lat_seconds_count 4" in text
+
+
+def test_jax_cache_stats_shape():
+    got = telemetry.jax_cache_stats()
+    assert set(got) == {"entries", "bytes"}
+    assert got["entries"] >= 0 and got["bytes"] >= 0
+    # unreadable dir → zeros, not an exception
+    assert telemetry.jax_cache_stats("/nonexistent/nowhere") == {
+        "entries": 0, "bytes": 0,
+    }
+
+
+def test_record_trace_counts_into_registry():
+    reg = telemetry.registry()
+
+    def count():
+        return reg.collect().get("fmrp_jit_traces_total", {}).get(
+            (("program", "test_prog"),), 0
+        )
+
+    before = count()
+    telemetry.record_trace("test_prog")
+    telemetry.record_trace("test_prog")
+    assert count() - before == 2
+
+
+# -- exporters --------------------------------------------------------------
+
+
+def _make_some_spans():
+    with telemetry.enabled(True):
+        with telemetry.span("alpha", cat="stage", idx=1):
+            telemetry.event("tick", n=1)
+            with telemetry.span("beta"):
+                pass
+        telemetry.event("orphan", cat="loose", z="q")
+
+
+def test_jsonl_schema_roundtrip_and_determinism(tmp_path):
+    _make_some_spans()
+    p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    from fm_returnprediction_tpu.telemetry import export
+
+    export.write_jsonl(p1)
+    export.write_jsonl(p2)
+    assert p1.read_bytes() == p2.read_bytes()  # deterministic re-export
+
+    records = [json.loads(line) for line in p1.read_text().splitlines()]
+    assert records[0]["type"] == "meta" and records[0]["schema"] == 1
+    spans = [r for r in records if r["type"] == "span"]
+    events = [r for r in records if r["type"] == "event"]
+    assert [s["name"] for s in spans] == ["alpha", "beta"]  # start order
+    assert [e["name"] for e in events] == ["orphan"]
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        for key in ("name", "cat", "trace_id", "span_id", "parent_id",
+                    "ts_us", "dur_us", "thread_id", "thread_name",
+                    "attrs", "events"):
+            assert key in s
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in by_id  # parent refs resolve
+    assert spans[0]["events"][0]["name"] == "tick"
+    assert records[-1]["type"] == "metrics"
+
+
+def test_chrome_trace_is_valid_and_complete(tmp_path):
+    _make_some_spans()
+    from fm_returnprediction_tpu.telemetry import export
+
+    path = export.write_chrome_trace(tmp_path / "trace.json")
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    phases = {e["ph"] for e in events}
+    assert phases <= {"X", "i", "M"}
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"alpha", "beta"}
+    for e in complete:
+        assert isinstance(e["ts"], (int, float))
+        assert e["dur"] >= 0
+        assert {"pid", "tid", "cat", "args"} <= set(e)
+    assert any(
+        e["ph"] == "M" and e["name"] == "process_name" for e in events
+    )
+    assert any(e["ph"] == "i" and e["name"] == "orphan" for e in events)
+
+
+def test_flush_writes_both_files_to_trace_dir(tmp_path):
+    _make_some_spans()
+    telemetry.set_trace_dir(tmp_path)
+    jsonl, chrome = telemetry.flush()
+    assert jsonl.exists() and chrome.exists()
+    telemetry.set_trace_dir(None)
+    assert telemetry.flush() is None  # unarmed: no-op
+
+
+# -- integrations -----------------------------------------------------------
+
+
+def test_retry_events_match_fault_plan():
+    from fm_returnprediction_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        RetryPolicy,
+        call_with_retry,
+        fault_site,
+    )
+
+    with telemetry.enabled(True):
+        with FaultPlan({"obs.flaky": FaultSpec(times=2)}) as plan:
+            with telemetry.span("carrier"):
+                call_with_retry(
+                    lambda: fault_site("obs.flaky") or True,
+                    RetryPolicy(max_attempts=4, backoff_s=0.01),
+                    label="obs.flaky",
+                    sleep=lambda s: None,
+                )
+    (carrier,) = [
+        s for s in telemetry.finished_spans() if s.name == "carrier"
+    ]
+    attempts = [e for e in carrier.events if e[0] == "retry.attempt"]
+    backoffs = [e for e in carrier.events if e[0] == "retry.backoff"]
+    assert len(attempts) == plan.fired["obs.flaky"] == 2
+    assert len(backoffs) == 2  # one backoff per failed-but-retried attempt
+    spans = [
+        s for s in telemetry.finished_spans() if s.name == "retry:obs.flaky"
+    ]
+    assert len(spans) == 3  # two failures + the success
+    assert [s.attrs["attempt"] for s in spans] == [1, 2, 3]
+
+
+def test_taskgraph_failure_log_matches_trace_events(tmp_path):
+    """Differential: the sqlite failure ledger and the exported JSONL
+    ``task.failure`` events must describe the SAME failures (task names
+    and skip/ran classification)."""
+    from fm_returnprediction_tpu.taskgraph.engine import (
+        PlainReporter,
+        Task,
+        TaskRunner,
+    )
+
+    def boom():
+        raise RuntimeError("injected")
+
+    tasks = [
+        Task(name="a", actions=[boom]),
+        Task(name="b", actions=[lambda: None], task_dep=["a"]),
+        Task(name="c", actions=[lambda: None]),
+    ]
+    with telemetry.enabled(True):
+        with TaskRunner(
+            tasks, db_path=tmp_path / "db.sqlite", reporter=PlainReporter()
+        ) as runner:
+            ok = runner.run(keep_going=True)
+            ledger = runner.failures()
+    assert not ok
+    trace_failures = {
+        e["attrs"]["task"]: e["attrs"]
+        for e in (
+            json.loads(line)
+            for line in _exported_jsonl(tmp_path).splitlines()
+        )
+        if e.get("type") == "event" and e.get("name") == "task.failure"
+    }
+    assert {row["task"] for row in ledger} == set(trace_failures) == {"a", "b"}
+    assert trace_failures["a"]["ran"] is True
+    assert trace_failures["b"]["ran"] is False  # dependency skip
+    for row in ledger:  # error strings agree ledger↔trace
+        assert trace_failures[row["task"]]["error"] == row["error"]
+    # the successful independent subgraph ran under its own task span
+    assert any(
+        s.name == "task:c" for s in telemetry.finished_spans()
+    )
+
+
+def _exported_jsonl(tmp_path) -> str:
+    from fm_returnprediction_tpu.telemetry import export
+
+    return export.write_jsonl(tmp_path / "events.jsonl").read_text()
+
+
+def test_checkpoint_hit_miss_events(tmp_path):
+    from fm_returnprediction_tpu.resilience.checkpoint import (
+        StageCheckpointer,
+    )
+
+    events = []
+    with telemetry.enabled(True):
+        ck = StageCheckpointer(tmp_path, "fp")
+        ck.frame(
+            "t", lambda: __import__("pandas").DataFrame({"a": [1.0]})
+        )  # miss + save
+        ck2 = StageCheckpointer(tmp_path, "fp")
+        ck2.frame("t", lambda: pytest.fail("must load, not recompute"))
+        events = [e["name"] for e in telemetry.standalone_events()]
+    assert events == ["checkpoint.miss", "checkpoint.save", "checkpoint.hit"]
+
+
+def test_serving_stats_shape_unchanged_and_spans_emitted():
+    """Arming telemetry must not change the serving ``stats()`` dict shape
+    (keys and value types), and must produce the request→batch→dispatch
+    span chain."""
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    t, n, p = 24, 40, 4
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=12, min_periods=6)
+
+    def run_queries(svc):
+        for q in range(8):
+            svc.query(t - 1, x[t - 1, q % n])
+        return svc.stats()
+
+    with ERService(state, max_batch=8, warm=True, auto_flush=False) as svc:
+        with telemetry.enabled(False):
+            svc.submit(t - 1, x[t - 1, 0])
+            svc.batcher.drain()
+        off_stats = svc.stats()
+    with telemetry.enabled(True):
+        with ERService(state, max_batch=8, warm=True,
+                       auto_flush=False) as svc:
+            svc.submit(t - 1, x[t - 1, 0])
+            svc.batcher.drain()
+            on_stats = svc.stats()
+    assert set(off_stats) == set(on_stats)
+    for k in off_stats:
+        assert type(off_stats[k]) is type(on_stats[k]), k
+    names = [s.name for s in telemetry.finished_spans()]
+    assert "serving.batch" in names and "serving.dispatch" in names
+    (batch,) = [
+        s for s in telemetry.finished_spans() if s.name == "serving.batch"
+    ]
+    (dispatch,) = [
+        s for s in telemetry.finished_spans() if s.name == "serving.dispatch"
+    ]
+    assert dispatch.parent_id == batch.span_id  # batch → bucket dispatch
+    assert any(
+        e["name"] == "serving.submit"
+        for e in telemetry.standalone_events()
+    )
+
+
+def test_erservice_prometheus_endpoint_hook():
+    from fm_returnprediction_tpu.serving import ERService, build_serving_state
+
+    t, n, p = 24, 40, 4
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=12, min_periods=6)
+    with ERService(state, max_batch=8, warm=True, auto_flush=False) as svc:
+        svc.submit(t - 1, x[t - 1, 0])
+        svc.batcher.drain()
+        text = svc.prometheus_metrics()
+    assert "fmrp_serving_executable_cache_hits_total" in text
+    assert "fmrp_serving_requests_done_total" in text
+    # service-level stats render as gauges (bools as 0/1, None skipped)
+    assert "fmrp_serving_service_n_done 1" in text
+    assert "fmrp_serving_service_degraded 0" in text
+    assert "fmrp_serving_service_quarantined_months" not in text
+
+
+def test_pipeline_trace_dir_end_to_end(tmp_path):
+    """The acceptance flow: one ``trace_dir`` run of ``run_pipeline`` plus
+    a few ERService queries produces one JSONL log and one Chrome trace
+    with host spans for the pipeline stages, the serving dispatches, and
+    the run root."""
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+    from fm_returnprediction_tpu.serving import ERService
+
+    trace_dir = tmp_path / "traces"
+    res = run_pipeline(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=24, n_months=42),
+        make_figure=False, make_deciles=True, make_serving=True,
+        compile_pdf=False, trace_dir=trace_dir,
+    )
+    assert (trace_dir / "events.jsonl").exists()
+    assert (trace_dir / "trace.json").exists()
+
+    # a few online queries, then close() re-flushes the same artifact
+    telemetry.set_trace_dir(trace_dir)
+    with telemetry.enabled(True):
+        with ERService(res.serving_state, max_batch=8, warm=True) as svc:
+            xq = np.zeros(res.serving_state.n_predictors, np.float32)
+            for _ in range(3):
+                svc.query(res.serving_state.n_months - 1, xq)
+
+    records = [
+        json.loads(line)
+        for line in (trace_dir / "events.jsonl").read_text().splitlines()
+    ]
+    span_names = {r["name"] for r in records if r["type"] == "span"}
+    for expected in ("run_pipeline", "load_raw_data", "build_panel",
+                     "subset_masks", "table_1", "table_2", "decile_table",
+                     "serving_state", "serving.batch", "serving.dispatch"):
+        assert expected in span_names, expected
+    # pipeline stages are children of the run root in ONE trace
+    spans = [r for r in records if r["type"] == "span"]
+    root = next(s for s in spans if s["name"] == "run_pipeline")
+    t1 = next(s for s in spans if s["name"] == "table_1")
+    assert t1["parent_id"] == root["span_id"]
+    assert t1["trace_id"] == root["trace_id"]
+    doc = json.loads((trace_dir / "trace.json").read_text())
+    chrome_names = {
+        e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    assert "table_2" in chrome_names and "serving.dispatch" in chrome_names
+
+
+def test_telemetry_off_pipeline_artifacts_bit_identical():
+    """The whole synthetic pipeline: telemetry armed vs disarmed emits
+    bit-identical tables (the tracer is pure observation)."""
+    import pandas as pd
+
+    from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+    from fm_returnprediction_tpu.pipeline import run_pipeline
+
+    kw = dict(
+        synthetic=True,
+        synthetic_config=SyntheticConfig(n_firms=20, n_months=36),
+        make_figure=False, make_deciles=False, make_serving=False,
+        compile_pdf=False,
+    )
+    with telemetry.enabled(False):
+        off = run_pipeline(**kw)
+    with telemetry.enabled(True):
+        on = run_pipeline(**kw)
+    pd.testing.assert_frame_equal(on.table_1, off.table_1)
+    pd.testing.assert_frame_equal(on.table_2, off.table_2)

@@ -9,6 +9,7 @@ default (production keeps cross-stage overlap), a real
 """
 
 import jax.numpy as jnp
+import pytest
 
 from fm_returnprediction_tpu.utils.timing import StageTimer, stage_sync
 
@@ -42,3 +43,44 @@ def test_stage_timer_nested_total():
     # from total() so the parent's wall is not double-counted
     assert "parent/child" in timer.durations
     assert timer.total() == timer.durations["parent"]
+
+
+def test_stage_timer_orphan_nested_name_rejected_by_total():
+    # a "/"-named stage recorded with NO parent stage open: its seconds
+    # are in no top-level stage, so total() would silently drop them —
+    # the convention is validated, not just documented
+    timer = StageTimer()
+    with timer.stage("loose/child"):
+        pass
+    assert "loose/child" in timer.durations  # still recorded
+    with pytest.raises(ValueError, match="no parent stage open"):
+        timer.total()
+
+
+def test_stage_timer_shadowed_top_level_name_rejected_by_total():
+    # the dual failure: a top-level (no "/") name opened INSIDE another
+    # stage would be counted twice by total()
+    timer = StageTimer()
+    with timer.stage("outer"):
+        with timer.stage("inner_top_level"):
+            pass
+    with pytest.raises(ValueError, match="counted twice"):
+        timer.total()
+
+
+def test_stage_timer_ensure_stage_covers_standalone_helpers():
+    # ensure_stage: a real stage when nothing is open (standalone helper
+    # call), a no-op when the caller already opened one
+    timer = StageTimer()
+    with timer.ensure_stage("build_panel"):
+        with timer.stage("panel/sub"):
+            pass
+    assert timer.total() == timer.durations["build_panel"]
+
+    timer2 = StageTimer()
+    with timer2.stage("caller"):
+        with timer2.ensure_stage("build_panel"):  # no-op: caller is open
+            with timer2.stage("panel/sub"):
+                pass
+    assert "build_panel" not in timer2.durations
+    assert timer2.total() == timer2.durations["caller"]
