@@ -130,24 +130,45 @@ def _bench_kernel(fast: bool):
             "kernel_shape": f"T{t}_N{n}_B{b}"}
 
 
-def _run_pipeline_timed(raw_dir):
+def _run_pipeline_timed(raw_dir, warm_label=None):
     """One pipeline run → (wall seconds, per-stage seconds).
 
     Enables the persistent compilation cache HERE, not only in ``main``:
     this helper is also the entry the CPU-rescue and mesh8 CHILD processes
     call, and cross-process compile reuse (the per-cell reporting
     programs) only happens if every process points at the same
-    ``_cache/jax``."""
+    ``_cache/jax``.
+
+    ``warm_label`` declares the run WARM to the recompile sentinel
+    (``telemetry.recompile_watch``): persistent-cache growth during a warm
+    run means something recompiled that should have been reused — r05 saw
+    the cache grow 83→84 on the "warm" run with no attribution — and now
+    counts into ``fmrp_unexpected_recompiles_total`` and warns with the
+    ledger's culprit programs. The per-stage dict also carries the stages
+    the run explicitly SKIPPED (``{"skipped": reason}`` instead of an
+    absent key or a 0.0 that reads as free)."""
+    from fm_returnprediction_tpu import telemetry
     from fm_returnprediction_tpu.pipeline import run_pipeline
     from fm_returnprediction_tpu.settings import enable_compilation_cache
 
     enable_compilation_cache()
-    with _timed("bench.pipeline_run") as wall:
-        res = run_pipeline(
-            raw_data_dir=raw_dir, make_figure=True,
-            make_deciles=True, compile_pdf=False, output_dir=None,
-        )
+    with telemetry.recompile_watch(
+        warm_label or "pipeline_run", warm=warm_label is not None
+    ) as cache_delta:
+        with _timed("bench.pipeline_run") as wall:
+            res = run_pipeline(
+                raw_data_dir=raw_dir, make_figure=True,
+                make_deciles=True, compile_pdf=False, output_dir=None,
+            )
     stages = {k: round(v, 3) for k, v in res.timer.durations.items()}
+    stages.update(
+        {k: {"skipped": v} for k, v in res.timer.skipped.items()}
+    )
+    if warm_label is not None and cache_delta.grew:
+        stages["unexpected_recompiles"] = {
+            "cache_entries_grew": cache_delta.grew,
+            "culprits": list(cache_delta.culprits) or ["unattributed-jit"],
+        }
     return wall.s, stages
 
 
@@ -172,7 +193,7 @@ def _bench_pipeline(fast: bool):
     with tempfile.TemporaryDirectory() as raw_dir:
         write_synthetic_cache(raw_dir, SyntheticConfig(n_firms=n, n_months=t))
         cold, _ = _run_pipeline_timed(raw_dir)
-        warm, stages = _run_pipeline_timed(raw_dir)
+        warm, stages = _run_pipeline_timed(raw_dir, warm_label="pipeline_warm")
     return {"pipeline_cold_s": round(cold, 4),
             "pipeline_warm_s": round(warm, 4),
             "pipeline_stage_s": stages,
@@ -253,7 +274,9 @@ def _bench_pipeline_real(fast: bool):
     out["real_pipeline_cold_stage_s"] = cold_stages
     if cold <= budget:
         try:
-            warm, stages = _run_pipeline_timed(raw_dir)
+            warm, stages = _run_pipeline_timed(
+                raw_dir, warm_label="real_pipeline_warm"
+            )
         except Exception as exc:  # noqa: BLE001 - keep the completed cold
             # a fault in the warm repeat must not throw away the completed
             # full-scale cold measurement (the invariant stated above); the
@@ -350,15 +373,27 @@ def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
     # before save_prepared leaves no checkpoint and the child pays the full
     # cold ingest, which must not masquerade as the repeat-run number. The
     # timer records the load_prepared ATTEMPT even on a miss, so the
-    # discriminator is the raw ingest's absence, not the attempt's presence.
-    warm_like = "load_raw_data" not in got["stages"]
+    # discriminator is the raw ingest not actually RUNNING (on a
+    # checkpoint hit it now appears as an explicit {"skipped": ...} entry
+    # rather than being absent).
+    warm_like = not isinstance(
+        got["stages"].get("load_raw_data"), (int, float)
+    )
     kind = "warm" if warm_like else "cold"
     stage_key = ("real_pipeline_stage_s" if warm_like
                  else "real_pipeline_cold_stage_s")
     return {
         f"real_pipeline_{kind}_s": round(got["wall"], 4),
-        stage_key: {k: round(v, 3) for k, v in got["stages"].items()},
+        stage_key: _round_stages(got["stages"]),
         "real_pipeline_device": "cpu-fallback",
+    }
+
+
+def _round_stages(stages: dict) -> dict:
+    """Round the numeric stage entries; skip markers pass through."""
+    return {
+        k: round(v, 3) if isinstance(v, (int, float)) else v
+        for k, v in stages.items()
     }
 
 
@@ -436,7 +471,14 @@ def _bench_pallas(fast: bool):
     import jax.numpy as jnp
 
     if jax.devices()[0].platform != "tpu":
-        return {"rolling_std_pallas_ms": None, "rolling_std_xla_ms": None}
+        # a structured skip reason, not a silent null: a null in the
+        # artifact reads as "measured nothing for unknown reasons", and
+        # the regression sentinel can't tell it from a parse bug
+        skip = {
+            "skipped": "pallas rolling kernel is TPU-only; "
+                       f"device is {jax.devices()[0].platform}"
+        }
+        return {"rolling_std_pallas_ms": skip, "rolling_std_xla_ms": skip}
 
     from fm_returnprediction_tpu.ops.rolling import rolling_std
 
@@ -604,6 +646,9 @@ def _bench_specgrid(fast: bool):
         for k in model_sizes for u in masks
     ))
 
+    from fm_returnprediction_tpu import telemetry as _telemetry
+
+    ledger_mark = _telemetry.cost_ledger().last_seq
     before = specgrid.program_trace_counts()
     with _timed("bench.specgrid_grid_cold") as grid_cold_t:
         res = specgrid.run_spec_grid(y, x, masks, grid)
@@ -652,7 +697,29 @@ def _bench_specgrid(fast: bool):
     p_sum = sum(k + 2 for k in model_sizes)
     gram_mb = len(grid) * t * q * q * itemsize / 2**20
     real_gram_mb = len(grid) * 600 * q * q * itemsize / 2**20
+    # roofline: the cost ledger knows the fused program's FLOPs from its
+    # AOT compile; warm wall over that gives achieved FLOP/s and the
+    # (rough, disclosed) platform-peak utilization gauge. Only THIS
+    # section's compiles count — earlier pipeline sections compile other
+    # specgrid_program signatures whose FLOPs must not inflate the gauge.
+    section_flops = sum(
+        r.flops or 0.0
+        for r in _telemetry.cost_ledger().since(ledger_mark)
+        if r.program == "specgrid_program"
+    )
+    roofline = (
+        _telemetry.record_runtime(
+            "specgrid_program", grid_warm, flops=section_flops
+        )
+        if section_flops else {}
+    )
+    roofline_keys = {
+        f"specgrid_{k}": (round(v, 6) if k == "roofline_utilization"
+                          else round(v, 1))
+        for k, v in roofline.items()
+    }
     return {
+        **roofline_keys,
         "specgrid_grid_cold_s": round(grid_cold, 4),
         "specgrid_grid_warm_s": round(grid_warm, 4),
         "specgrid_percell_cold_s": round(percell_cold, 4),
@@ -714,6 +781,14 @@ def _bench_serving(fast: bool):
         wall = wall_t.s
         stats = svc.stats()
         assert len(futs) == n_queries
+    # the cost ledger's view of what warm-up bought: every bucket
+    # program's compile seconds and FLOPs are accounted per compile
+    from fm_returnprediction_tpu import telemetry as _telemetry
+
+    ledger = _telemetry.cost_ledger()
+    bucket_records = [
+        r for r in ledger.records() if r.program == "serving_bucket"
+    ]
     return {
         "serving_qps": round(n_queries / wall, 1),
         "serving_p50_ms": round(stats["p50_ms"], 3),
@@ -721,6 +796,10 @@ def _bench_serving(fast: bool):
         "serving_batch_occupancy": round(stats["batch_occupancy"], 4),
         "serving_cache_misses_after_warm": svc.executor.misses - base_misses,
         "serving_dispatches": svc.executor.hits - base_hits,
+        "serving_ledger_programs": len(bucket_records),
+        "serving_ledger_compile_s": round(
+            sum(r.lower_s + r.compile_s for r in bucket_records), 4
+        ),
         "serving_shape": f"T{t}_P{p}_Q{n_queries}",
     }
 
@@ -1108,9 +1187,7 @@ def _mesh8_child_run(real_shape: bool):
     got = json.loads(lines[-1][len("MESH8 "):])
     return {
         "mesh8_pipeline_wall_s": round(got["wall"], 4),
-        "mesh8_pipeline_stage_s": {
-            k: round(v, 3) for k, v in got["stages"].items()
-        },
+        "mesh8_pipeline_stage_s": _round_stages(got["stages"]),
         "mesh8_shape": f"T{t}_N{n}",
         "mesh8_scale": "real" if real_shape else "small",
         "mesh8_device": "cpu-virtual-8",
@@ -1189,7 +1266,13 @@ def _devices_or_die(timeout_s: int = 150):
         done.set()
         return devices, None
     except Exception as exc:  # noqa: BLE001 - recorded, then fall back or exit
-        reason = repr(exc)[:300]
+        # typed outage record, not a raw repr string: consumers (and the
+        # regression sentinel) get probe/timeout/error as separate fields
+        reason = {
+            "probe": "import jax; jax.devices()",
+            "timeout_s": timeout_s,
+            "error": repr(exc)[:300],
+        }
         if _cpu_fallback_possible(min(timeout_s, 90)):
             import jax
 
@@ -1201,6 +1284,26 @@ def _devices_or_die(timeout_s: int = 150):
             "extra": {"backend_init_error": reason},
         }))
         raise SystemExit(0)
+
+
+def _headline(extra: dict):
+    """(metric name, value) for this run's headline, or None when every
+    pipeline section errored. A rescued real-shape number is a HOST
+    number: the metric name itself must say so — a consumer reading only
+    metric/value/device must not be able to record it as an accelerator
+    result."""
+    fell_back = extra.get("real_pipeline_device") == "cpu-fallback"
+    disclose = "_cpu_fallback" if fell_back else ""
+    if "real_pipeline_warm_s" in extra:
+        return (f"e2e_pipeline_{extra['real_pipeline_shape']}"
+                f"_warm{disclose}_wall_s", extra["real_pipeline_warm_s"])
+    if "real_pipeline_cold_s" in extra:
+        return (f"e2e_pipeline_{extra['real_pipeline_shape']}"
+                f"_cold{disclose}_wall_s", extra["real_pipeline_cold_s"])
+    if "pipeline_warm_s" in extra:
+        return (f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s",
+                extra["pipeline_warm_s"])
+    return None
 
 
 _EMIT_LOCK = threading.Lock()
@@ -1223,28 +1326,15 @@ def _emit_line(extra: dict) -> None:
         _emit_line._done = True
 
         budget = 60.0
-        # a rescued real-shape number is a HOST number: the metric name
-        # itself must say so — a consumer reading only metric/value/device
-        # must not be able to record it as an accelerator result
-        fell_back = extra.get("real_pipeline_device") == "cpu-fallback"
-        disclose = "_cpu_fallback" if fell_back else ""
-        if "real_pipeline_warm_s" in extra:
-            warm = extra["real_pipeline_warm_s"]
-            metric = (f"e2e_pipeline_{extra['real_pipeline_shape']}"
-                      f"_warm{disclose}_wall_s")
-        elif "real_pipeline_cold_s" in extra:
-            warm = extra["real_pipeline_cold_s"]
-            metric = (f"e2e_pipeline_{extra['real_pipeline_shape']}"
-                      f"_cold{disclose}_wall_s")
-        elif "pipeline_warm_s" in extra:
-            warm = extra["pipeline_warm_s"]
-            metric = f"e2e_pipeline_{extra['pipeline_shape']}_warm_wall_s"
-        else:  # every pipeline section errored — emit a parseable line
+        headline = _headline(extra)
+        if headline is None:  # every pipeline section errored — emit a
+            # parseable line
             print(json.dumps({"metric": "bench_failed", "value": -1.0,
                               "unit": "s", "vs_baseline": 0.0,
                               "extra": extra}),
                   flush=True)
             return
+        metric, warm = headline
         print(
             json.dumps(
                 {
@@ -1356,19 +1446,79 @@ def main() -> None:
 
     # FMRP_TRACE=<dir> wraps the whole bench in a jax.profiler trace
     # (round-2 VERDICT item 8) — open with TensorBoard/xprof.
+    from fm_returnprediction_tpu.telemetry import recompile_watch
+
+    section_cache_growth = {}
     with trace(os.environ.get("FMRP_TRACE")):
         for section in sections:
             # fault isolation: one section failing must not lose the whole
             # JSON artifact (the driver records exactly one line)
+            delta = None
             try:
-                extra.update(section(fast))
+                # per-section compile-cache diff: which section paid (or
+                # re-paid) compiles is part of the accounting story
+                with recompile_watch(section.__name__) as delta:
+                    extra.update(section(fast))
             except Exception as exc:  # noqa: BLE001 - recorded, not hidden
                 extra[f"{section.__name__}_error"] = repr(exc)[:300]
                 extra[f"{section.__name__}_error_frames"] = _error_frames(exc)
+            if delta is not None and delta.grew:
+                section_cache_growth[section.__name__] = delta.grew
+    if section_cache_growth:
+        extra["section_cache_growth"] = section_cache_growth
 
     bench_done.set()
     extra["jax_cache_after"] = _jax_cache_stats()
     _emit_line(extra)
+    _regress_report(extra)
+
+
+def _regress_report(extra: dict) -> None:
+    """End-of-round perf-regression sentinel: the archived bench history
+    PLUS the round that just ran (its artifact is only archived by the
+    driver after this process exits, so ``extra`` is appended as a
+    synthetic latest round — otherwise the report would re-judge last
+    round). To STDERR (the stdout artifact must stay one JSON line),
+    report-only (the CI gate is the tier-2 pytest / the regress CLI).
+    FMRP_BENCH_REGRESS=0 skips."""
+    if os.environ.get("FMRP_BENCH_REGRESS", "1") == "0":
+        return
+    import glob
+    import sys
+    import tempfile
+
+    try:
+        from fm_returnprediction_tpu.telemetry import regress
+
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        files = sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json")))
+        rounds = regress.load_rounds(files)
+        headline = _headline(extra)
+        this_round = None
+        if headline is not None and rounds:
+            metric, value = headline
+            payload = {
+                "n": max(r.order[0] for r in rounds) + 1,
+                "parsed": {"metric": metric, "value": value,
+                           "extra": extra},
+            }
+            with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix="BENCH_current_",
+                delete=False,
+            ) as fh:
+                json.dump(payload, fh)
+                this_round = fh.name
+        all_rounds = regress.load_rounds(
+            [*files, *( [this_round] if this_round else [] )]
+        )
+        if this_round:
+            os.unlink(this_round)
+        if len(all_rounds) < 2:
+            return
+        report = regress.analyze(all_rounds)
+        print(report.format_text(), file=sys.stderr, flush=True)
+    except Exception as exc:  # noqa: BLE001 — advisory only, never fatal
+        print(f"regress sentinel failed: {exc!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

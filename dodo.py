@@ -71,6 +71,28 @@ for _t in _all_tasks():
 del _t
 
 
+def task_perf_regress():
+    """Run the perf-regression sentinel over the in-repo bench history
+    (``telemetry.regress``): exits non-zero when the latest round
+    regressed a tracked metric beyond its fitted noise band, so a perf
+    regression fails the build instead of living only in JSON diffs."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m "
+            "fm_returnprediction_tpu.telemetry.regress"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "perf-regression sentinel over BENCH_*.json "
+               "(telemetry.regress; fails on regressions beyond band)",
+        "verbosity": 2,
+        "uptodate": [False],  # history-dependent: always re-evaluate
+    }
+
+
 if __name__ == "__main__":
     try:
         from doit.doit_cmd import DoitMain

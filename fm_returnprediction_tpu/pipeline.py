@@ -274,6 +274,10 @@ def load_or_build_panel(
     if prepared is not None:
         base, cd = prepared
         del prepared
+        # an explicit skip marker, NOT a 0.0 duration: the prepared
+        # checkpoint short-circuits the raw ingest, and a zero in the
+        # per-stage breakdown would read as "load_raw_data is free"
+        timer.mark_skipped("load_raw_data", "prepared checkpoint hit")
         with timer.stage("build_panel"):
             panel, factors_dict = build_panel_prepared(
                 base, cd, dtype=dtype, mesh=mesh, timer=timer,
@@ -325,6 +329,7 @@ def run_pipeline(
     guard: Optional[bool] = None,
     audit_dir=None,
     trace_dir=None,
+    profile_dir=None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
 
@@ -366,12 +371,19 @@ def run_pipeline(
     ``<trace_dir>/trace.json`` (Chrome trace-event format — load in
     Perfetto alongside a ``jax.profiler`` device trace). Telemetry is
     host-side only: with it off OR on, jaxprs and artifacts are
-    bit-identical (pinned by the ``obs`` tests)."""
+    bit-identical (pinned by the ``obs`` tests).
+
+    ``profile_dir`` additionally wraps the run in a ``jax.profiler``
+    DEVICE trace written there (``telemetry.profiling``); every host span
+    inside the run also annotates the device trace, so Perfetto shows
+    named device rows beside the host rows the trace exporters produce."""
     from fm_returnprediction_tpu.guard import checks as _guard_checks
 
     if guard is None:
         guard = _guard_checks.guard_active()
-    with _telemetry.tracing(trace_dir), _telemetry.span(
+    with _telemetry.tracing(trace_dir), _telemetry.profiling(
+        profile_dir
+    ), _telemetry.span(
         "run_pipeline", cat="pipeline"
     ), _guard_checks.guards(bool(guard)):
         return _run_pipeline_guarded(
@@ -826,6 +838,12 @@ def _main() -> None:
              "trace-event format, loads in Perfetto alongside a "
              "jax.profiler device trace); default follows FMRP_TRACE_DIR",
     )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="additionally capture a jax.profiler DEVICE trace of the "
+             "run into this directory (host spans annotate the device "
+             "timeline; open with Perfetto/TensorBoard)",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -852,6 +870,7 @@ def _main() -> None:
         guard=False if args.no_guard else None,
         audit_dir=args.audit_dir,
         trace_dir=args.trace_dir,
+        profile_dir=args.profile_dir,
     )
     print(result.table_1.round(3).to_string())
     print()

@@ -73,10 +73,17 @@ class MicroBatcher:
         auto_flush: bool = True,
         n_predictors: Optional[int] = None,
         min_bucket: int = 1,
+        observer: Optional[Callable] = None,
     ):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self._runner = runner
+        # per-request outcome hook ``observer(latency_s, ok, queue_depth)``
+        # — the service's SLO monitor feeds from it (latency_s is None for
+        # a backpressure reject, queue_depth None when unknown). Called
+        # outside the batcher lock and never allowed to raise into the
+        # flusher.
+        self._observer = observer
         # when known, row shape is enforced at SUBMIT so one malformed
         # request fails alone instead of poisoning its whole batch
         self._n_predictors = n_predictors
@@ -147,6 +154,7 @@ class MicroBatcher:
             raise ValueError(f"feature row must be 1-D (P,), got {x.shape}")
         fut: Future = Future()
         req = _Pending(int(month_idx), x, fut, time.perf_counter())
+        rejected_depth = None
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -166,17 +174,23 @@ class MicroBatcher:
                 )
             if len(self._pending) >= self.max_queue:
                 self._m_rejected.inc()
-                telemetry.event(
-                    "serving.reject", cat="serving",
-                    queue_depth=len(self._pending),
-                )
-                raise QueueFullError(
-                    f"serving queue full ({self.max_queue} pending); "
-                    "shed load or retry"
-                )
-            self._pending.append(req)
-            depth = len(self._pending)
-            self._cv.notify_all()
+                rejected_depth = len(self._pending)
+            else:
+                self._pending.append(req)
+                depth = len(self._pending)
+                self._cv.notify_all()
+        if rejected_depth is not None:
+            # event + observer OUTSIDE the lock (the observer contract):
+            # a blocking SLO hook during a queue-full storm must not
+            # serialize every submit and the flusher behind it
+            telemetry.event(
+                "serving.reject", cat="serving", queue_depth=rejected_depth,
+            )
+            self._notify(None, False, rejected_depth)
+            raise QueueFullError(
+                f"serving queue full ({self.max_queue} pending); "
+                "shed load or retry"
+            )
         telemetry.event(
             "serving.submit", cat="serving",
             month_idx=req.month_idx, queue_depth=depth,
@@ -258,9 +272,11 @@ class MicroBatcher:
         except Exception as exc:  # noqa: BLE001 - delivered per-request
             self._m_failed_batches.inc()
             self._m_failed.inc(len(batch))
+            now = time.perf_counter()
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(exc)
+                self._notify(now - r.t_submit, False, None)
             return
         now = time.perf_counter()
         occupancy = len(batch) / bucket_for(
@@ -271,13 +287,26 @@ class MicroBatcher:
         self._m_done.inc(len(batch))
         with self._cv:
             self._occupancy.append(occupancy)
+            depth = len(self._pending)
+            lats = []
             for r in batch:
                 lat = now - r.t_submit
+                lats.append(lat)
                 self._latencies.append(lat)
                 self._m_latency.observe(lat)
         for r, value in zip(batch, out):
             if not r.future.cancelled():
                 r.future.set_result(float(value))
+        for lat in lats:
+            self._notify(lat, True, depth)
+
+    def _notify(self, latency_s, ok: bool, queue_depth) -> None:
+        if self._observer is None:
+            return
+        try:
+            self._observer(latency_s, ok, queue_depth)
+        except Exception:  # noqa: BLE001 — an SLO hook must never be able
+            pass  # to kill the flusher thread or fail a submit
 
     # -- lifecycle / metrics ----------------------------------------------
 

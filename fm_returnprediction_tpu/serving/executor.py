@@ -185,7 +185,12 @@ class BucketedExecutor:
         """Compile the bucket's executable. Called WITHOUT the lock held —
         a compile costs orders of magnitude more than a dispatch, and
         holding the executor-wide lock through one would stall concurrent
-        dispatches for buckets that are already compiled."""
+        dispatches for buckets that are already compiled.
+
+        The AOT compile goes through the cost ledger
+        (``telemetry.timed_aot_compile``): lowering+compile wall time,
+        ``cost_analysis``/``memory_analysis`` and persistent-cache
+        provenance are accounted per bucket program."""
         import jax
         import jax.numpy as jnp
 
@@ -194,7 +199,10 @@ class BucketedExecutor:
             jnp.zeros((bucket, self._state_args[0].shape[1]), self._dtype),
             jnp.zeros((bucket,), bool),
         )
-        return jax.jit(_er_kernel).lower(*self._state_args, *example).compile()
+        return telemetry.timed_aot_compile(
+            jax.jit(_er_kernel), *self._state_args, *example,
+            program="serving_bucket", bucket=bucket,
+        )
 
     def _ensure(self, bucket: int):
         """The bucket's executable, compiling it first if needed (publish
@@ -282,6 +290,7 @@ class BucketedExecutor:
                 "serving.dispatch_timeout", cat="serving", bucket=bucket,
                 timeout_s=self.dispatch_timeout_s,
             )
+            telemetry.dump_flight(f"serving.dispatch_timeout:bucket={bucket}")
             raise DispatchTimeoutError(
                 f"bucket {bucket} dispatch exceeded "
                 f"{self.dispatch_timeout_s}s (runner stalled; worker abandoned)"

@@ -70,9 +70,21 @@ class ERService:
         auto_flush: bool = True,
         dispatch_timeout_s: Optional[float] = None,
         merge_tolerance: Optional[float] = None,
+        slos=None,
     ):
         self.state = state
         self.timer = StageTimer()
+        # SLO monitor (telemetry.slo): explicit objectives, else the
+        # FMRP_SLO_* env knobs; None when neither is set — the monitor is
+        # pure observation, so arming it changes no serving behavior
+        from fm_returnprediction_tpu.telemetry import slo as _slo
+
+        objectives = tuple(slos) if slos is not None else _slo.slos_from_env()
+        self.slo: Optional[_slo.SloMonitor] = (
+            _slo.SloMonitor(objectives, window_s=_slo.env_window_s())
+            if objectives else None
+        )
+        self._max_queue = max_queue
         self._max_batch = max_batch
         self._min_bucket = min_bucket
         self._dispatch_timeout_s = dispatch_timeout_s
@@ -98,6 +110,7 @@ class ERService:
             auto_flush=auto_flush,
             n_predictors=state.n_predictors,
             min_bucket=min_bucket,
+            observer=self._observe_request if self.slo is not None else None,
         )
         self._quarantined: dict = {}  # month label → rejection reason
         self._n_ingested = 0
@@ -129,6 +142,13 @@ class ERService:
             min_bucket=self._min_bucket,
             dispatch_timeout_s=self._dispatch_timeout_s,
         )
+
+    def _observe_request(self, latency_s, ok, queue_depth) -> None:
+        """Batcher outcome hook → SLO monitor (see ``MicroBatcher``'s
+        ``observer`` contract)."""
+        self.slo.observe(latency_s, ok=ok)
+        if queue_depth is not None and self._max_queue:
+            self.slo.observe_queue(queue_depth / self._max_queue)
 
     def _dispatch(self, month_idx, x, valid) -> np.ndarray:
         # one indirection instead of binding ``executor.run`` into the
@@ -226,6 +246,10 @@ class ERService:
                 "serving.quarantine", cat="serving",
                 month=key, error=repr(exc)[:200],
             )
+            # flight recorder: the last N spans/events + the cost ledger,
+            # frozen at the moment the month went bad (no-op unless a
+            # trace dir is armed)
+            telemetry.dump_flight(f"serving.quarantine:{key}")
             return False
         # publish: attribute assignment is atomic under the GIL, and
         # append-only month slots mean an in-flight request resolved on the
@@ -286,6 +310,29 @@ class ERService:
             dispatch_timeouts=tot["timeouts"],
             guard_violations=len(self.audit.violations),
         )
+        if self.slo is not None:
+            snap = self.slo.snapshot()
+            out["slo_state"] = snap["state"]
+            out["slo_state_code"] = snap["state_code"]
+            out["slo_window_error_rate"] = snap["error_rate"]
+            out["slo_window_p99_ms"] = snap["p99_ms"]
+            out["slo"] = snap["objectives"]
+            # /metrics carries the numeric twin: alerting keys off
+            # fmrp_slo_state{slo=...} >= 1 (warn) / >= 2 (breach)
+            reg = telemetry.registry()
+            for name, obj in snap["objectives"].items():
+                reg.gauge(
+                    "fmrp_slo_state",
+                    help="SLO state by objective: 0 ok, 1 warn, 2 breach",
+                    slo=name,
+                ).set(obj["state_code"])
+                reg.gauge(
+                    "fmrp_slo_burn_rate",
+                    help="windowed bad fraction over the SLO budget",
+                    slo=name,
+                ).set(obj["burn_rate"])
+        else:
+            out["slo_state"] = None
         return out
 
     def report(self) -> str:
@@ -295,6 +342,18 @@ class ERService:
             for name, value in sorted(self.stats().items())
         ]
         return "\n".join([self.timer.report(), *lines])
+
+    def capture_profile(self, profile_dir):
+        """On-demand ``jax.profiler`` device capture around a live-serving
+        window::
+
+            with svc.capture_profile("/tmp/prof"):
+                ...   # the queries in this block are device-profiled
+
+        Every armed host span inside the block also annotates the device
+        trace (``telemetry.profiling``), so Perfetto shows the serving
+        batch/dispatch spans beside the device rows."""
+        return telemetry.profiling(profile_dir)
 
     # -- metrics endpoint hook ---------------------------------------------
 

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                         help="arm telemetry: export task/stage spans to "
                              "events.jsonl + trace.json in this directory "
                              "(default follows FMRP_TRACE_DIR)")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler device trace of the "
+                             "run into this directory (host spans "
+                             "annotate the device timeline)")
     args = parser.parse_args(argv)
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -56,6 +60,7 @@ def main(argv=None) -> int:
 
     with ExitStack() as stack:
         stack.enter_context(telemetry.tracing(args.trace_dir))
+        stack.enter_context(telemetry.profiling(args.profile_dir))
         runner = stack.enter_context(TaskRunner(tasks, db_path=db))
         if args.list:
             for t in tasks:

@@ -407,6 +407,9 @@ class TaskRunner:
                 "task.timeout", cat="taskgraph",
                 task=task.name, timeout_s=task.timeout_s,
             )
+            # flight recorder: freeze the last spans/events + cost ledger
+            # at the moment the watchdog fired (no-op without a trace dir)
+            telemetry.dump_flight(f"task.timeout:{task.name}")
             raise TaskTimeoutError(
                 f"task {task.name!r} action exceeded {task.timeout_s}s "
                 "(worker abandoned)"
@@ -466,6 +469,8 @@ class TaskRunner:
             "task.failure", cat="taskgraph",
             task=task.name, error=error, ran=ran,
         )
+        if ran:  # dependency-skips carry no new evidence worth a dump
+            telemetry.dump_flight(f"task.failure:{task.name}")
 
     def run(
         self,

@@ -17,6 +17,19 @@ The observability layer (L-obs) the rest of the stack instruments into:
   (Perfetto-loadable, epoch-anchored so ``jax.profiler`` device traces
   line up beside the host spans), plus Prometheus text format for the
   ``ERService`` metrics endpoint hook.
+- :mod:`.perf`    — the performance plane on top: a program COST LEDGER
+  (``cost_analysis``/``memory_analysis`` + compile wall time for every
+  AOT program in the serving/specgrid paths, exported as ``program``
+  JSONL records, Chrome counter tracks and ``fmrp_program_*`` metric
+  families), ``jax.profiler`` capture hooks (``run_pipeline
+  (profile_dir=)`` / ``--profile-dir`` / ``ERService.capture_profile``),
+  the ``flight.json`` crash-time flight recorder, and the warm-run
+  recompile sentinel.
+- :mod:`.slo`     — declarative ``SLO`` objectives + a sliding-window
+  burn-rate monitor over the serving metrics (state in ``stats()`` and
+  ``/metrics``).
+- :mod:`.regress` — the perf-regression sentinel over the bench history
+  (``python -m fm_returnprediction_tpu.telemetry.regress BENCH_*.json``).
 
 Discipline (same stance as the guard layer's static flag): telemetry off —
 the default — is near-zero overhead (one global read per instrumented
@@ -45,6 +58,23 @@ from fm_returnprediction_tpu.telemetry.metrics import (
     record_trace,
     registry,
 )
+from fm_returnprediction_tpu.telemetry.perf import (
+    CostLedger,
+    ProgramRecord,
+    cost_ledger,
+    dump_flight,
+    peak_flops_estimate,
+    profiling,
+    recompile_watch,
+    record_compiled,
+    record_runtime,
+    timed_aot_compile,
+)
+from fm_returnprediction_tpu.telemetry.slo import (
+    SLO,
+    SloMonitor,
+    slos_from_env,
+)
 from fm_returnprediction_tpu.telemetry.spans import (
     Span,
     active,
@@ -66,11 +96,24 @@ from fm_returnprediction_tpu.telemetry.spans import (
 )
 
 __all__ = [
+    "CostLedger",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "ProgramRecord",
+    "SLO",
+    "SloMonitor",
     "Span",
+    "cost_ledger",
+    "dump_flight",
+    "peak_flops_estimate",
+    "profiling",
+    "recompile_watch",
+    "record_compiled",
+    "record_runtime",
+    "slos_from_env",
+    "timed_aot_compile",
     "active",
     "attach",
     "capture",

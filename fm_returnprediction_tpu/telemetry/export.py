@@ -36,8 +36,10 @@ from fm_returnprediction_tpu.telemetry import metrics as _metrics
 from fm_returnprediction_tpu.telemetry import spans as _spans
 
 __all__ = [
+    "flat_metrics",
     "span_record",
     "event_record",
+    "program_record",
     "write_jsonl",
     "chrome_trace_events",
     "write_chrome_trace",
@@ -54,6 +56,18 @@ CHROME_TRACE_NAME = "trace.json"
 def _ts_us(t_ns: int) -> float:
     """perf_counter_ns → epoch microseconds (one anchor per process)."""
     return (t_ns + _spans.EPOCH_ANCHOR_NS) / 1e3
+
+
+def flat_metrics() -> dict:
+    """The registry snapshot as one flat ``name{k=v,...} → value`` dict —
+    the shared shape of the JSONL ``metrics`` line and the flight
+    recorder's ``metrics`` field."""
+    out = {}
+    for name, series in _metrics.registry().collect().items():
+        for key, value in sorted(series.items()):
+            label = ",".join(f"{k}={v}" for k, v in key)
+            out[f"{name}{{{label}}}" if label else name] = value
+    return out
 
 
 def _clean(attrs: dict) -> dict:
@@ -104,6 +118,17 @@ def event_record(e: dict) -> dict:
     }
 
 
+def program_record(r) -> dict:
+    """One cost-ledger :class:`ProgramRecord` as a JSONL line (``type:
+    "program"``): the per-compiled-program FLOP/byte/memory accounting
+    beside the spans that paid for it."""
+    out = r.to_json()
+    out["type"] = "program"
+    out["ts_us"] = round(_ts_us(r.t_ns), 3)
+    del out["t_ns"]
+    return out
+
+
 def _ordered_records() -> List[dict]:
     """Every collected span/event as records, deterministically ordered
     (start time, then span id — ties cannot reorder across exports)."""
@@ -137,15 +162,18 @@ def write_jsonl(path, include_metrics: bool = True) -> Path:
         )
     ]
     lines += [json.dumps(r, sort_keys=True) for r in _ordered_records()]
+    from fm_returnprediction_tpu.telemetry import perf as _perf
+
+    lines += [
+        json.dumps(program_record(r), sort_keys=True)
+        for r in _perf.cost_ledger().records()
+    ]
     if include_metrics:
-        collected = _metrics.registry().collect()
-        flat = {}
-        for name, series in collected.items():
-            for key, value in sorted(series.items()):
-                label = ",".join(f"{k}={v}" for k, v in key)
-                flat[f"{name}{{{label}}}" if label else name] = value
         lines.append(
-            json.dumps({"type": "metrics", "values": flat}, sort_keys=True)
+            json.dumps(
+                {"type": "metrics", "values": flat_metrics()},
+                sort_keys=True,
+            )
         )
     tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
     tmp.write_text("\n".join(lines) + "\n")
@@ -230,6 +258,69 @@ def chrome_trace_events(pid: Optional[int] = None) -> List[dict]:
                 "args": _clean(e["attrs"]),
             }
         )
+    out.extend(_program_trace_events(pid))
+    return out
+
+
+#: synthetic tid the compile rows live on — AOT compiles happen on real
+#: threads, but a dedicated row keeps Perfetto's compile story scannable
+_COMPILE_TID = 999_999
+
+
+def _program_trace_events(pid: int) -> List[dict]:
+    """Cost-ledger records as Chrome trace events: one ``X`` slice per
+    compile (lowering+compile interval, on a dedicated "fmrp-compiles"
+    row) plus ``C`` counter tracks for FLOPs and bytes-accessed so the
+    per-program cost accounting rides the same timeline as the spans."""
+    from fm_returnprediction_tpu.telemetry import perf as _perf
+
+    records = _perf.cost_ledger().records()
+    if not records:
+        return []
+    out: List[dict] = [
+        {
+            "ph": "M",
+            "name": "thread_name",
+            "pid": pid,
+            "tid": _COMPILE_TID,
+            "args": {"name": "fmrp-compiles"},
+        }
+    ]
+    for r in records:
+        dur_ns = int((r.lower_s + r.compile_s) * 1e9)
+        out.append(
+            {
+                "ph": "X",
+                "name": f"compile:{r.program}",
+                "cat": "compile",
+                "ts": round(_ts_us(r.t_ns - dur_ns), 3),
+                "dur": round(dur_ns / 1e3, 3),
+                "pid": pid,
+                "tid": _COMPILE_TID,
+                "args": {
+                    k: v
+                    for k, v in r.to_json().items()
+                    if v is not None and k != "t_ns"
+                },
+            }
+        )
+        for counter, value in (
+            ("flops", r.flops),
+            ("bytes_accessed", r.bytes_accessed),
+            ("temp_bytes", r.temp_bytes),
+        ):
+            if value is None:
+                continue
+            out.append(
+                {
+                    "ph": "C",
+                    "name": f"program_{counter}",
+                    "ts": round(_ts_us(r.t_ns), 3),
+                    "pid": pid,
+                    "tid": _COMPILE_TID,
+                    "args": {r.program: value},
+                }
+            )
     return out
 
 

@@ -206,6 +206,24 @@ def sanitize(name: str) -> str:
     return name
 
 
+def escape_label_value(value: object) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped or a value like
+    ``repr(exc)`` containing a quote splits the label set mid-line."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """Escape a HELP string (backslash and newline only — quotes are
+    legal there)."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 class MetricsRegistry:
     """Process-wide instrument store with families aggregated for export."""
 
@@ -329,7 +347,11 @@ class MetricsRegistry:
             total = _zero_state("histogram", bounds)
             _fold_state("histogram", total, series.base)
             for inst in instruments:
-                _fold_state("histogram", total, inst._cell)
+                # fold under the instrument's own lock: a concurrent
+                # observe() mutates bucket/sum/count non-atomically, and a
+                # torn read would render count ≠ Σ buckets
+                with inst._lock:
+                    _fold_state("histogram", total, inst._cell)
             return {
                 "buckets": list(total[0]),
                 "sum": total[1],
@@ -372,12 +394,12 @@ class MetricsRegistry:
             kind, help_, bounds = metas[name]
             pname = sanitize(name)
             if help_:
-                lines.append(f"# HELP {pname} {help_}")
+                lines.append(f"# HELP {pname} {escape_help(help_)}")
             lines.append(f"# TYPE {pname} {kind}")
             for key in sorted(collected[name]):
                 value = collected[name][key]
                 label_str = ",".join(
-                    f'{sanitize(k)}="{v}"' for k, v in key
+                    f'{sanitize(k)}="{escape_label_value(v)}"' for k, v in key
                 )
                 if kind == "histogram":
                     bnds = list(bounds or DEFAULT_LATENCY_BUCKETS)

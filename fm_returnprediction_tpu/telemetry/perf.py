@@ -1,0 +1,608 @@
+"""Performance accounting: program cost ledger, profiler hooks, flight
+recorder, recompile sentinel.
+
+The PR-5 telemetry substrate answers *where did the wall-clock go* (spans)
+and *how often did things happen* (metrics). This module answers the
+questions the ROADMAP turns on next: *what does each compiled program
+cost* (FLOPs / bytes / device memory / compile seconds — the accounting a
+tuned estimation stack is driven by, per the "high-performance routines"
+reference in PAPERS.md), *what does the device itself see*
+(``jax.profiler`` capture around the host spans), and *what was happening
+right before a failure* (the flight recorder).
+
+Four pieces:
+
+- :class:`CostLedger` / :func:`record_compiled` — one record per
+  ahead-of-time compiled program: ``Compiled.cost_analysis()`` FLOPs and
+  bytes-accessed, ``memory_analysis()`` temp/argument/output bytes,
+  lowering + compile wall time, shape-bucket/signature key, and
+  persistent-cache provenance (did this compile land a new entry in the
+  XLA compilation cache, or was it served from it). The serving
+  :class:`BucketedExecutor` and the specgrid fused program record here;
+  records export as ``type: "program"`` JSONL events, Chrome-trace
+  counter tracks, and ``fmrp_program_*`` Prometheus families. Always on,
+  like the metrics registry: the cost is paid at *compile* time (host
+  side, once per program), never on the dispatch hot path, and nothing
+  here enters a traced function — jaxprs stay byte-identical telemetry
+  on or off.
+- :func:`profiling` — arms a ``jax.profiler`` device trace around a
+  region AND makes every armed host span also emit a
+  ``jax.profiler.TraceAnnotation``, so Perfetto shows the device rows
+  beside (and labelled by) the PR-5 host spans.
+  ``run_pipeline(profile_dir=...)`` / ``--profile-dir`` and
+  ``ERService.capture_profile`` wrap this.
+- :func:`dump_flight` — the flight recorder: the last N collected
+  spans/events plus the ledger tail and a metrics snapshot, written to
+  ``flight.json`` in the trace dir. The resilience layer calls it on
+  task failure/timeout and serving quarantine, so the ledger and the
+  trace agree at crash time.
+- :func:`recompile_watch` — diffs the persistent XLA compile cache
+  around a region; growth during a region declared *warm* counts into
+  ``fmrp_unexpected_recompiles_total`` and warns with the programs the
+  ledger saw compile in the window (ROADMAP item 5's "the cache grew
+  83→84 on the warm run" becomes an attributed warning instead of a
+  silent diff).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from fm_returnprediction_tpu.telemetry import metrics as _metrics
+from fm_returnprediction_tpu.telemetry import spans as _spans
+
+__all__ = [
+    "ProgramRecord",
+    "CostLedger",
+    "cost_ledger",
+    "record_compiled",
+    "timed_aot_compile",
+    "record_runtime",
+    "peak_flops_estimate",
+    "profiling",
+    "profiler_active",
+    "dump_flight",
+    "FLIGHT_NAME",
+    "recompile_watch",
+    "CacheDelta",
+]
+
+FLIGHT_NAME = "flight.json"
+
+_LEDGER_MAX = int(os.environ.get("FMRP_LEDGER_MAX", "4096"))
+_FLIGHT_SPANS = int(os.environ.get("FMRP_FLIGHT_SPANS", "256"))
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramRecord:
+    """One AOT-compiled program's cost accounting."""
+
+    program: str  # logical name ("serving_bucket", "specgrid_program", ...)
+    signature: str  # shape/dtype/static key the compile was for
+    fingerprint: str  # short stable hash of (program, signature)
+    backend: str
+    lower_s: float
+    compile_s: float
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+    temp_bytes: Optional[int]
+    argument_bytes: Optional[int]
+    output_bytes: Optional[int]
+    generated_code_bytes: Optional[int]
+    provenance: str  # "fresh" | "persistent-cache" | "uncached"
+    cache_entries_delta: int
+    bucket: Optional[int] = None
+    t_ns: int = 0  # perf_counter_ns at record time (epoch-anchorable)
+    seq: int = 0
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["lower_s"] = round(d["lower_s"], 6)
+        d["compile_s"] = round(d["compile_s"], 6)
+        return d
+
+
+class CostLedger:
+    """Process-wide, bounded, append-only store of :class:`ProgramRecord`."""
+
+    def __init__(self, maxlen: int = _LEDGER_MAX) -> None:
+        self._lock = threading.Lock()
+        self._records: List[ProgramRecord] = []
+        self._maxlen = maxlen
+        self._dropped = 0
+        self._seq = itertools.count(1)
+
+    def add(self, record: ProgramRecord) -> ProgramRecord:
+        record = dataclasses.replace(record, seq=next(self._seq))
+        with self._lock:
+            if len(self._records) >= self._maxlen:
+                # evict OLDEST: the flight recorder and the recompile
+                # sentinel both read the recent tail — dropping the newest
+                # would blind them at exactly the failure they exist for
+                self._records.pop(0)
+                self._dropped += 1
+            self._records.append(record)
+        return record
+
+    def records(self) -> List[ProgramRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def tail(self, n: int) -> List[ProgramRecord]:
+        with self._lock:
+            return list(self._records[-n:])
+
+    def since(self, seq: int) -> List[ProgramRecord]:
+        """Records added after sequence number ``seq`` (the recompile
+        sentinel's attribution window)."""
+        with self._lock:
+            return [r for r in self._records if r.seq > seq]
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._records[-1].seq if self._records else 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"records": len(self._records), "dropped": self._dropped}
+
+    def total(self, field: str, program: Optional[str] = None) -> float:
+        """Sum of a numeric field over (optionally one program's) records."""
+        out = 0.0
+        for r in self.records():
+            if program is not None and r.program != program:
+                continue
+            v = getattr(r, field)
+            if v is not None:
+                out += v
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+            self._seq = itertools.count(1)
+
+
+_LEDGER: Optional[CostLedger] = None
+_LEDGER_LOCK = threading.Lock()
+# serializes timed_aot_compile's measure-and-compile window (see there)
+_AOT_MEASURE_LOCK = threading.Lock()
+
+
+def cost_ledger() -> CostLedger:
+    """The process-wide cost ledger (created on first use)."""
+    global _LEDGER
+    if _LEDGER is None:
+        with _LEDGER_LOCK:
+            if _LEDGER is None:
+                _LEDGER = CostLedger()
+    return _LEDGER
+
+
+def _fingerprint(program: str, signature: str) -> str:
+    return hashlib.sha256(f"{program}|{signature}".encode()).hexdigest()[:12]
+
+
+def _cost_dict(compiled) -> dict:
+    """``Compiled.cost_analysis()`` normalized to one flat dict; {} when
+    the backend does not support it (never raises)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — optional backend feature
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if isinstance(ca, dict) else {}
+
+
+def _memory_fields(compiled) -> dict:
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception:  # noqa: BLE001 — optional backend feature
+        return {
+            "temp_bytes": None,
+            "argument_bytes": None,
+            "output_bytes": None,
+            "generated_code_bytes": None,
+        }
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — ledger must never break a compile
+        return "unknown"
+
+
+def record_compiled(
+    program: str,
+    compiled,
+    signature: str,
+    lower_s: float,
+    compile_s: float,
+    cache_entries_delta: int = 0,
+    cache_enabled: bool = True,
+    bucket: Optional[int] = None,
+) -> ProgramRecord:
+    """Account one freshly AOT-compiled program into the ledger, the
+    metrics registry, and (when tracing is armed) the current span.
+
+    ``cache_entries_delta`` is the persistent XLA compile-cache growth
+    measured around the ``compile()`` call: >0 means this compile paid
+    full price and landed a new cache entry ("fresh"); 0 with the cache
+    enabled means XLA served it from the persistent cache
+    ("persistent-cache"); with no cache configured provenance is
+    "uncached"."""
+    cost = _cost_dict(compiled)
+    flops = cost.get("flops")
+    bytes_accessed = cost.get("bytes accessed")
+    if not cache_enabled:
+        provenance = "uncached"
+    else:
+        provenance = "fresh" if cache_entries_delta > 0 else "persistent-cache"
+    record = cost_ledger().add(
+        ProgramRecord(
+            program=program,
+            signature=signature,
+            fingerprint=_fingerprint(program, signature),
+            backend=_backend_name(),
+            lower_s=float(lower_s),
+            compile_s=float(compile_s),
+            flops=float(flops) if flops is not None else None,
+            bytes_accessed=(
+                float(bytes_accessed) if bytes_accessed is not None else None
+            ),
+            provenance=provenance,
+            cache_entries_delta=int(cache_entries_delta),
+            bucket=bucket,
+            t_ns=time.perf_counter_ns(),
+            **_memory_fields(compiled),
+        )
+    )
+    reg = _metrics.registry()
+    reg.counter(
+        "fmrp_program_compiles_total",
+        help="AOT programs compiled, by logical program and provenance",
+        program=program, provenance=provenance,
+    ).inc()
+    reg.counter(
+        "fmrp_program_compile_seconds_total",
+        help="wall seconds spent lowering+compiling, by program",
+        program=program,
+    ).inc(record.lower_s + record.compile_s)
+    if record.flops is not None:
+        reg.gauge(
+            "fmrp_program_flops",
+            help="XLA cost_analysis FLOPs of the last compile, by program",
+            program=program,
+        ).set(record.flops)
+    if record.bytes_accessed is not None:
+        reg.gauge(
+            "fmrp_program_bytes_accessed",
+            help="XLA cost_analysis bytes accessed of the last compile",
+            program=program,
+        ).set(record.bytes_accessed)
+    if record.temp_bytes is not None:
+        reg.gauge(
+            "fmrp_program_temp_bytes",
+            help="XLA memory_analysis temp allocation of the last compile",
+            program=program,
+        ).set(record.temp_bytes)
+    _spans.event(
+        "program_compiled", cat="compile",
+        program=program, fingerprint=record.fingerprint,
+        compile_s=round(record.compile_s, 4), provenance=provenance,
+        **({"bucket": bucket} if bucket is not None else {}),
+    )
+    return record
+
+
+def timed_aot_compile(jitted, *args, program: str,
+                      signature: Optional[str] = None,
+                      bucket: Optional[int] = None, **static_kwargs):
+    """Lower + compile ``jitted`` ahead of time, timing both phases and
+    accounting the result via :func:`record_compiled`. Returns the
+    ``Compiled`` executable (call it with the array args only).
+
+    The one AOT entry the serving executor and the specgrid program
+    share, so every compiled program in those paths lands in the ledger
+    with the same fields."""
+    if signature is None:
+        signature = arg_signature(args, static_kwargs)
+    cache_enabled = _persistent_cache_enabled()
+    # one compile-measurement window at a time: provenance comes from a
+    # GLOBAL cache-dir entry diff, so two concurrent windows would
+    # attribute each other's cache entries (thread A labelled "fresh" by
+    # thread B's new entry). Serializing here costs parallelism only in
+    # the rare concurrent-cold-compile case — warmups loop sequentially —
+    # and buys a provenance split that is actually trustworthy.
+    with _AOT_MEASURE_LOCK:
+        entries_before = (
+            _metrics.jax_cache_stats()["entries"] if cache_enabled else 0
+        )
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args, **static_kwargs)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        delta = (
+            _metrics.jax_cache_stats()["entries"] - entries_before
+            if cache_enabled else 0
+        )
+    record_compiled(
+        program, compiled, signature,
+        lower_s=t1 - t0, compile_s=t2 - t1,
+        cache_entries_delta=delta,
+        cache_enabled=cache_enabled,
+        bucket=bucket,
+    )
+    return compiled
+
+
+def _persistent_cache_enabled() -> bool:
+    """Whether THIS process armed the persistent XLA compilation cache —
+    provenance must not claim a cache hit just because a previous run's
+    cache directory exists on disk."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:  # noqa: BLE001 — unknown jax: claim nothing
+        return False
+
+
+def arg_signature(args, static_kwargs=None) -> str:
+    """Deterministic shape/dtype/static key for an AOT cache + the ledger."""
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None:
+            parts.append(repr(a))
+        else:
+            parts.append(f"{tuple(shape)}:{dtype}")
+    if static_kwargs:
+        parts.append(
+            "|".join(f"{k}={static_kwargs[k]!r}" for k in sorted(static_kwargs))
+        )
+    return ";".join(parts)
+
+
+# -- roofline / achieved-FLOPs ---------------------------------------------
+
+#: very rough per-core CPU peak (FMA × vector width × ~3 GHz); the point of
+#: the roofline gauge is order-of-magnitude honesty, not vendor marketing
+_CPU_PEAK_PER_CORE = 48e9
+_TPU_PEAK_DEFAULT = 275e12  # one v4 chip, bf16 — override via FMRP_PEAK_FLOPS
+
+
+def peak_flops_estimate() -> float:
+    """Best-effort peak-FLOPs estimate for the roofline-utilization gauge.
+
+    ``FMRP_PEAK_FLOPS`` overrides (set it when the exact part is known);
+    otherwise a disclosed rough default per platform. Never raises."""
+    env = os.environ.get("FMRP_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if _backend_name() == "tpu":
+        return _TPU_PEAK_DEFAULT
+    return (os.cpu_count() or 1) * _CPU_PEAK_PER_CORE
+
+
+def record_runtime(program: str, seconds: float,
+                   flops: Optional[float] = None) -> dict:
+    """Derive achieved FLOP/s (FLOPs ÷ measured runtime) and roofline
+    utilization for a bench section's program; sets the
+    ``fmrp_program_achieved_flops`` / ``fmrp_program_roofline_utilization``
+    gauges and returns the numbers (empty dict when no FLOP count is
+    known or the runtime is degenerate).
+
+    ``flops`` defaults to the ledger total for ``program`` — correct when
+    the process compiled exactly the program the runtime measures. A
+    caller timing ONE execution in a process that compiled several
+    signatures of the same program (the bench, after the pipeline
+    sections) must pass the executed compile's own FLOPs explicitly or
+    the gauge overstates."""
+    if flops is None:
+        flops = cost_ledger().total("flops", program=program)
+    if not flops or seconds <= 0:
+        return {}
+    achieved = flops / seconds
+    peak = peak_flops_estimate()
+    util = achieved / peak if peak > 0 else 0.0
+    reg = _metrics.registry()
+    reg.gauge(
+        "fmrp_program_achieved_flops",
+        help="ledger FLOPs / measured wall seconds, by program",
+        program=program,
+    ).set(achieved)
+    reg.gauge(
+        "fmrp_program_roofline_utilization",
+        help="achieved FLOP/s over the (rough) platform peak",
+        program=program,
+    ).set(util)
+    return {
+        "achieved_flops": achieved,
+        "peak_flops_estimate": peak,
+        "roofline_utilization": util,
+    }
+
+
+# -- profiler capture -------------------------------------------------------
+
+
+def profiler_active() -> bool:
+    return _spans.annotation_factory() is not None
+
+
+@contextlib.contextmanager
+def profiling(profile_dir=None):
+    """Wrap a region in a ``jax.profiler`` device trace written to
+    ``profile_dir`` (pass-through when None), and make every armed host
+    span in the region also emit a ``jax.profiler.TraceAnnotation`` so
+    the device timeline carries the span names.
+
+    Nesting is refused rather than silently corrupting the outer capture
+    (``jax.profiler`` keeps one global trace per process)."""
+    if profile_dir is None:
+        yield None
+        return
+    if profiler_active():
+        raise RuntimeError(
+            "a jax.profiler capture is already active in this process; "
+            "stop it before starting another"
+        )
+    import jax
+
+    profile_dir = str(profile_dir)
+    Path(profile_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(profile_dir)
+    _spans.set_annotation_factory(jax.profiler.TraceAnnotation)
+    try:
+        # span collection must be ARMED for annotations to fire (span()
+        # returns the shared no-op when telemetry is off): --profile-dir
+        # alone promises named host rows on the device timeline, so the
+        # capture region forces spans on even without a trace dir
+        with _spans.enabled(True):
+            yield profile_dir
+    finally:
+        _spans.set_annotation_factory(None)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a dead backend must not mask
+            pass  # the region's own exception with a profiler teardown one
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def flight_snapshot(reason: str, max_spans: int = _FLIGHT_SPANS) -> dict:
+    """The flight-recorder payload: the last ``max_spans`` collected
+    spans/events (ring-buffer tail), the ledger tail, and a metrics
+    snapshot — everything needed to reconstruct the moments before a
+    failure without waiting for the end-of-run export."""
+    from fm_returnprediction_tpu.telemetry import export as _export
+
+    cur = _spans.current_span()
+    spans = _spans.finished_spans()[-max_spans:]
+    events = _spans.standalone_events()[-max_spans:]
+    return {
+        "type": "flight",
+        "schema": 1,
+        "reason": reason,
+        "pid": os.getpid(),
+        "anchor_span_id": cur.span_id if cur is not None else None,
+        "collector": _spans.collector_stats(),
+        "spans": [_export.span_record(s) for s in spans],
+        "events": [_export.event_record(e) for e in events],
+        "programs": [r.to_json() for r in cost_ledger().tail(max_spans)],
+        "metrics": _export.flat_metrics(),
+    }
+
+
+def dump_flight(reason: str, directory=None) -> Optional[Path]:
+    """Write ``flight.json`` (see :func:`flight_snapshot`) into
+    ``directory`` (default: the configured trace dir). No-op returning
+    None when no directory is armed; never raises — the flight recorder
+    runs on failure paths whose original exception must stay primary."""
+    directory = directory or _spans.trace_dir()
+    if directory is None:
+        return None
+    try:
+        path = Path(directory) / FLIGHT_NAME
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
+        tmp.write_text(
+            json.dumps(flight_snapshot(reason), sort_keys=True, default=repr)
+        )
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — see docstring
+        return None
+
+
+# -- recompile sentinel -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class CacheDelta:
+    """Filled in when a :func:`recompile_watch` region exits."""
+
+    label: str
+    warm: bool
+    entries_before: int = 0
+    entries_after: int = 0
+    culprits: Tuple[str, ...] = ()
+
+    @property
+    def grew(self) -> int:
+        return max(0, self.entries_after - self.entries_before)
+
+
+@contextlib.contextmanager
+def recompile_watch(label: str, warm: bool = False):
+    """Diff the persistent XLA compile cache around a region.
+
+    Yields a :class:`CacheDelta`. Growth inside a region declared
+    ``warm`` means something recompiled that a warm run should have
+    reused: it counts into ``fmrp_unexpected_recompiles_total{section=}``
+    and WARNS (never fails — ROADMAP item 5 wants the tax surfaced, not
+    runs killed), naming the programs the cost ledger saw compile inside
+    the window when it knows them."""
+    delta = CacheDelta(label=label, warm=warm)
+    delta.entries_before = _metrics.jax_cache_stats()["entries"]
+    ledger_mark = cost_ledger().last_seq
+    try:
+        yield delta
+    finally:
+        delta.entries_after = _metrics.jax_cache_stats()["entries"]
+        new_records = cost_ledger().since(ledger_mark)
+        delta.culprits = tuple(
+            f"{r.program}@{r.fingerprint}" for r in new_records
+            if r.provenance == "fresh"
+        )
+        if delta.grew and warm:
+            _metrics.registry().counter(
+                "fmrp_unexpected_recompiles_total",
+                help="persistent-cache growth observed during warm regions",
+                section=label,
+            ).inc(delta.grew)
+            _spans.event(
+                "unexpected_recompile", cat="compile", section=label,
+                grew=delta.grew, culprits=",".join(delta.culprits) or "unknown",
+            )
+            warnings.warn(
+                f"warm region {label!r} grew the persistent XLA compile "
+                f"cache by {delta.grew} entr{'y' if delta.grew == 1 else 'ies'}"
+                " (something recompiled that should have been reused); "
+                + (
+                    f"ledger-attributed compiles: {', '.join(delta.culprits)}"
+                    if delta.culprits
+                    else "the cost ledger saw no fresh AOT compile in this "
+                         "window, so the culprit is a plain jit trace"
+                ),
+                stacklevel=3,
+            )

@@ -72,6 +72,8 @@ __all__ = [
     "reset",
     "trace_dir",
     "set_trace_dir",
+    "annotation_factory",
+    "set_annotation_factory",
 ]
 
 _TRUE = ("1", "on", "true", "yes")
@@ -101,6 +103,21 @@ _DROPPED = 0
 _CURRENT: ContextVar[Optional["Span"]] = ContextVar(
     "fmrp_current_span", default=None
 )
+
+# When a jax.profiler capture is live (telemetry.perf.profiling), this is
+# jax.profiler.TraceAnnotation: every armed span also annotates the device
+# trace so Perfetto shows named device rows beside the host spans. None —
+# the default — keeps jax entirely out of the span hot path.
+_ANNOTATION_FACTORY = None
+
+
+def annotation_factory():
+    return _ANNOTATION_FACTORY
+
+
+def set_annotation_factory(factory) -> None:
+    global _ANNOTATION_FACTORY
+    _ANNOTATION_FACTORY = factory
 
 
 def active() -> bool:
@@ -197,7 +214,7 @@ def _collect_span(s: Span) -> None:
 class _SpanCtx:
     """Context manager for one live span (allocated only when armed)."""
 
-    __slots__ = ("_name", "_cat", "_attrs", "_span", "_token")
+    __slots__ = ("_name", "_cat", "_attrs", "_span", "_token", "_ann")
 
     def __init__(self, name: str, cat: str, attrs: Dict[str, object]):
         self._name = name
@@ -207,10 +224,25 @@ class _SpanCtx:
     def __enter__(self) -> Span:
         self._span = Span(self._name, self._cat, self._attrs)
         self._token = _CURRENT.set(self._span)
+        factory = _ANNOTATION_FACTORY
+        self._ann = None
+        if factory is not None:
+            # mirror the span into the live jax.profiler capture so the
+            # device timeline carries the same names as the host trace
+            try:
+                self._ann = factory(self._name)
+                self._ann.__enter__()
+            except Exception:  # noqa: BLE001 — profiling must never break
+                self._ann = None  # the instrumented code path
         return self._span
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         s = self._span
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001 — see __enter__
+                pass
         s.t1_ns = time.perf_counter_ns()
         if exc is not None:
             s.attrs = {**s.attrs, "error": repr(exc)[:200]}

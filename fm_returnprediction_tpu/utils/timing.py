@@ -62,6 +62,11 @@ class StageTimer:
 
     def __init__(self) -> None:
         self.durations: Dict[str, float] = {}
+        # stages that deliberately did NOT run this time (a checkpoint
+        # short-circuit, a disabled feature), with the reason — so a
+        # consumer can tell "skipped" from "ran in 0.0 s" (the r05 bench
+        # artifact read a short-circuited load_raw_data as free)
+        self.skipped: Dict[str, str] = {}
         self._local = threading.local()
         # names whose recording violated the nesting convention — total()
         # refuses to produce a silently-wrong sum over these
@@ -85,6 +90,7 @@ class StageTimer:
                 yield
         finally:
             stack.pop()
+            self.skipped.pop(name, None)  # it ran after all
             self.durations[name] = self.durations.get(name, 0.0) + (
                 time.perf_counter() - start
             )
@@ -104,6 +110,16 @@ class StageTimer:
             return
         with self.stage(name):
             yield
+
+    def mark_skipped(self, name: str, reason: str) -> None:
+        """Record that stage ``name`` was deliberately skipped (and why).
+
+        The stage gets NO duration entry — a 0.0 would read as "ran for
+        free" in the per-stage breakdowns — and the skip is a point event
+        on the current span when telemetry is armed. A stage that later
+        actually runs clears its skip marker."""
+        self.skipped[name] = reason
+        _spans.event("stage.skipped", cat="stage", stage=name, reason=reason)
 
     def total(self) -> float:
         """Sum of TOP-LEVEL stages only. Names containing "/" are nested
@@ -143,6 +159,10 @@ class StageTimer:
 
     def report(self) -> str:
         lines = [f"{name:<40s} {secs:9.3f}s" for name, secs in self.durations.items()]
+        lines += [
+            f"{name:<40s}   skipped ({reason})"
+            for name, reason in self.skipped.items()
+        ]
         lines.append(f"{'TOTAL':<40s} {self.total():9.3f}s")
         return "\n".join(lines)
 

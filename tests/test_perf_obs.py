@@ -1,0 +1,581 @@
+"""Performance-observability plane: cost ledger, profiler hooks, flight
+recorder, recompile sentinel, SLO monitor, Prometheus edge cases.
+
+Acceptance contract (ISSUE 6):
+
+- the COST LEDGER records cost_analysis/memory_analysis + compile wall
+  time for every AOT program in the serving and specgrid paths, and the
+  records ride the existing exporters (JSONL ``program`` lines, Chrome
+  counter tracks, ``fmrp_program_*`` families);
+- the FLIGHT RECORDER freezes the last spans/events + ledger tail to
+  ``flight.json`` on serving quarantine (and is a safe no-op unarmed);
+- the RECOMPILE SENTINEL turns warm-run persistent-cache growth into a
+  counted, attributed warning;
+- SLO state transitions ok→warn→breach→recover are a pure function of a
+  deterministic synthetic latency stream, and are visible through
+  ``stats()`` and ``prometheus_metrics()``;
+- the Prometheus text format survives hostile label values, concurrent
+  histogram updates, and serves the right content type.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu import telemetry
+from fm_returnprediction_tpu.telemetry import metrics as tmetrics
+from fm_returnprediction_tpu.telemetry import perf as tperf
+from fm_returnprediction_tpu.telemetry import slo as tslo
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+    yield
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+
+
+def _serving_state(t=24, n=40, p=4, seed=5):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    return build_serving_state(y, x, mask, window=12, min_periods=6), x
+
+
+# -- cost ledger ------------------------------------------------------------
+
+
+def test_timed_aot_compile_records_cost_and_memory():
+    import jax
+    import jax.numpy as jnp
+
+    before = len(tperf.cost_ledger().records())
+    f = jax.jit(lambda a: jnp.sum(a @ a.T))
+    compiled = tperf.timed_aot_compile(
+        f, jnp.zeros((16, 16), jnp.float32), program="obs_test_probe"
+    )
+    assert float(compiled(jnp.zeros((16, 16), jnp.float32))) == 0.0
+    records = [
+        r for r in tperf.cost_ledger().records()[before:]
+        if r.program == "obs_test_probe"
+    ]
+    assert len(records) == 1
+    (r,) = records
+    assert r.compile_s > 0 and r.lower_s > 0
+    assert r.signature and r.fingerprint
+    assert r.provenance in ("fresh", "persistent-cache", "uncached")
+    # CPU XLA supports both analyses; if a backend ever stops, the field
+    # goes None rather than the compile failing — assert the happy path
+    assert r.flops is not None and r.flops > 0
+    assert r.temp_bytes is not None
+    # registry families materialized
+    collected = telemetry.registry().collect()
+    assert any(
+        ("program", "obs_test_probe") in dict(k)
+        or dict(k).get("program") == "obs_test_probe"
+        for k in collected["fmrp_program_compiles_total"]
+    )
+
+
+def test_serving_executor_buckets_land_in_ledger():
+    from fm_returnprediction_tpu.serving.executor import BucketedExecutor
+
+    state, _ = _serving_state()
+    mark = tperf.cost_ledger().last_seq
+    exe = BucketedExecutor(state, max_batch=8)
+    exe.warmup()
+    new = [
+        r for r in tperf.cost_ledger().since(mark)
+        if r.program == "serving_bucket"
+    ]
+    assert {r.bucket for r in new} == set(exe.buckets())
+    for r in new:
+        assert r.compile_s > 0
+        assert r.flops is not None
+
+
+def test_specgrid_program_lands_in_ledger_once_per_signature():
+    from fm_returnprediction_tpu import specgrid
+
+    rng = np.random.default_rng(0)
+    t, n, p = 24, 30, 3
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    y = (x @ (0.05 * rng.standard_normal(p)).astype(np.float32)
+         + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    masks = {"All": rng.random((t, n)) > 0.2}
+    names = [f"x{i}" for i in range(p)]
+    grid = specgrid.SpecGrid(
+        (specgrid.Spec("m | All", tuple(names), "All"),)
+    )
+
+    def grid_records():
+        return [
+            r for r in tperf.cost_ledger().records()
+            if r.program == "specgrid_program"
+        ]
+
+    before = len(grid_records())
+    specgrid.run_spec_grid(y, x, masks, grid)
+    after_first = len(grid_records())
+    specgrid.run_spec_grid(y, x, masks, grid)
+    after_second = len(grid_records())
+    # exactly one ledger record for a new signature, zero for the repeat
+    # (the AOT cache, like jit's, compiles once per signature)
+    assert after_first - before == 1
+    assert after_second == after_first
+    rec = grid_records()[-1]
+    assert rec.compile_s > 0 and rec.flops is not None
+
+
+def test_program_records_ride_the_exporters(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    tperf.timed_aot_compile(
+        jax.jit(lambda a: a * 2.0), jnp.zeros((4,), jnp.float32),
+        program="obs_export_probe",
+    )
+    from fm_returnprediction_tpu.telemetry import export
+
+    jsonl = export.write_jsonl(tmp_path / "events.jsonl")
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    programs = [r for r in records if r["type"] == "program"]
+    assert any(p["program"] == "obs_export_probe" for p in programs)
+    probe = next(p for p in programs if p["program"] == "obs_export_probe")
+    for key in ("flops", "bytes_accessed", "compile_s", "lower_s",
+                "provenance", "fingerprint", "signature", "ts_us"):
+        assert key in probe
+    # deterministic re-export stays byte-identical with ledger records
+    again = export.write_jsonl(tmp_path / "events2.jsonl")
+    assert jsonl.read_bytes() == again.read_bytes()
+
+    chrome = json.loads(
+        export.write_chrome_trace(tmp_path / "trace.json").read_text()
+    )
+    events = chrome["traceEvents"]
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "program_flops" for e in counters)
+    compiles = [
+        e for e in events
+        if e["ph"] == "X" and e["name"].startswith("compile:")
+    ]
+    assert any(e["name"] == "compile:obs_export_probe" for e in compiles)
+    # the dedicated compile row is named
+    assert any(
+        e["ph"] == "M" and e["name"] == "thread_name"
+        and e["args"]["name"] == "fmrp-compiles"
+        for e in events
+    )
+
+
+def test_record_runtime_sets_roofline_gauges():
+    import jax
+    import jax.numpy as jnp
+
+    tperf.timed_aot_compile(
+        jax.jit(lambda a: jnp.sum(a @ a.T)), jnp.zeros((32, 32), jnp.float32),
+        program="obs_roofline_probe",
+    )
+    out = telemetry.record_runtime("obs_roofline_probe", 0.01)
+    assert out["achieved_flops"] > 0
+    assert 0 <= out["roofline_utilization"]
+    text = telemetry.registry().to_prometheus()
+    assert 'fmrp_program_achieved_flops{program="obs_roofline_probe"}' in text
+    # no ledger FLOPs → empty dict, no crash
+    assert telemetry.record_runtime("does_not_exist", 1.0) == {}
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_dump_flight_unarmed_is_noop_and_armed_writes(tmp_path):
+    assert telemetry.dump_flight("test.reason") is None  # no trace dir
+    with telemetry.enabled(True):
+        with telemetry.span("flight_parent"):
+            telemetry.event("flight_tick")
+    telemetry.set_trace_dir(tmp_path)
+    path = telemetry.dump_flight("test.reason")
+    assert path is not None and path.name == "flight.json"
+    doc = json.loads(path.read_text())
+    assert doc["type"] == "flight" and doc["reason"] == "test.reason"
+    assert any(s["name"] == "flight_parent" for s in doc["spans"])
+    assert "programs" in doc and "metrics" in doc and "collector" in doc
+
+
+def test_quarantine_dumps_flight(tmp_path):
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _serving_state()
+    telemetry.set_trace_dir(tmp_path)
+    with telemetry.enabled(True):
+        with ERService(state, max_batch=8, warm=True,
+                       auto_flush=False) as svc:
+            bad = np.full((40, x.shape[-1]), np.nan, dtype=np.float32)
+            ok = svc.ingest_month(
+                np.full(40, np.nan), bad, np.ones(40, bool),
+                np.datetime64("2071-01-31", "ns"),
+            )
+            assert not ok and svc.degraded
+    flight = tmp_path / "flight.json"
+    assert flight.exists()
+    doc = json.loads(flight.read_text())
+    assert doc["reason"].startswith("serving.quarantine:")
+
+
+# -- recompile sentinel -----------------------------------------------------
+
+
+class _FakeCompiled:
+    def cost_analysis(self):
+        return [{"flops": 123.0, "bytes accessed": 456.0}]
+
+    def memory_analysis(self):
+        raise NotImplementedError  # memory fields go None, no crash
+
+
+def test_recompile_watch_counts_and_attributes(monkeypatch):
+    entries = iter([10, 12])  # watch-open, watch-close
+
+    monkeypatch.setattr(
+        tmetrics, "jax_cache_stats",
+        lambda cache_dir=None: {"entries": next(entries), "bytes": 0},
+    )
+    counter = telemetry.registry().counter(
+        "fmrp_unexpected_recompiles_total", section="warm_probe"
+    )
+    base = counter.value
+    with pytest.warns(UserWarning, match="warm region 'warm_probe' grew"):
+        with telemetry.recompile_watch("warm_probe", warm=True) as delta:
+            tperf.record_compiled(
+                "warm_probe_prog", _FakeCompiled(), "sig", 0.1, 0.2,
+                cache_entries_delta=2, cache_enabled=True,
+            )
+    assert delta.grew == 2
+    assert any("warm_probe_prog@" in c for c in delta.culprits)
+    assert counter.value == base + 2
+    rec = [
+        r for r in tperf.cost_ledger().records()
+        if r.program == "warm_probe_prog"
+    ][-1]
+    assert rec.provenance == "fresh"
+    assert rec.flops == 123.0 and rec.temp_bytes is None
+
+
+def test_recompile_watch_cold_region_never_warns(monkeypatch):
+    entries = iter([10, 12])
+    monkeypatch.setattr(
+        tmetrics, "jax_cache_stats",
+        lambda cache_dir=None: {"entries": next(entries), "bytes": 0},
+    )
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # any warning fails the test
+        with telemetry.recompile_watch("cold_probe", warm=False) as delta:
+            pass
+    assert delta.grew == 2  # recorded, not warned
+
+
+# -- profiler hooks ---------------------------------------------------------
+
+
+def test_profiling_arms_span_annotations(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    assert not tperf.profiler_active()
+    assert not telemetry.active()
+    with telemetry.profiling(tmp_path / "prof"):
+        assert tperf.profiler_active()
+        # --profile-dir alone must annotate: the capture region arms span
+        # collection even when telemetry is otherwise off
+        assert telemetry.active()
+        with telemetry.span("profiled_region"):
+            float(jax.jit(lambda a: jnp.sum(a))(jnp.ones(8)))
+        # nesting refused, outer capture intact
+        with pytest.raises(RuntimeError, match="already active"):
+            with telemetry.profiling(tmp_path / "prof2"):
+                pass
+    assert not tperf.profiler_active()
+    # the capture produced an artifact directory
+    assert (tmp_path / "prof").exists()
+    assert any((tmp_path / "prof").rglob("*"))
+    # passthrough mode: no arming, no error
+    with telemetry.profiling(None):
+        assert not tperf.profiler_active()
+    # the span recorded normally despite the annotation mirror
+    assert any(
+        s.name == "profiled_region" for s in telemetry.finished_spans()
+    )
+
+
+# -- SLO monitor ------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_state_transitions_ok_warn_breach_recover():
+    clock = _Clock()
+    slo = tslo.SLO("p99", "latency", threshold_ms=10.0, budget=0.10,
+                   warn_burn=1.0, breach_burn=2.0)
+    mon = tslo.SloMonitor((slo,), window_s=10.0, clock=clock)
+
+    # 100 fast requests → ok
+    for _ in range(100):
+        mon.observe(0.001)
+    assert mon.snapshot()["objectives"]["p99"]["state"] == "ok"
+
+    # 12% slow → burn 1.2 → warn
+    for _ in range(12):
+        mon.observe(0.050)
+    snap = mon.snapshot()
+    assert snap["objectives"]["p99"]["state"] == "warn"
+    assert snap["state"] == "warn" and snap["state_code"] == 1
+
+    # pile on: 30% slow → burn ≥ 2 → breach
+    for _ in range(30):
+        mon.observe(0.050)
+    snap = mon.snapshot()
+    assert snap["objectives"]["p99"]["state"] == "breach"
+    assert snap["state_code"] == 2
+
+    # the window drains: advance past it, healthy traffic → recover to ok
+    clock.t += 11.0
+    for _ in range(20):
+        mon.observe(0.001)
+    snap = mon.snapshot()
+    assert snap["objectives"]["p99"]["state"] == "ok"
+    assert snap["n"] == 20  # aged-out samples really left the window
+
+
+def test_slo_error_rate_and_reject_samples():
+    clock = _Clock()
+    slo = tslo.SLO("errors", "error_rate", budget=0.05)
+    mon = tslo.SloMonitor((slo,), window_s=60.0, clock=clock)
+    for _ in range(95):
+        mon.observe(0.001, ok=True)
+    for _ in range(5):
+        mon.observe(None, ok=False)  # rejects carry no latency
+    snap = mon.snapshot()
+    assert snap["error_rate"] == pytest.approx(0.05)
+    assert snap["objectives"]["errors"]["burn_rate"] == pytest.approx(1.0)
+    assert snap["objectives"]["errors"]["state"] == "warn"
+    # latency quantiles ignore the NaN (reject) samples
+    assert snap["p99_ms"] is not None
+
+
+def test_slo_queue_breach_is_reachable():
+    # queue burn is continuous (occupancy / ceiling), so a saturated
+    # queue must be able to reach breach — a binary trip capped burn at
+    # 1.0 and left breach unreachable for any ceiling above 0.5
+    slo = tslo.slos_from_env({"FMRP_SLO_QUEUE": "0.8"})[0]
+    mon = tslo.SloMonitor((slo,), window_s=60.0, clock=_Clock())
+    mon.observe_queue(0.5)
+    assert mon.snapshot()["objectives"]["queue_occupancy"]["state"] == "ok"
+    mon.observe_queue(0.7)  # 87.5% of the ceiling → warn
+    assert mon.snapshot()["objectives"]["queue_occupancy"]["state"] == "warn"
+    mon.observe_queue(0.95)  # over the ceiling → breach
+    snap = mon.snapshot()["objectives"]["queue_occupancy"]
+    assert snap["state"] == "breach"
+    assert snap["burn_rate"] == pytest.approx(0.95 / 0.8)
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError, match="kind"):
+        tslo.SLO("x", "bogus")
+    with pytest.raises(ValueError, match="threshold_ms"):
+        tslo.SLO("x", "latency")
+    with pytest.raises(ValueError, match="budget"):
+        tslo.SLO("x", "error_rate", budget=0.0)
+    with pytest.raises(ValueError, match="warn"):
+        tslo.SLO("x", "latency", threshold_ms=1.0,
+                 warn_burn=2.0, breach_burn=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        tslo.SloMonitor((
+            tslo.SLO("a", "error_rate", budget=0.1),
+            tslo.SLO("a", "error_rate", budget=0.2),
+        ))
+
+
+def test_slos_from_env():
+    env = {
+        "FMRP_SLO_P99_MS": "25",
+        "FMRP_SLO_ERROR_RATE": "0.02",
+        "FMRP_SLO_QUEUE": "0.9",
+        "FMRP_SLO_WARN_BURN": "0.5",
+    }
+    slos = tslo.slos_from_env(env)
+    assert {s.name for s in slos} == {
+        "p99_latency", "error_rate", "queue_occupancy"
+    }
+    p99 = next(s for s in slos if s.name == "p99_latency")
+    assert p99.threshold_ms == 25.0 and p99.budget == 0.01
+    assert p99.warn_burn == 0.5
+    assert tslo.slos_from_env({}) == ()
+
+
+def test_erservice_slo_in_stats_and_metrics():
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _serving_state()
+    t = 24
+    slos = (tslo.SLO("p99_latency", "latency", threshold_ms=1e4),)
+    with ERService(state, max_batch=8, warm=True, auto_flush=False,
+                   slos=slos) as svc:
+        svc.submit(t - 1, x[t - 1, 0])
+        svc.batcher.drain()
+        stats = svc.stats()
+        assert stats["slo_state"] == "ok"
+        assert stats["slo_state_code"] == 0
+        assert stats["slo"]["p99_latency"]["burn_rate"] == 0.0
+        text = svc.prometheus_metrics()
+    assert 'fmrp_slo_state{slo="p99_latency"} 0' in text
+    assert 'fmrp_slo_burn_rate{slo="p99_latency"}' in text
+    assert "fmrp_serving_service_slo_state_code 0" in text
+
+
+def test_erservice_without_slos_reports_none():
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _serving_state()
+    with ERService(state, max_batch=8, warm=True, auto_flush=False) as svc:
+        stats = svc.stats()
+        assert stats["slo_state"] is None
+        assert "slo" not in stats
+
+
+# -- Prometheus text-format edge cases --------------------------------------
+
+
+def test_label_values_are_escaped():
+    reg = telemetry.registry()
+    hostile = 'say "hi"\\path\nnewline'
+    reg.counter(
+        "fmrp_test_escape_total", help="escape probe", detail=hostile
+    ).inc()
+    text = reg.to_prometheus()
+    (line,) = [
+        l for l in text.splitlines()
+        if l.startswith("fmrp_test_escape_total{")
+    ]
+    # escaped per exposition format: \" \\ \n — and ONE physical line
+    assert '\\"hi\\"' in line
+    assert "\\\\path" in line
+    assert "\\nnewline" in line
+    assert "\n" not in line
+
+
+def test_help_lines_are_escaped():
+    reg = telemetry.registry()
+    reg.counter("fmrp_test_help_total", help="line1\nline2 \\ slash").inc()
+    text = reg.to_prometheus()
+    (help_line,) = [
+        l for l in text.splitlines()
+        if l.startswith("# HELP fmrp_test_help_total")
+    ]
+    assert help_line == "# HELP fmrp_test_help_total line1\\nline2 \\\\ slash"
+
+
+def _parse_histogram(text, name):
+    buckets, hsum, count = [], None, None
+    for line in text.splitlines():
+        if line.startswith(f"{name}_bucket"):
+            buckets.append(float(line.rsplit(" ", 1)[1]))
+        elif line.startswith(f"{name}_sum"):
+            hsum = float(line.rsplit(" ", 1)[1])
+        elif line.startswith(f"{name}_count"):
+            count = float(line.rsplit(" ", 1)[1])
+    return buckets, hsum, count
+
+
+def test_histogram_rendering_under_concurrent_updates():
+    reg = telemetry.registry()
+    hist = reg.histogram(
+        "fmrp_test_concurrent_seconds", buckets=(0.001, 0.01, 0.1, 1.0)
+    )
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            hist.observe(0.005)
+            hist.observe(0.5)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(20):
+            text = reg.to_prometheus()
+            buckets, hsum, count = _parse_histogram(
+                text, "fmrp_test_concurrent_seconds"
+            )
+            assert len(buckets) == 5  # 4 bounds + +Inf
+            # cumulative buckets are monotone and +Inf equals count —
+            # a torn read would violate one of these
+            assert buckets == sorted(buckets)
+            assert buckets[-1] == count
+            assert hsum >= 0
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+
+
+def test_metrics_server_content_type_and_help_type_lines():
+    from fm_returnprediction_tpu.serving import ERService
+
+    state, x = _serving_state()
+    t = 24
+    with ERService(state, max_batch=8, warm=True, auto_flush=False) as svc:
+        svc.submit(t - 1, x[t - 1, 0])
+        svc.batcher.drain()
+        host, port = svc.start_metrics_server()
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == (
+                "text/plain; version=0.0.4"
+            )
+            body = resp.read().decode()
+    lines = body.splitlines()
+    # every family: HELP (when present) immediately precedes TYPE, TYPE
+    # precedes its samples, and TYPE values are legal
+    seen_type = {}
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in seen_type  # one TYPE per family
+            seen_type[name] = i
+            if i and lines[i - 1].startswith("# HELP "):
+                assert lines[i - 1].split(" ")[2] == name
+        elif line and not line.startswith("#"):
+            metric = line.split("{", 1)[0].split(" ", 1)[0]
+            family = metric
+            for suffix in ("_bucket", "_sum", "_count"):
+                if metric.endswith(suffix) and metric[: -len(suffix)] in seen_type:
+                    family = metric[: -len(suffix)]
+                    break
+            if family in seen_type:
+                assert seen_type[family] < i  # TYPE precedes samples
+    assert "fmrp_serving_requests_done_total" in seen_type
+    assert "fmrp_serving_request_latency_seconds" in seen_type

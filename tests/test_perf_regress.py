@@ -1,0 +1,245 @@
+"""Perf-regression sentinel (`telemetry.regress`).
+
+Fast unit tests pin the detector's semantics on synthetic histories; the
+tier-2 gate (marked ``slow``) runs the sentinel over the REAL in-repo
+``BENCH_r*.json`` trajectory — improvements must read as improvements,
+nothing may falsely regress, and an injected synthetic regression must be
+caught. The gate skips cleanly when the history is absent (a fresh clone
+without bench artifacts)."""
+
+import glob
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from fm_returnprediction_tpu.telemetry import regress
+
+pytestmark = pytest.mark.obs
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write_round(tmp_path, n, metric, value, extra=None, name=None):
+    doc = {
+        "n": n,
+        "parsed": {
+            "metric": metric,
+            "value": value,
+            "unit": "s",
+            "vs_baseline": 1.0,
+            "extra": extra or {},
+        },
+    }
+    path = tmp_path / (name or f"BENCH_r{n:02d}.json")
+    path.write_text(json.dumps(doc))
+    return path
+
+
+# -- parsing / ordering -----------------------------------------------------
+
+
+def test_load_rounds_orders_by_n_then_filename(tmp_path):
+    p2 = _write_round(tmp_path, 2, "wall_s", 2.0)
+    p1 = _write_round(tmp_path, 1, "wall_s", 1.0)
+    # a self-run artifact without "n" falls back to the rNN in its name
+    doc = {"parsed": {"metric": "wall_s", "value": 1.5, "extra": {}}}
+    (tmp_path / "BENCH_r01_self.json").write_text(json.dumps(doc))
+    rounds = regress.load_rounds(
+        [p2, p1, tmp_path / "BENCH_r01_self.json"]
+    )
+    assert [r.label for r in rounds] == [
+        "BENCH_r01", "BENCH_r01_self", "BENCH_r02"
+    ]
+
+
+def test_load_round_tolerates_foreign_files(tmp_path):
+    (tmp_path / "junk.json").write_text("not json at all")
+    (tmp_path / "other.json").write_text('{"hello": 1}')
+    assert regress.load_round(tmp_path / "junk.json") is None
+    assert regress.load_round(tmp_path / "other.json") is None
+    assert regress.load_round(tmp_path / "missing.json") is None
+
+
+def test_flatten_skips_bools_nulls_and_skip_markers(tmp_path):
+    p1 = _write_round(tmp_path, 1, "wall_s", 1.0, extra={
+        "warm_s": 2.0,
+        "flag": True,
+        "probe": None,
+        "pallas_ms": {"skipped": "tpu-only"},
+        "stages": {"a": 0.5},
+    })
+    r = regress.load_round(p1)
+    assert r.values == {"warm_s": 2.0, "stages.a": 0.5, "wall_s": 1.0}
+
+
+# -- direction classification ----------------------------------------------
+
+
+@pytest.mark.parametrize("key,expected", [
+    ("pipeline_warm_s", "lower"),
+    ("serving_p99_ms", "lower"),
+    ("specgrid_gram_mb", "lower"),
+    ("guard_overhead_table2_pct", "lower"),
+    ("serving_qps", "higher"),
+    ("specgrid_speedup_warm", "higher"),
+    ("daily_fullscale_rows_per_s", "higher"),
+    ("vs_baseline", "higher"),
+    ("specgrid_programs", None),
+    ("jax_cache_before.entries", None),
+    ("real_pipeline_stage_s.table_2", None),  # attribution, not gated
+    ("serving_ledger_compile_s", None),  # cache-state dependent, not gated
+])
+def test_direction(key, expected):
+    assert regress.direction(key) == expected
+
+
+# -- verdict semantics ------------------------------------------------------
+
+
+def _analyze(tmp_path, histories):
+    """histories: {metric: [v1, v2, ...]} — one file per round index."""
+    n_rounds = max(len(v) for v in histories.values())
+    paths = []
+    for i in range(n_rounds):
+        extra = {
+            k: vals[i] for k, vals in histories.items()
+            if i < len(vals) and vals[i] is not None
+        }
+        paths.append(
+            _write_round(tmp_path, i + 1, "headline_s",
+                         extra.pop("headline_s", 1.0), extra=extra)
+        )
+    return regress.analyze(regress.load_rounds(paths))
+
+
+def test_new_best_is_improved_and_regression_is_caught(tmp_path):
+    report = _analyze(tmp_path, {
+        "headline_s": [10.0, 5.0, 4.0],       # improving
+        "warm_s": [10.0, 4.0, 13.0],          # 3.25x worse than best
+        "steady_s": [1.0, 1.05, 1.1],         # within the 25% floor band
+    })
+    by_key = {v.key: v for v in report.verdicts}
+    assert by_key["headline_s"].status == "improved"
+    assert by_key["warm_s"].status == "regressed"
+    assert by_key["steady_s"].status == "ok"
+    assert not report.ok
+    assert [v.key for v in report.regressions] == ["warm_s"]
+
+
+def test_higher_is_better_directions(tmp_path):
+    report = _analyze(tmp_path, {
+        "serving_qps": [100.0, 150.0, 80.0],   # collapsed beyond band
+        "x_speedup": [2.0, 2.1, 2.2],          # new best
+    })
+    by_key = {v.key: v for v in report.verdicts}
+    assert by_key["serving_qps"].status == "regressed"
+    assert by_key["x_speedup"].status == "improved"
+
+
+def test_fitted_noise_band_widens_for_flappy_metrics(tmp_path):
+    # history flaps ±60%: the fitted band must absorb another 60% swing
+    # that the 25% floor alone would have flagged
+    report = _analyze(tmp_path, {
+        "flappy_s": [1.0, 1.6, 1.0, 1.6, 1.0, 1.55],
+    })
+    (v,) = [v for v in report.verdicts if v.key == "flappy_s"]
+    assert v.status == "ok"
+    assert v.band_ratio > 1.25
+
+
+def test_abs_floor_suppresses_microscopic_regressions(tmp_path):
+    report = _analyze(tmp_path, {
+        "tiny_s": [0.001, 0.001, 0.002],  # 2x but 1ms — below abs floor
+    })
+    (v,) = [v for v in report.verdicts if v.key == "tiny_s"]
+    assert v.status == "ok"
+
+
+def test_new_missing_and_nonpositive_statuses(tmp_path):
+    report = _analyze(tmp_path, {
+        "old_s": [1.0, 1.0, None],        # gone in latest
+        "fresh_s": [None, None, 1.0],     # first appearance
+        "signed_pct": [-3.0, 2.0, 5.0],   # non-positive history
+    })
+    by_key = {v.key: v for v in report.verdicts}
+    assert by_key["old_s"].status == "missing"
+    assert by_key["fresh_s"].status == "new"
+    assert by_key["signed_pct"].status == "skipped"
+    assert report.ok  # none of those gate
+
+
+def test_report_roundtrips_to_json(tmp_path):
+    report = _analyze(tmp_path, {"headline_s": [2.0, 1.0, 3.0]})
+    doc = report.to_json()
+    assert doc["ok"] is False
+    assert doc["latest"] == "BENCH_r03"
+    text = report.format_text()
+    assert "FAIL" in text and "headline_s" in text
+    json.dumps(doc)  # serializable
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_gates_and_no_fail_mode(tmp_path, capsys, monkeypatch):
+    _write_round(tmp_path, 1, "wall_s", 1.0)
+    _write_round(tmp_path, 2, "wall_s", 5.0)
+    files = sorted(str(p) for p in tmp_path.glob("BENCH_*.json"))
+    assert regress.main(files) == 1  # regression → gate fails
+    assert regress.main([*files, "--no-fail"]) == 0
+    out = capsys.readouterr().out
+    assert "regressed" in out
+    rc = regress.main([*files, "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+
+
+def test_cli_too_little_history_exits_zero(tmp_path, capsys):
+    p = _write_round(tmp_path, 1, "wall_s", 1.0)
+    assert regress.main([str(p)]) == 0
+    assert "need >=2" in capsys.readouterr().err
+
+
+# -- tier-2 gate over the real in-repo history ------------------------------
+
+
+def _repo_history():
+    return sorted(glob.glob(str(REPO / "BENCH_r*.json")))
+
+
+@pytest.mark.slow
+def test_repo_bench_history_has_no_false_regressions():
+    files = _repo_history()
+    if len(files) < 2:
+        pytest.skip("no BENCH_*.json history in this checkout")
+    report = regress.analyze(regress.load_rounds(files))
+    assert report.ok, report.format_text()
+    # the known r03→r05 improvement trajectory reads as improvement
+    by_key = {v.key: v.status for v in report.verdicts}
+    if "real_pipeline_warm_s" in by_key:
+        assert by_key["real_pipeline_warm_s"] in ("improved", "ok")
+
+
+@pytest.mark.slow
+def test_repo_history_catches_injected_regression(tmp_path):
+    files = _repo_history()
+    if len(files) < 2:
+        pytest.skip("no BENCH_*.json history in this checkout")
+    rounds = regress.load_rounds(files)
+    latest = json.loads(Path(files[-1]).read_text())
+    payload = latest.get("parsed", latest)
+    payload["value"] = payload["value"] * 3
+    for key in ("real_pipeline_warm_s", "pipeline_warm_s"):
+        if key in (payload.get("extra") or {}):
+            payload["extra"][key] *= 3
+    latest["n"] = max(r.order[0] for r in rounds) + 1
+    inject = tmp_path / "BENCH_r99.json"
+    inject.write_text(json.dumps(latest))
+    report = regress.analyze(regress.load_rounds([*files, inject]))
+    assert not report.ok
+    assert any(
+        v.key == payload["metric"] for v in report.regressions
+    ), report.format_text()

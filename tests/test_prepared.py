@@ -137,6 +137,10 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
                     "factors/daily_ingest", "factors/long_to_dense",
                     "build_panel/save_prepared"):
         assert skipped not in warm.timer.durations, skipped
+    # the short-circuited raw ingest is an EXPLICIT skip with a reason —
+    # not a 0.0 that reads as "free" in the per-stage breakdowns
+    assert warm.timer.skipped["load_raw_data"] == "prepared checkpoint hit"
+    assert "load_raw_data" not in cold.timer.skipped
     assert _tables(warm) == _tables(cold)  # bit-identical reporting
 
     # staleness: re-pulling a raw file invalidates the checkpoint

@@ -275,8 +275,9 @@ def test_chrome_trace_is_valid_and_complete(tmp_path):
     events = doc["traceEvents"]
     assert isinstance(events, list) and events
     phases = {e["ph"] for e in events}
-    assert phases <= {"X", "i", "M"}
-    complete = [e for e in events if e["ph"] == "X"]
+    assert phases <= {"X", "i", "M", "C"}  # C: cost-ledger counter tracks
+    complete = [e for e in events if e["ph"] == "X"
+                and not e["name"].startswith("compile:")]
     assert {e["name"] for e in complete} == {"alpha", "beta"}
     for e in complete:
         assert isinstance(e["ts"], (int, float))

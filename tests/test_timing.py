@@ -84,3 +84,21 @@ def test_stage_timer_ensure_stage_covers_standalone_helpers():
                 pass
     assert "build_panel" not in timer2.durations
     assert timer2.total() == timer2.durations["caller"]
+
+
+def test_stage_timer_mark_skipped_records_reason_not_zero():
+    # a deliberately-skipped stage must be distinguishable from one that
+    # ran in ~0 s: no durations entry, an explicit reason, and visibility
+    # in the report
+    timer = StageTimer()
+    timer.mark_skipped("load_raw_data", "prepared checkpoint hit")
+    assert "load_raw_data" not in timer.durations
+    assert timer.skipped == {"load_raw_data": "prepared checkpoint hit"}
+    assert "skipped (prepared checkpoint hit)" in timer.report()
+    assert timer.total() == 0.0
+
+    # a stage that later actually runs clears its skip marker
+    with timer.stage("load_raw_data"):
+        pass
+    assert "load_raw_data" in timer.durations
+    assert "load_raw_data" not in timer.skipped
