@@ -374,10 +374,13 @@ def _real_cpu_rescue(raw_dir: str, budget: float) -> dict:
     # cold ingest, which must not masquerade as the repeat-run number. The
     # timer records the load_prepared ATTEMPT even on a miss, so the
     # discriminator is the raw ingest not actually RUNNING (on a
-    # checkpoint hit it now appears as an explicit {"skipped": ...} entry
-    # rather than being absent).
-    warm_like = not isinstance(
-        got["stages"].get("load_raw_data"), (int, float)
+    # checkpoint hit it appears as an explicit {"skipped": ...} entry
+    # rather than being absent). Both ingest routes count: the legacy
+    # route records load_raw_data, the columnar route streams its reads
+    # inside panel/monthly_ingest.
+    warm_like = not any(
+        isinstance(got["stages"].get(k), (int, float))
+        for k in ("load_raw_data", "panel/monthly_ingest")
     )
     kind = "warm" if warm_like else "cold"
     stage_key = ("real_pipeline_stage_s" if warm_like
@@ -395,6 +398,107 @@ def _round_stages(stages: dict) -> dict:
         k: round(v, 3) if isinstance(v, (int, float)) else v
         for k, v in stages.items()
     }
+
+
+def _bench_panel_build(fast: bool):
+    """Panel-build routes head to head: columnar vs legacy ingest.
+
+    The tentpole evidence for the device-resident columnar panel build
+    (ISSUE 7): both routes ingest the SAME benchscale cache cold (raw
+    parquet → enriched device panel, prepared checkpoint disabled so the
+    ingest actually runs), recording per-stage wall and raw-rows/s
+    throughput (``*_rows_per_s`` — a higher-is-better series for the
+    perf-regression sentinel), then repeat warm under ``recompile_watch``
+    so any re-trace of the new jitted panel programs (the fused
+    characteristics+winsorize program, the gather-reconstruction daily
+    strips) is flagged and counted into
+    ``fmrp_unexpected_recompiles_total``. FMRP_BENCH_PANEL=0 skips;
+    FMRP_BENCH_PANEL_MONTHS/_FIRMS resize (default a mid shape — the
+    real-shape section already times the default route end to end)."""
+    if os.environ.get("FMRP_BENCH_PANEL", "1") == "0":
+        return {}
+    from fm_returnprediction_tpu import settings, telemetry
+    from fm_returnprediction_tpu.data.benchscale import write_benchscale_cache
+    from fm_returnprediction_tpu.pipeline import load_or_build_panel, resolve_dtype
+    from fm_returnprediction_tpu.utils.timing import StageTimer
+
+    t = int(os.environ.get("FMRP_BENCH_PANEL_MONTHS", 60 if fast else 240))
+    n = int(os.environ.get("FMRP_BENCH_PANEL_FIRMS", 400 if fast else 8000))
+    os.environ.setdefault("FMRP_SYNC_STAGES", "1")  # honest attribution
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    raw_dir = os.path.join(repo_root, "_cache", f"benchscale_T{t}_N{n}")
+    write_benchscale_cache(raw_dir, n_permnos=n, n_months=t)
+
+    # raw-row volume from parquet metadata (free): the throughput
+    # denominator counts what the ingest actually has to chew through
+    import pyarrow.parquet as pq
+
+    from fm_returnprediction_tpu.data.synthetic import FILE_NAMES
+
+    raw_rows = sum(
+        pq.ParquetFile(os.path.join(raw_dir, name)).metadata.num_rows
+        for name in FILE_NAMES.values()
+    )
+
+    out = {"panel_build_shape": f"T{t}_N{n}", "panel_build_raw_rows": raw_rows}
+    prev_route = os.environ.get("FMRP_PANEL_ROUTE")
+    prev_prepared = settings.d.get("PREPARED_CACHE")
+    try:
+        settings.d["PREPARED_CACHE"] = 0  # measure the ingest, not the skip
+        # Pre-warm the SHARED device programs (fused characteristics
+        # program, daily strip kernels) with one untimed build: both
+        # routes run the same programs at the same shapes, so whichever
+        # route ran first would otherwise pay the traces/compiles inside
+        # its "cold" number and flatter the other — this section compares
+        # INGEST routes, so cold_s means ingest-cold / program-warm (the
+        # real-shape section still measures true compile-cold).
+        os.environ["FMRP_PANEL_ROUTE"] = "columnar"
+        warm_panel, _ = load_or_build_panel(
+            raw_dir, dtype=resolve_dtype(), timer=StageTimer()
+        )
+        np.asarray(warm_panel.values[0, 0])
+        del warm_panel
+        for route in ("columnar", "legacy"):
+            os.environ["FMRP_PANEL_ROUTE"] = route
+            timer = StageTimer()
+            with _timed(f"bench.panel_build_{route}_cold") as cold:
+                panel, _ = load_or_build_panel(
+                    raw_dir, dtype=resolve_dtype(), timer=timer
+                )
+                np.asarray(panel.values[0, 0])  # host pull = barrier
+            out[f"panel_build_{route}_cold_s"] = round(cold.s, 4)
+            out[f"panel_build_{route}_stage_s"] = _round_stages({
+                **timer.durations,
+                **{k: {"skipped": v} for k, v in timer.skipped.items()},
+            })
+            out[f"panel_build_{route}_rows_per_s"] = round(raw_rows / cold.s, 1)
+            # warm repeat: same ingest, programs already compiled — cache
+            # growth here means a panel program re-traced and is flagged
+            with telemetry.recompile_watch(
+                f"panel_build_{route}_warm", warm=True
+            ) as cache_delta:
+                with _timed(f"bench.panel_build_{route}_warm") as warm:
+                    panel, _ = load_or_build_panel(
+                        raw_dir, dtype=resolve_dtype(), timer=StageTimer()
+                    )
+                    np.asarray(panel.values[0, 0])
+            out[f"panel_build_{route}_warm_s"] = round(warm.s, 4)
+            if cache_delta.grew:
+                out[f"panel_build_{route}_warm_recompiles"] = {
+                    "cache_entries_grew": cache_delta.grew,
+                    "culprits": list(cache_delta.culprits) or ["unattributed-jit"],
+                }
+            del panel
+    finally:
+        if prev_route is None:
+            os.environ.pop("FMRP_PANEL_ROUTE", None)
+        else:
+            os.environ["FMRP_PANEL_ROUTE"] = prev_route
+        if prev_prepared is None:
+            settings.d.pop("PREPARED_CACHE", None)
+        else:
+            settings.d["PREPARED_CACHE"] = prev_prepared
+    return out
 
 
 def _bench_daily_fullscale(fast: bool):
@@ -1402,6 +1506,7 @@ def main() -> None:
     if os.environ.get("FMRP_BENCH_PIPE", "1") == "1":
         sections.append(_bench_pipeline)
     sections.append(_bench_pipeline_real)  # _REAL=0 handled in-section
+    sections.append(_bench_panel_build)  # _PANEL=0 handled in-section
     if os.environ.get("FMRP_BENCH_KERNEL", "1") == "1":
         sections.append(_bench_kernel)
     if os.environ.get("FMRP_BENCH_DAILY", "1") == "1":

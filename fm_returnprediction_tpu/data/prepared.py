@@ -1,41 +1,43 @@
 """Prepared-inputs checkpoint: skip the host ingest path on warm runs.
 
-At real 1964-2013 CRSP shape, ~98 s of the end-to-end wall-clock is
-host-side pandas/parquet work a TPU cannot touch: reading the 77M-row daily
-parquet, the common-stock/exchange universe filter, the monthly relational
-transforms, the long→compact daily ingest, and the long→dense monthly
-scatter (BENCH_r03/r04 ``real_pipeline_stage_s``). All of it is a pure
-function of the five raw cache files (plus the compute dtype and the
+At real 1964-2013 CRSP shape, the cold wall is host-side ingest work a TPU
+cannot touch: reading the 77M-row daily parquet, the universe filter, the
+relational transforms, the long→compact daily ingest, and the long→dense
+monthly scatter (BENCH_r03-r05 ``real_pipeline_*stage_s``). All of it is a
+pure function of the five raw cache files (plus the compute dtype and the
 INCLUDE_TURNOVER column set), so the pipeline checkpoints its two host
 products:
 
-- ``dense_base.npz``    — the scattered dense monthly base panel
-  (``panel.dense.DensePanel`` over BASE_COLUMNS + is_nyse): the direct
-  input to the device characteristic engine. v1 stored the merged long
-  frame instead and re-scattered it every warm run (~11 s at real shape);
-  the dense base is the same information one stage later, host-numpy at
-  capture time (no device pull to save it), and loads in the time the
-  parquet read alone used to take.
-- ``compact_daily.npz`` — the per-firm compacted daily strips + the
-  shared calendar vectors (``panel.daily.CompactDaily``): the input to the
-  daily vol/beta kernels.
+- the dense monthly BASE panel (``panel.dense.DensePanel`` over
+  BASE_COLUMNS + is_nyse): the direct input to the device characteristic
+  engine;
+- the per-firm compacted daily strips + shared calendar vectors
+  (``panel.daily.CompactDaily``): the input to the daily vol/beta kernels.
 
-A warm run loads these two files (IO-bound, seconds) instead of redoing the
-ingest, which is the difference between the <60 s north-star budget being
-reachable and not. This extends the reference's cache-as-checkpoint role
-(``/root/reference/src/utils.py:183-218`` caches raw pulls; every transform
-recomputes each run) one stage further, the same way the task graph's
-dense-panel npz does between build and report stages.
+Layout v3 is COLUMNAR: one raw ``.npy`` file per array under
+``<raw_dir>/_prepared/`` instead of the v2 npz bundles. npz is a zip
+container — every load decompresses/copies each member through Python —
+while bare ``.npy`` files load with ``np.load(mmap_mode="r")``: the warm
+run maps the checkpoint ZERO-COPY in milliseconds (v2 cost 1.3-2.9 s at
+real shape) and pages flow from the OS cache straight into the consumers
+(the device push, the daily strip assembly) without an intermediate heap
+copy.
 
-Validity is a fingerprint over the raw files' (name, size, mtime) plus the
-compute dtype, a caller salt (the resolved INCLUDE_TURNOVER flag — it
-changes the base column set), and a layout version — the make-style
-staleness contract: any re-pull or re-generation of the raw caches
-invalidates the checkpoint. One slot per raw directory
-(``<raw_dir>/_prepared/``), overwritten in place; ``meta.json`` is written
-last (tmp + rename), so a crashed writer leaves a stale fingerprint, never
-a half-valid checkpoint. Set ``PREPARED_CACHE=0`` to disable both reading
-and writing.
+Integrity: ``meta.json`` carries a sha256 + byte-size manifest over every
+payload file (the same guard-manifest shape as the audit/drift layer and
+``utils.cache.save_array_bundle``). Loads always verify structure and
+sizes; a mismatch — or any structurally unreadable payload — surfaces as
+the typed :class:`CorruptArtifactError` internally and degrades to a
+rebuild (warning, never a crash), preserving the v2 semantics. Full
+content re-hash on load costs what the mmap saves, so it is opt-in:
+``FMRP_PREPARED_VERIFY=1``.
+
+Validity is a fingerprint over the raw files' (name, size, mtime) plus
+the compute dtype, a caller salt (the resolved INCLUDE_TURNOVER flag) and
+the layout version — the make-style staleness contract. One slot per raw
+directory, overwritten in place; ``meta.json`` is written last (tmp +
+rename), so a crashed writer leaves a stale fingerprint, never a
+half-valid checkpoint. ``PREPARED_CACHE=0`` disables reading and writing.
 """
 
 from __future__ import annotations
@@ -51,6 +53,7 @@ import numpy as np
 
 from fm_returnprediction_tpu.panel.daily import CompactDaily
 from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
 
 __all__ = [
     "PREPARED_DIRNAME",
@@ -62,14 +65,20 @@ __all__ = [
 
 PREPARED_DIRNAME = "_prepared"
 # Bump when the prepared LAYOUT or the ingest semantics feeding it change —
-# an old checkpoint must not satisfy a new pipeline. v2: dense base panel
-# replaced the merged long frame (long_to_dense moved inside the
-# checkpoint boundary).
-_VERSION = 2
+# an old checkpoint must not satisfy a new pipeline. v3: columnar per-array
+# .npy files with a sha256 manifest, memory-mapped on load (v2 was two npz
+# bundles; v1 stored the merged long frame).
+_VERSION = 3
 
-_BASE_FILE = "dense_base.npz"
-_DAILY_FILE = "compact_daily.npz"
 _META_FILE = "meta.json"
+# v2 payloads a version upgrade orphans — removed by the next save
+_STALE_FILES = ("dense_base.npz", "compact_daily.npz", "monthly_merged.parquet")
+
+_BASE_ARRAYS = ("values", "mask", "months", "ids", "var_names")
+_DAILY_ARRAYS = (
+    "row_values", "row_pos", "offsets", "ids", "mkt", "mkt_present",
+    "days", "day_month_id", "week_id", "week_month_id",
+)
 
 
 def prepared_enabled() -> bool:
@@ -100,45 +109,70 @@ def raw_fingerprint(raw_dir, dtype, salt: str = "") -> str:
     return h.hexdigest()
 
 
+def _file_sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 22), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _write_npy(prepared_dir: Path, name: str, arr: np.ndarray, manifest: dict):
+    """One atomic .npy write (tmp + rename) + its manifest entry."""
+    path = prepared_dir / f"{name}.npy"
+    tmp = prepared_dir / f".{name}.tmp{os.getpid()}.npy"
+    try:
+        with open(tmp, "wb") as f:
+            np.lib.format.write_array(
+                f, np.ascontiguousarray(arr), allow_pickle=False
+            )
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
+    st = path.stat()
+    manifest[f"{name}.npy"] = {
+        "sha256": _file_sha256(path), "size": st.st_size,
+    }
+
+
 def save_prepared(
     prepared_dir, fingerprint: str, base: DensePanel, cd: CompactDaily
 ) -> None:
-    """Write the checkpoint; meta (with the fingerprint) goes LAST so a
-    partial write is indistinguishable from a stale one. Failures degrade to
-    a warning — the checkpoint is an accelerant, never a correctness gate.
-
-    Both payloads are savez UNcompressed: they are hundreds of MB of
-    near-incompressible floats at real shape, and zlib would cost more
-    than the ingest the checkpoint skips."""
+    """Write the v3 columnar checkpoint; meta (fingerprint + manifest) goes
+    LAST so a partial write is indistinguishable from a stale one. Failures
+    degrade to a warning — the checkpoint is an accelerant, never a
+    correctness gate."""
     prepared_dir = Path(prepared_dir)
     try:
         prepared_dir.mkdir(parents=True, exist_ok=True)
-        meta = prepared_dir / _META_FILE
-        meta.unlink(missing_ok=True)  # invalidate before touching payloads
-        # drop the v1 payload a version upgrade orphans (~0.2 GB at real
-        # shape); nothing references it once meta is v2
-        (prepared_dir / "monthly_merged.parquet").unlink(missing_ok=True)
+        meta_path = prepared_dir / _META_FILE
+        meta_path.unlink(missing_ok=True)  # invalidate before payloads
+        for stale in _STALE_FILES:
+            (prepared_dir / stale).unlink(missing_ok=True)
+
+        manifest: dict = {}
         months_unit = np.datetime_data(base.months.dtype)[0]
-        np.savez(
-            prepared_dir / _BASE_FILE,
-            values=np.asarray(base.values),
-            mask=np.asarray(base.mask),
-            months=base.months.astype(np.int64),
-            ids=np.asarray(base.ids),
+        days_unit = np.datetime_data(cd.days.dtype)[0]
+        base_arrays = {
+            "values": np.asarray(base.values),
+            "mask": np.asarray(base.mask),
+            "months": base.months.astype(np.int64),
+            "ids": np.asarray(base.ids),
             # fixed-width unicode, NOT object dtype: loadable with
             # allow_pickle off (no pickle surface in a shared artifact)
-            var_names=np.asarray(base.var_names, dtype=np.str_),
-        )
-        arrays = {
-            f.name: getattr(cd, f.name)
-            for f in dataclasses.fields(cd)
-            if isinstance(getattr(cd, f.name), np.ndarray)
+            "var_names": np.asarray(base.var_names, dtype=np.str_),
         }
-        # datetime64 won't survive npz without a unit side-channel
-        days_unit = np.datetime_data(cd.days.dtype)[0]
-        arrays["days"] = cd.days.astype(np.int64)
-        np.savez(prepared_dir / _DAILY_FILE, **arrays)
-        tmp = meta.with_suffix(f".tmp{os.getpid()}")  # per-writer tmp name
+        for name, arr in base_arrays.items():
+            _write_npy(prepared_dir, f"base.{name}", arr, manifest)
+        for field in dataclasses.fields(cd):
+            value = getattr(cd, field.name)
+            if not isinstance(value, np.ndarray):
+                continue
+            if field.name == "days":
+                value = value.astype(np.int64)  # datetime64 needs a unit
+            _write_npy(prepared_dir, f"daily.{field.name}", value, manifest)
+
+        tmp = meta_path.with_suffix(f".tmp{os.getpid()}")  # per-writer tmp
         tmp.write_text(json.dumps({
             "fingerprint": fingerprint,
             "version": _VERSION,
@@ -146,8 +180,9 @@ def save_prepared(
             "days_unit": days_unit,
             "n_weeks": cd.n_weeks,
             "n_months": cd.n_months,
+            "manifest": manifest,
         }))
-        os.replace(tmp, meta)
+        os.replace(tmp, meta_path)
     except OSError as exc:  # read-only raw dir, disk full, ...
         import warnings
 
@@ -155,10 +190,54 @@ def save_prepared(
                       stacklevel=2)
 
 
+def _verify_on_load() -> bool:
+    return os.environ.get("FMRP_PREPARED_VERIFY", "0") == "1"
+
+
+def _load_payload(prepared_dir: Path, name: str, meta: dict) -> np.ndarray:
+    """One payload, memory-mapped, checked against the manifest.
+
+    Size + npy-header structure always verify (free); the full content
+    sha256 re-read is opt-in (``FMRP_PREPARED_VERIFY=1``) because it costs
+    the IO the mmap exists to avoid. Any mismatch or unreadable file is a
+    :class:`CorruptArtifactError` — the caller degrades to a rebuild."""
+    fname = f"{name}.npy"
+    entry = meta.get("manifest", {}).get(fname)
+    path = prepared_dir / fname
+    if entry is None:
+        raise CorruptArtifactError(f"{fname} missing from manifest")
+    try:
+        size = path.stat().st_size
+    except OSError as exc:
+        raise CorruptArtifactError(f"{fname} unreadable: {exc!r}") from exc
+    if size != entry.get("size"):
+        raise CorruptArtifactError(
+            f"{fname} is {size} bytes, manifest says {entry.get('size')}"
+        )
+    if _verify_on_load():
+        try:
+            digest = _file_sha256(path)
+        except OSError as exc:  # EIO, perms, concurrent replace — degrade
+            raise CorruptArtifactError(
+                f"{fname} unreadable during verify: {exc!r}"
+            ) from exc
+        if digest != entry.get("sha256"):
+            raise CorruptArtifactError(f"{fname} failed its content sha256")
+    try:
+        return np.load(path, mmap_mode="r", allow_pickle=False)
+    except (OSError, ValueError) as exc:
+        raise CorruptArtifactError(f"{fname} unreadable: {exc!r}") from exc
+
+
 def load_prepared(
     prepared_dir, fingerprint: str
 ) -> Optional[Tuple[DensePanel, CompactDaily]]:
-    """The checkpoint contents iff present and fingerprint-valid, else None."""
+    """The checkpoint contents iff present and fingerprint-valid, else None.
+
+    Payload arrays come back MEMORY-MAPPED (read-only views): the load
+    itself is header reads + size checks in milliseconds, and bytes page
+    in lazily where they are consumed — the device push, the daily strip
+    assembly — straight from the OS cache with no intermediate copy."""
     prepared_dir = Path(prepared_dir)
     meta_path = prepared_dir / _META_FILE
     try:
@@ -168,32 +247,34 @@ def load_prepared(
     if meta.get("version") != _VERSION or meta.get("fingerprint") != fingerprint:
         return None
     try:
-        with np.load(prepared_dir / _BASE_FILE, allow_pickle=False) as z:
-            base = DensePanel(
-                values=z["values"],
-                mask=z["mask"],
-                months=z["months"].astype(
-                    f"datetime64[{meta['months_unit']}]"
-                ),
-                ids=z["ids"],
-                var_names=[str(v) for v in z["var_names"]],
-            )
-        with np.load(prepared_dir / _DAILY_FILE, allow_pickle=False) as z:
-            cd = CompactDaily(
-                row_values=z["row_values"],
-                row_pos=z["row_pos"],
-                offsets=z["offsets"],
-                ids=z["ids"],
-                mkt=z["mkt"],
-                mkt_present=z["mkt_present"],
-                days=z["days"].astype(f"datetime64[{meta['days_unit']}]"),
-                day_month_id=z["day_month_id"],
-                week_id=z["week_id"],
-                n_weeks=int(meta["n_weeks"]),
-                week_month_id=z["week_month_id"],
-                n_months=int(meta["n_months"]),
-            )
-    except (OSError, KeyError, ValueError) as exc:
+        b = {n: _load_payload(prepared_dir, f"base.{n}", meta)
+             for n in _BASE_ARRAYS}
+        d = {n: _load_payload(prepared_dir, f"daily.{n}", meta)
+             for n in _DAILY_ARRAYS}
+        base = DensePanel(
+            values=b["values"],
+            mask=b["mask"],
+            months=np.asarray(b["months"]).view(
+                f"datetime64[{meta['months_unit']}]"
+            ),
+            ids=b["ids"],
+            var_names=[str(v) for v in b["var_names"]],
+        )
+        cd = CompactDaily(
+            row_values=d["row_values"],
+            row_pos=d["row_pos"],
+            offsets=d["offsets"],
+            ids=d["ids"],
+            mkt=d["mkt"],
+            mkt_present=d["mkt_present"],
+            days=np.asarray(d["days"]).view(f"datetime64[{meta['days_unit']}]"),
+            day_month_id=d["day_month_id"],
+            week_id=d["week_id"],
+            n_weeks=int(meta["n_weeks"]),
+            week_month_id=d["week_month_id"],
+            n_months=int(meta["n_months"]),
+        )
+    except (CorruptArtifactError, KeyError, ValueError, OSError) as exc:
         import warnings
 
         warnings.warn(

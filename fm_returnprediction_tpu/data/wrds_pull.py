@@ -44,6 +44,7 @@ __all__ = [
     "pull_Compustat",
     "pull_CRSP_Comp_link_table",
     "subset_to_common_stock_and_exchanges",
+    "UNIVERSE_FLAGS",
     "build_crsp_stock_sql",
     "build_compustat_sql",
     "build_link_table_sql",
@@ -85,6 +86,21 @@ FLAG_COLUMNS = [
     "issuertype", "primaryexch", "conditionaltype", "tradingstatusflg",
 ]
 
+# The admitted values per flag column — the ONE definition of the US
+# common-stock NYSE/AMEX/NASDAQ universe, consumed by the pandas filter
+# below AND by the columnar ingest route (``data.columnar``), so the two
+# routes cannot drift.
+UNIVERSE_FLAGS = {
+    "conditionaltype": ("RW",),
+    "tradingstatusflg": ("A",),
+    "sharetype": ("NS",),
+    "securitytype": ("EQTY",),
+    "securitysubtype": ("COM",),
+    "usincflg": ("Y",),
+    "issuertype": ("ACOR", "CORP"),
+    "primaryexch": ("N", "A", "Q"),
+}
+
 
 def subset_to_common_stock_and_exchanges(
     crsp: pd.DataFrame, columns: Optional[List[str]] = None
@@ -119,16 +135,10 @@ def subset_to_common_stock_and_exchanges(
             return keep
         return col.isin(values).to_numpy()
 
-    keep = (
-        flag_in("conditionaltype", ["RW"])
-        & flag_in("tradingstatusflg", ["A"])
-        & flag_in("sharetype", ["NS"])
-        & flag_in("securitytype", ["EQTY"])
-        & flag_in("securitysubtype", ["COM"])
-        & flag_in("usincflg", ["Y"])
-        & flag_in("issuertype", ["ACOR", "CORP"])
-        & flag_in("primaryexch", ["N", "A", "Q"])
-    )
+    keep = None
+    for name, values in UNIVERSE_FLAGS.items():
+        m = flag_in(name, list(values))
+        keep = m if keep is None else keep & m
     out = crsp if columns is None else crsp[columns]
     return out[keep]
 

@@ -174,6 +174,7 @@ def _mesh_strip_fn(mesh, axis_name: str, n_days: int, n_weeks: int,
         daily_compact_strip,
         daily_compact_strip_contiguous,
     )
+    from fm_returnprediction_tpu.parallel.mesh import shard_map
 
     kernel = functools.partial(
         daily_compact_strip_contiguous if contiguous else daily_compact_strip,
@@ -190,7 +191,7 @@ def _mesh_strip_fn(mesh, axis_name: str, n_days: int, n_weeks: int,
         in_specs = (P(None, axis_name), P(None, axis_name),
                     P(), P(), P(), P(), P())
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kernel,
             mesh=mesh,
             in_specs=in_specs,

@@ -74,22 +74,53 @@ def daily_compact_strip_contiguous(
     CONTIGUOUS (the norm in CRSP: rows exist for every trading day while
     listed, null returns are NaN VALUES on present rows). The (H, C) int16
     position rectangle then carries no information beyond per-firm
-    ``starts``/``counts`` — reconstructing it on device from two (C,) int32
-    vectors cuts a third of the strip's transfer bytes.
+    ``starts``/``counts`` — two (C,) int32 vectors cut a third of the
+    strip's transfer bytes.
+
+    Contiguity also changes WHICH primitive rebuilds the calendar layout:
+    ``dense[d, k] = comp_ret[d - starts[k], k]`` is a pure offset GATHER,
+    where the general path needs a scatter through the ``pos`` rectangle.
+    XLA's CPU scatter emitter is effectively serial — measured 2.4-4.0 s
+    per (13 k, 2.4 k) strip reconstruction on a 24-core box, three of them
+    per strip = the entire daily-stage wall at real shape (BENCH_r05's
+    30 s / 46 s) — while the offset gather runs the same reconstruction in
+    ~0.1 s and row-validity becomes index arithmetic (no gather at all
+    for the mask). Outputs are bit-identical to the scatter path (pinned
+    by ``tests/test_daily_chunked.py``); on TPU both forms are a single
+    fast HLO (measured scatter ≈ 290 ms per strip, ``ops.daily_compact``
+    module note), so the gather form is used unconditionally here.
     """
     h = comp_ret.shape[0]
+    counts = counts.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
     row = jnp.arange(h, dtype=jnp.int32)[:, None]
-    pos = jnp.where(
-        row < counts.astype(jnp.int32)[None, :],
-        starts.astype(jnp.int32)[None, :] + row,
-        n_days,
+    row_present = row < counts[None, :]
+
+    # vol: rolling over the firm's observed rows — already the ingested
+    # layout, no reconstruction needed
+    vol_rows = rolling_std(
+        jnp.where(row_present, comp_ret, jnp.nan), window, min_periods,
+        use_pallas=use_pallas,
+    ) * jnp.sqrt(jnp.asarray(float(window), dtype=comp_ret.dtype))
+
+    # calendar reconstruction by offset gather: day d of firm k is row
+    # (d - starts[k]) when inside [0, counts[k])
+    day = jnp.arange(n_days, dtype=jnp.int32)[:, None]
+    idx = day - starts[None, :]
+    mask = (idx >= 0) & (idx < counts[None, :])
+    idx_c = jnp.clip(idx, 0, h - 1)
+
+    def to_cal(x):
+        return jnp.where(
+            mask, jnp.take_along_axis(x, idx_c, axis=0), jnp.nan
+        )
+
+    vol = last_obs_per_month(to_cal(vol_rows), mask, day_month_id, n_months)
+    beta = weekly_rolling_beta_monthly(
+        to_cal(comp_ret), mask, mkt_d, week_id, n_weeks, week_month_id,
+        n_months, window_weeks=window_weeks, mkt_present=mkt_present,
     )
-    return daily_compact_strip(
-        comp_ret, pos, mkt_d, mkt_present, day_month_id, week_id,
-        week_month_id, n_days, n_weeks, n_months,
-        window=window, min_periods=min_periods,
-        window_weeks=window_weeks, use_pallas=use_pallas,
-    )
+    return vol, beta
 
 
 @functools.partial(

@@ -21,6 +21,7 @@ __all__ = [
     "CompactDaily",
     "build_daily_panel",
     "build_compact_daily",
+    "build_compact_daily_arrays",
     "month_index_of",
 ]
 
@@ -126,22 +127,43 @@ def build_compact_daily(
     while the common case (cache written firm-major chronological) needs
     only an O(R) sortedness check, flag-based keep-last dedup and
     factorization, and a hash-based day vocabulary."""
-    permno = crsp_d["permno"].to_numpy()
-    # int64 view in the frame's OWN datetime unit: both sides of every
+    date_raw = crsp_d["dlycaldt"].to_numpy()
+    return build_compact_daily_arrays(
+        crsp_d["permno"].to_numpy(),
+        date_raw,
+        crsp_d["retx"].to_numpy(dtype=dtype),
+        crsp_index_d,
+        months,
+        dtype=dtype,
+    )
+
+
+def build_compact_daily_arrays(
+    permno: np.ndarray,
+    date_raw: np.ndarray,
+    retx: np.ndarray,
+    crsp_index_d: pd.DataFrame,
+    months: np.ndarray,
+    dtype=np.float64,
+) -> CompactDaily:
+    """The array-core of :func:`build_compact_daily`: the same compaction
+    from bare ``(permno, date, retx)`` columns, so the columnar ingest
+    route (``panel.columnar``) feeds rows it filtered chunk-by-chunk out
+    of the parquet batches without ever assembling a DataFrame."""
+    # int64 view in the input's OWN datetime unit: both sides of every
     # comparison below come from this same array, so no [ns]->[s] astype
     # pass over the 70M rows is needed (measured ~10s of pure conversion).
     # Foreign caches (csv, parquet date32) load as object dtype — coerce
     # those the slow way first.
-    date_raw = crsp_d["dlycaldt"].to_numpy()
     if date_raw.dtype.kind != "M":
         # tz-aware columns stay object through a bare DatetimeIndex round
         # trip — force a concrete naive unit (UTC instants), as the old
         # pandas path did
         date_raw = np.asarray(
-            pd.DatetimeIndex(crsp_d["dlycaldt"]), dtype="datetime64[s]"
+            pd.DatetimeIndex(date_raw), dtype="datetime64[s]"
         )
     date_i8 = date_raw.view(np.int64)
-    retx = crsp_d["retx"].to_numpy(dtype=dtype)
+    retx = np.asarray(retx, dtype=dtype)
 
     if len(permno):
         in_order = (permno[:-1] < permno[1:]) | (

@@ -29,6 +29,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
     pad_to_multiple,
     pipeline_mesh,
     place_global,
+    shard_map,
     shard_panel,
 )
 from fm_returnprediction_tpu.parallel.time_sharded import (
@@ -67,5 +68,6 @@ __all__ = [
     "rolling_std_time_sharded",
     "rolling_sum_time_sharded",
     "weekly_rolling_beta_time_sharded",
+    "shard_map",
     "shard_panel",
 ]

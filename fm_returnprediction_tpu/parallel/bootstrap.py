@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fm_returnprediction_tpu.ops.newey_west import compact_front
+from fm_returnprediction_tpu.parallel.mesh import shard_map
 
 __all__ = ["BootstrapResult", "block_bootstrap_se", "bootstrap_replicate_means"]
 
@@ -131,7 +132,7 @@ def _jitted_bootstrap_moments(mesh: Optional[Mesh], block_length: int, axis_name
         return s1, s2, pilot
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kernel,
             mesh=mesh,
             in_specs=(P(axis_name), P(), P()),

@@ -66,7 +66,7 @@ from fm_returnprediction_tpu.ops.ols import (
     row_validity,
     sufficient_stats,
 )
-from fm_returnprediction_tpu.parallel.mesh import make_mesh, shard_panel
+from fm_returnprediction_tpu.parallel.mesh import make_mesh, shard_map, shard_panel
 
 __all__ = ["cs_ols_kernel", "monthly_cs_ols_sharded", "fama_macbeth_sharded"]
 
@@ -175,7 +175,7 @@ def monthly_cs_ols_sharded(
             y_l, x_l, mask_l, axis_name, mesh.shape[axis_name], n_refine
         )
 
-    shard = jax.shard_map(
+    shard = shard_map(
         kernel,
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name, None), P(None, axis_name)),

@@ -36,9 +36,22 @@ __all__ = [
     "pad_to_multiple",
     "pipeline_mesh",
     "place_global",
+    "shard_map",
     "shard_panel",
     "host_local_mesh",
 ]
+
+# ``shard_map`` import-compat shim — the ONE place the API's location is
+# resolved. Newer JAX exposes it as ``jax.shard_map``; the versions this
+# container ships keep it at ``jax.experimental.shard_map.shard_map``.
+# Every sharded program in the repo imports the symbol from here, so a
+# JAX upgrade (or downgrade) never turns into six scattered
+# ``AttributeError: module 'jax' has no attribute 'shard_map'`` sites
+# (the disclosed mesh8 bench failure of BENCH_r03-r05).
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on exactly one of the two JAX APIs
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
 
 
 def place_global(a, sharding: NamedSharding) -> jax.Array:

@@ -46,7 +46,11 @@ from fm_returnprediction_tpu.ops.fama_macbeth import (
 )
 from fm_returnprediction_tpu.ops.ols import CSRegressionResult
 from fm_returnprediction_tpu.parallel.fm_sharded import cs_ols_kernel
-from fm_returnprediction_tpu.parallel.mesh import pad_to_multiple, place_global
+from fm_returnprediction_tpu.parallel.mesh import (
+    pad_to_multiple,
+    place_global,
+    shard_map,
+)
 
 __all__ = [
     "distributed_client_active",
@@ -209,7 +213,7 @@ def _jitted_fm_hier(mesh: Mesh, month_axis: str, firm_axis: str,
         return cs_full, summary
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kernel,
             mesh=mesh,
             in_specs=(

@@ -60,6 +60,7 @@ from fm_returnprediction_tpu.parallel.mesh import (
     make_mesh,
     pad_to_multiple,
     place_global,
+    shard_map,
 )
 
 __all__ = [
@@ -141,7 +142,7 @@ def _jitted_rolling(mesh: Mesh, axis_name: str, window: int, stat: str,
         (P(axis_name, None),) * 3 if stat == "moments" else P(axis_name, None)
     )
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kernel, mesh=mesh, in_specs=P(axis_name, None), out_specs=out_specs
         )
     )
@@ -236,7 +237,7 @@ def _jitted_beta(mesh: Mesh, axis_name: str, n_weeks: int, n_months: int,
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kernel,
             mesh=mesh,
             in_specs=(

@@ -59,9 +59,27 @@ __all__ = [
     "build_panel",
     "build_panel_prepared",
     "load_or_build_panel",
+    "panel_route",
     "resolve_dtype",
     "run_pipeline",
 ]
+
+
+def panel_route() -> str:
+    """The ingest route for real-data panel builds: ``"columnar"`` (default;
+    chunked Arrow reads + vectorized numpy joins, ``panel.columnar``) or
+    ``"legacy"`` (pandas frames + relational merges). Resolved live from
+    ``FMRP_PANEL_ROUTE`` so tests and benches can flip routes per call;
+    the two routes are differentially pinned to identical panels
+    (``tests/test_panel_columnar.py``)."""
+    import os
+
+    route = os.environ.get("FMRP_PANEL_ROUTE", "columnar").strip().lower()
+    if route not in ("columnar", "legacy"):
+        raise ValueError(
+            f"FMRP_PANEL_ROUTE must be 'columnar' or 'legacy', got {route!r}"
+        )
+    return route
 
 
 def resolve_dtype() -> np.dtype:
@@ -285,12 +303,50 @@ def load_or_build_panel(
             )
             stage_sync(panel.values)
         return panel, factors_dict
-    with timer.stage("load_raw_data"):
-        data = load_raw_data(raw_data_dir)
     import jax
 
     write_prepared = prepared_dir is not None and jax.process_index() == 0
     capture = {} if write_prepared else None
+    route = panel_route()
+    data = None
+    if route == "columnar":
+        from fm_returnprediction_tpu.data.columnar import ColumnarIngestError
+        from fm_returnprediction_tpu.panel.columnar import build_panel_columnar
+
+        # the raw reads stream INSIDE build_panel (chunked, filtered at the
+        # batch level), so there is no separate load_raw_data stage — an
+        # explicit skip marker, not an absent key, keeps the bench's
+        # per-stage breakdown honest
+        timer.mark_skipped(
+            "load_raw_data", "columnar route streams raw parquet in-stage"
+        )
+        try:
+            with timer.stage("build_panel"):
+                panel, factors_dict = build_panel_columnar(
+                    raw_data_dir, dtype=dtype, mesh=mesh, timer=timer,
+                    include_turnover=include_turnover, capture=capture,
+                )
+                stage_sync(panel.values)
+                if write_prepared:
+                    with timer.stage("build_panel/save_prepared"):
+                        save_prepared(prepared_dir, fingerprint,
+                                      capture["dense_base"],
+                                      capture["compact_daily"])
+            del capture
+            return panel, factors_dict
+        except ColumnarIngestError as exc:
+            # a cache layout the columnar reader cannot service (csv/zip
+            # cache, pre-CIZ columns) degrades to the legacy route rather
+            # than failing a run the pandas path could complete
+            import warnings
+
+            warnings.warn(
+                f"columnar panel route unavailable ({exc}); "
+                "falling back to the legacy pandas ingest",
+                stacklevel=2,
+            )
+    with timer.stage("load_raw_data"):
+        data = load_raw_data(raw_data_dir)
     with timer.stage("build_panel"):
         panel, factors_dict = build_panel(
             data, dtype=dtype, mesh=mesh, timer=timer,

@@ -1,0 +1,309 @@
+"""Columnar-vs-legacy panel route differentials.
+
+The columnar ingest (``panel.columnar`` over ``data.columnar``) replaces
+the pandas relational chain with numpy searchsorted/gather joins over
+chunked Arrow reads. Its contract is EXACT equality with the legacy route
+wherever the legacy path is exact — pinned here at every level:
+
+- the dense BASE panel (values, mask, month/firm vocabularies) bit-equal;
+- the enriched characteristic panel bit-equal (both routes share the same
+  fused device program, so host ingest is the only possible divergence);
+- Table 1/2, the figure sweep cross-sections, the decile table and the
+  serving-state artifacts bit-equal through ``run_pipeline``;
+- edge cases: thin months (< 5 valid rows, the winsorize skip path) and
+  an all-NaN fundamental column survive both routes identically;
+- the ``FMRP_PANEL_ROUTE`` knob selects routes, rejects junk, and a
+  ``ColumnarIngestError`` falls back to legacy with a warning;
+- the prepared-inputs checkpoint v3 (columnar mmap payloads) round-trips
+  under full-hash verification and detects payload corruption.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.data.synthetic import (
+    FILE_NAMES,
+    SyntheticConfig,
+    write_synthetic_cache,
+)
+from fm_returnprediction_tpu.panel.columnar import build_panel_columnar
+from fm_returnprediction_tpu.pipeline import (
+    build_panel,
+    load_or_build_panel,
+    load_raw_data,
+    panel_route,
+    run_pipeline,
+)
+
+CFG = SyntheticConfig(n_firms=40, n_months=60)
+
+
+@pytest.fixture(scope="module")
+def raw_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("raw_columnar")
+    write_synthetic_cache(d, CFG)
+    return d
+
+
+def _assert_panels_equal(a, b):
+    assert a.var_names == b.var_names
+    np.testing.assert_array_equal(np.asarray(a.months), np.asarray(b.months))
+    np.testing.assert_array_equal(np.asarray(a.ids), np.asarray(b.ids))
+    np.testing.assert_array_equal(np.asarray(a.mask), np.asarray(b.mask))
+    va, vb = np.asarray(a.values), np.asarray(b.values)
+    assert va.shape == vb.shape
+    assert np.array_equal(va, vb, equal_nan=True), (
+        "panel values differ between routes"
+    )
+
+
+def _routes_panels(raw, dtype=np.float64):
+    legacy, f_l = build_panel(load_raw_data(raw), dtype=dtype)
+    columnar, f_c = build_panel_columnar(raw, dtype=dtype)
+    assert f_l == f_c
+    return legacy, columnar
+
+
+def test_enriched_panel_bit_equal(raw_dir):
+    legacy, columnar = _routes_panels(raw_dir)
+    _assert_panels_equal(legacy, columnar)
+
+
+def test_compact_daily_bit_equal(raw_dir):
+    """The chunked filtered daily ingest lands on the same CSR strips as
+    the pandas filter + frame path."""
+    import dataclasses
+
+    from fm_returnprediction_tpu.data.wrds_pull import (
+        subset_to_common_stock_and_exchanges,
+    )
+    from fm_returnprediction_tpu.panel.columnar import (
+        ingest_compact_daily_columnar,
+    )
+    from fm_returnprediction_tpu.panel.daily import build_compact_daily
+
+    data = load_raw_data(raw_dir)
+    crsp_d = subset_to_common_stock_and_exchanges(
+        data["crsp_d"], columns=["permno", "dlycaldt", "retx"]
+    )
+    months = np.unique(data["crsp_m"]["jdate"].to_numpy())
+    cd_l = build_compact_daily(crsp_d, data["crsp_index_d"], months)
+    cd_c = ingest_compact_daily_columnar(raw_dir, months)
+    for field in dataclasses.fields(cd_l):
+        a, b = getattr(cd_l, field.name), getattr(cd_c, field.name)
+        if isinstance(a, np.ndarray):
+            if a.dtype.kind == "M":
+                a, b = a.astype("datetime64[s]"), b.astype("datetime64[s]")
+            np.testing.assert_array_equal(a, b, err_msg=field.name)
+        else:
+            assert a == b, field.name
+
+
+def test_thin_month_edge_case(tmp_path):
+    """A universe small enough that months fall under the winsorize
+    min_obs=5 skip threshold: both routes agree bit-for-bit."""
+    raw = tmp_path / "thin"
+    write_synthetic_cache(raw, SyntheticConfig(n_firms=6, n_months=30))
+    legacy, columnar = _routes_panels(raw)
+    _assert_panels_equal(legacy, columnar)
+
+
+def test_all_nan_column_edge_case(tmp_path):
+    """An all-NaN fundamental column (every dvc null → dy all-NaN) flows
+    through ingest, winsorize and assembly identically on both routes."""
+    raw = tmp_path / "nan_col"
+    write_synthetic_cache(raw, SyntheticConfig(n_firms=25, n_months=36))
+    comp_path = raw / FILE_NAMES["comp"]
+    comp = pd.read_parquet(comp_path)
+    comp["dvc"] = np.nan
+    comp.to_parquet(comp_path, index=False)
+    legacy, columnar = _routes_panels(raw)
+    assert np.isnan(np.asarray(legacy.var("dy"))).all()
+    _assert_panels_equal(legacy, columnar)
+
+
+def test_multilink_and_multisecurity_edge_cases(tmp_path):
+    """The join semantics the base fixture does not reach: (a) a permno
+    with SEVERAL valid CCM links — the legacy route emits one merged row
+    per link and `long_to_dense` keeps the last (the largest gvkey), which
+    the columnar join must pick directly, in both directions (extra link
+    above AND below the original gvkey); (b) four securities per
+    (permco, jdate) incl. an exact security-ME tie — exercises the Kahan
+    group sum beyond the 2-element case (where it degenerates to naive
+    addition) and the min-permno tie-break."""
+    raw = tmp_path / "links"
+    write_synthetic_cache(raw, SyntheticConfig(n_firms=30, n_months=36))
+
+    ccm_path = raw / FILE_NAMES["ccm"]
+    ccm = pd.read_parquet(ccm_path)
+    ccm = ccm.sort_values("gvkey").reset_index(drop=True)
+    wide_lo = ccm.iloc[[0]].assign(permno=ccm["permno"].iloc[-1])
+    wide_hi = ccm.iloc[[-1]].assign(permno=ccm["permno"].iloc[0])
+    for extra in (wide_lo, wide_hi):
+        extra["linkdt"] = pd.Timestamp("1960-01-31")
+        extra["linkenddt"] = pd.NaT  # open link: valid through today
+    pd.concat([ccm, wide_lo, wide_hi]).to_parquet(ccm_path, index=False)
+
+    m_path = raw / FILE_NAMES["crsp_m"]
+    m = pd.read_parquet(m_path)
+    victim_permco = m["permco"].iloc[0]
+    block = m[m["permco"] == victim_permco]
+    clones = []
+    for i, scale in enumerate((0.31, 0.57, 1.0)):  # last: exact ME tie
+        c = block.copy()
+        c["permno"] = c["permno"] + 90_000 + i
+        c["prc"] = c["prc"] * scale
+        clones.append(c)
+    pd.concat([m, *clones]).to_parquet(m_path, index=False)
+
+    legacy, columnar = _routes_panels(raw)
+    _assert_panels_equal(legacy, columnar)
+
+
+def _pipeline_artifacts(raw, route, monkeypatch):
+    from fm_returnprediction_tpu import settings
+
+    monkeypatch.setitem(settings.d, "PREPARED_CACHE", 0)
+    monkeypatch.setenv("FMRP_PANEL_ROUTE", route)
+    return run_pipeline(raw_data_dir=raw, make_figure=True,
+                        make_deciles=True, compile_pdf=False)
+
+
+def test_pipeline_artifacts_agree_across_routes(raw_dir, monkeypatch):
+    """Table 1/2, decile table, figure cross-sections and the serving
+    state are bit-identical between routes (the panels are, and every
+    downstream stage is a deterministic function of the panel)."""
+    res_l = _pipeline_artifacts(raw_dir, "legacy", monkeypatch)
+    res_c = _pipeline_artifacts(raw_dir, "columnar", monkeypatch)
+
+    # route evidence: legacy records load_raw_data, columnar streams
+    assert "load_raw_data" in res_l.timer.durations
+    assert "panel/monthly_ingest" in res_c.timer.durations
+    assert "load_raw_data" not in res_c.timer.durations
+
+    _assert_panels_equal(res_l.panel, res_c.panel)
+    assert res_l.table_1.to_string() == res_c.table_1.to_string()
+    assert res_l.table_2.to_string() == res_c.table_2.to_string()
+    assert res_l.decile_table.to_string() == res_c.decile_table.to_string()
+
+    s_l, s_c = res_l.serving_state, res_c.serving_state
+    assert s_l is not None and s_c is not None
+    np.testing.assert_array_equal(np.asarray(s_l.coef), np.asarray(s_c.coef))
+    np.testing.assert_array_equal(
+        np.asarray(s_l.slopes_bar), np.asarray(s_c.slopes_bar)
+    )
+    np.testing.assert_array_equal(np.asarray(s_l.gram), np.asarray(s_c.gram))
+    np.testing.assert_array_equal(
+        np.asarray(s_l.n_obs), np.asarray(s_c.n_obs)
+    )
+
+    # the figure sweep rides the same cross-sections both times
+    from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
+
+    cs_l = subset_sweep(res_l.panel, res_l.subset_masks, ["All stocks"])
+    cs_c = subset_sweep(res_c.panel, res_c.subset_masks, ["All stocks"])
+    np.testing.assert_array_equal(
+        np.asarray(cs_l["All stocks"].cs.slopes),
+        np.asarray(cs_c["All stocks"].cs.slopes),
+    )
+
+
+def test_route_knob_validation(monkeypatch):
+    monkeypatch.setenv("FMRP_PANEL_ROUTE", "columnar")
+    assert panel_route() == "columnar"
+    monkeypatch.setenv("FMRP_PANEL_ROUTE", "legacy")
+    assert panel_route() == "legacy"
+    monkeypatch.delenv("FMRP_PANEL_ROUTE")
+    assert panel_route() == "columnar"  # the default route
+    monkeypatch.setenv("FMRP_PANEL_ROUTE", "parquet-ish")
+    with pytest.raises(ValueError, match="FMRP_PANEL_ROUTE"):
+        panel_route()
+
+
+def test_columnar_failure_falls_back_to_legacy(raw_dir, monkeypatch):
+    """A cache the columnar reader cannot service degrades to the legacy
+    route with a warning instead of failing the run."""
+    from fm_returnprediction_tpu import settings
+    from fm_returnprediction_tpu.data.columnar import ColumnarIngestError
+    from fm_returnprediction_tpu.panel import columnar as pcol
+
+    monkeypatch.setitem(settings.d, "PREPARED_CACHE", 0)
+
+    def boom(*a, **k):
+        raise ColumnarIngestError("synthetic unserviceable cache")
+
+    monkeypatch.setattr(pcol, "build_dense_base_columnar", boom)
+    with pytest.warns(UserWarning, match="falling back to the legacy"):
+        panel, factors = load_or_build_panel(raw_dir, dtype=np.float64)
+    assert "rolling_std_252" in panel.var_names
+
+
+def test_missing_column_is_typed_ingest_error(tmp_path):
+    """A monthly cache lacking a filter column raises the typed fallback
+    signal, not a KeyError deep in numpy."""
+    from fm_returnprediction_tpu.data.columnar import ColumnarIngestError
+
+    raw = tmp_path / "nocol"
+    write_synthetic_cache(raw, SyntheticConfig(n_firms=10, n_months=12))
+    m_path = raw / FILE_NAMES["crsp_m"]
+    m = pd.read_parquet(m_path).drop(columns=["sharetype"])
+    m.to_parquet(m_path, index=False)
+    with pytest.raises(ColumnarIngestError, match="sharetype"):
+        build_panel_columnar(raw, dtype=np.float64)
+
+
+def test_panel_program_no_retrace_on_warm_repeat(raw_dir):
+    """The fused characteristics+winsorize program compiles once per
+    shape/config — a warm repeat of the panel build must not re-trace."""
+    from fm_returnprediction_tpu.panel import characteristics as ch
+
+    build_panel_columnar(raw_dir, dtype=np.float64)
+    before = ch.TRACES["panel_characteristics"]
+    build_panel_columnar(raw_dir, dtype=np.float64)
+    assert ch.TRACES["panel_characteristics"] == before
+
+
+def test_prepared_v3_verify_and_corruption(raw_dir, tmp_path, monkeypatch):
+    """v3 columnar checkpoint: mmap load passes full-hash verification;
+    flipped payload bytes surface as a rebuild (miss + warning) when
+    verification is armed."""
+    from fm_returnprediction_tpu.data.prepared import (
+        load_prepared,
+        raw_fingerprint,
+        save_prepared,
+    )
+
+    capture = {}
+    build_panel(load_raw_data(raw_dir), capture=capture)
+    fp = raw_fingerprint(raw_dir, np.float64)
+    save_prepared(tmp_path, fp, capture["dense_base"],
+                  capture["compact_daily"])
+
+    monkeypatch.setenv("FMRP_PREPARED_VERIFY", "1")
+    got = load_prepared(tmp_path, fp)
+    assert got is not None
+    base, cd = got
+    # mmap'd payloads: zero-copy views over the files
+    assert isinstance(base.values, np.memmap)
+    assert isinstance(cd.row_values, np.memmap)
+    np.testing.assert_array_equal(
+        np.asarray(base.values), np.asarray(capture["dense_base"].values)
+    )
+
+    victim = tmp_path / "base.values.npy"
+    raw_bytes = bytearray(victim.read_bytes())
+    raw_bytes[-8] ^= 0xFF  # flip a payload byte, size unchanged
+    victim.write_bytes(bytes(raw_bytes))
+    with pytest.warns(UserWarning, match="sha256"):
+        assert load_prepared(tmp_path, fp) is None
+
+    # without verification the size check alone cannot see the bit-flip,
+    # but a TRUNCATED payload is still caught structurally
+    monkeypatch.setenv("FMRP_PREPARED_VERIFY", "0")
+    victim.write_bytes(bytes(raw_bytes[:-16]))
+    with pytest.warns(UserWarning, match="bytes"):
+        assert load_prepared(tmp_path, fp) is None

@@ -93,21 +93,23 @@ def test_roundtrip_and_corruption(raw_dir, tmp_path):
 
     # valid meta + missing payload (a torn checkpoint) → miss with a
     # warning, never an exception
-    (tmp_path / "compact_daily.npz").unlink()
+    (tmp_path / "daily.row_values.npy").unlink()
     with pytest.warns(UserWarning, match="unreadable"):
         assert load_prepared(tmp_path, fp) is None
 
 
-def test_v1_checkpoint_upgrade(raw_dir, tmp_path):
-    """A v1-layout slot (older meta version + the merged-frame payload) is
-    a clean miss, and the next save removes the orphaned v1 payload."""
+def test_old_layout_checkpoint_upgrade(raw_dir, tmp_path):
+    """An older-layout slot (v1 merged frame / v2 npz bundles) is a clean
+    miss, and the next save removes the orphaned payloads."""
     from fm_returnprediction_tpu.pipeline import build_panel, load_raw_data
 
     v1_payload = tmp_path / "monthly_merged.parquet"
     v1_payload.write_bytes(b"stale v1 payload")
+    v2_payload = tmp_path / "dense_base.npz"
+    v2_payload.write_bytes(b"stale v2 payload")
     fp = raw_fingerprint(raw_dir, np.float64)
     (tmp_path / "meta.json").write_text(
-        json.dumps({"fingerprint": fp, "version": 1})
+        json.dumps({"fingerprint": fp, "version": 2})
     )
     assert load_prepared(tmp_path, fp) is None  # version mismatch → miss
 
@@ -116,6 +118,7 @@ def test_v1_checkpoint_upgrade(raw_dir, tmp_path):
     save_prepared(tmp_path, fp, capture["dense_base"],
                   capture["compact_daily"])
     assert not v1_payload.exists()
+    assert not v2_payload.exists()
     assert load_prepared(tmp_path, fp) is not None
 
 
@@ -123,16 +126,26 @@ def _tables(res):
     return res.table_1.to_string() + res.table_2.to_string()
 
 
+def _ingested_raw(timer) -> bool:
+    """Did the run ingest from raw parquet (either route)? The columnar
+    route streams the reads inside ``panel/monthly_ingest``; the legacy
+    route records ``load_raw_data``."""
+    return ("load_raw_data" in timer.durations
+            or "panel/monthly_ingest" in timer.durations)
+
+
 def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     cold = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                         make_deciles=False, compile_pdf=False)
     assert "build_panel/save_prepared" in cold.timer.durations
+    assert _ingested_raw(cold.timer)
     assert (raw_dir / PREPARED_DIRNAME / "meta.json").exists()
 
     warm = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                         make_deciles=False, compile_pdf=False)
     assert "load_prepared" in warm.timer.durations
     for skipped in ("load_raw_data", "panel/universe_filter",
+                    "panel/monthly_ingest",
                     "panel/market_equity", "panel/ccm_merge",
                     "factors/daily_ingest", "factors/long_to_dense",
                     "build_panel/save_prepared"):
@@ -140,7 +153,7 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     # the short-circuited raw ingest is an EXPLICIT skip with a reason —
     # not a 0.0 that reads as "free" in the per-stage breakdowns
     assert warm.timer.skipped["load_raw_data"] == "prepared checkpoint hit"
-    assert "load_raw_data" not in cold.timer.skipped
+    assert cold.timer.skipped.get("load_raw_data") != "prepared checkpoint hit"
     assert _tables(warm) == _tables(cold)  # bit-identical reporting
 
     # staleness: re-pulling a raw file invalidates the checkpoint
@@ -150,7 +163,7 @@ def test_pipeline_warm_run_uses_checkpoint(raw_dir):
     try:
         rebuilt = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                                make_deciles=False, compile_pdf=False)
-        assert "load_raw_data" in rebuilt.timer.durations
+        assert _ingested_raw(rebuilt.timer)
         assert "build_panel/save_prepared" in rebuilt.timer.durations
         assert _tables(rebuilt) == _tables(cold)
     finally:
@@ -163,6 +176,6 @@ def test_prepared_cache_setting_disables(raw_dir, monkeypatch):
     monkeypatch.setitem(settings.d, "PREPARED_CACHE", 0)
     res = run_pipeline(raw_data_dir=raw_dir, make_figure=False,
                        make_deciles=False, compile_pdf=False)
-    assert "load_raw_data" in res.timer.durations
+    assert _ingested_raw(res.timer)
     assert "load_prepared" not in res.timer.durations
     assert "build_panel/save_prepared" not in res.timer.durations
