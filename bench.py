@@ -28,6 +28,11 @@ over-claim without (round-1 VERDICT "What's weak" #1-2):
   3×3 grid from Gram sufficient statistics (one fused program) vs the
   per-cell batched-QR route, with compiled-program/referee counts and the
   Gram-vs-stacked footprint estimates.
+- ``specgrid_scale_*``       — the pod-scale tile engine: a 1e3→1e5
+  cell-count ladder through the lazy CellSpace tiling and the streaming
+  top-k sink, ``cells_per_s`` per rung (higher-is-better series), warm
+  repeats under ``recompile_watch``, and the tracemalloc peak vs the
+  one-tile memory bound.
 
 All timings synchronize by pulling a result to the host (``np.asarray``
 or a scalar device-side reduction), not ``block_until_ready`` alone — on
@@ -846,6 +851,123 @@ def _bench_specgrid(fast: bool):
     }
 
 
+def _bench_specgrid_scale(fast: bool):
+    """Pod-scale spec-grid: a CELL-COUNT LADDER through the lazy tile
+    engine (``specgrid.cellspace``/``specgrid.engine``) and the streaming
+    top-k sink — the ISSUE-8 acceptance evidence that a 1e5-cell scenario
+    sweep completes on this box with peak incremental host memory bounded
+    by one tile. Each rung scales the bootstrap-draw dimension over a
+    fixed 432-spec product (48 predictor subsets × 3 universes × 3
+    windows), so the ladder spans both regimes: solve-dominated (few
+    draws) and aggregation-dominated (many draws). Per rung: cold sweep,
+    then a warm repeat under ``recompile_watch`` (a warm re-sweep must
+    reuse the tile program — any growth lands in
+    ``fmrp_unexpected_recompiles_total``), ``cells_per_s`` from the warm
+    wall (a higher-is-better series for the PR-6 regression sentinel),
+    tracemalloc peak across the warm sweep, and the one-tile byte
+    estimate it is bounded against. FMRP_BENCH_SPECGRID_SCALE=0 skips."""
+    if os.environ.get("FMRP_BENCH_SPECGRID_SCALE", "1") == "0":
+        return {}
+    import tracemalloc
+
+    from fm_returnprediction_tpu.specgrid import (
+        CellSpace,
+        TopKSink,
+        run_cellspace,
+    )
+    from fm_returnprediction_tpu.specgrid.cellspace import resolve_tile_cells
+    from fm_returnprediction_tpu.telemetry import recompile_watch
+
+    t = int(os.environ.get("FMRP_BENCH_SPECGRID_SCALE_MONTHS", 60))
+    n = int(os.environ.get("FMRP_BENCH_SPECGRID_SCALE_FIRMS", 400))
+    p = 8
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    # 48 deterministic predictor subsets; the FIRST is the full set so the
+    # space's union order equals the panel's column order
+    rng = np.random.default_rng(2014)
+    sets = [("s00_full", tuple(names))]
+    while len(sets) < 48:
+        k = 2 + (len(sets) % (p - 2))
+        cols = np.sort(rng.choice(p, size=k, replace=False))
+        sets.append((f"s{len(sets):02d}_{k}", tuple(names[c] for c in cols)))
+    windows = (("full", None), ("half1", (0, t // 2)), ("half2", (t // 2, t)))
+
+    ladder = [1_000, 10_000] if fast else [1_000, 10_000, 100_000]
+    ladder = [int(c) for c in os.environ.get(
+        "FMRP_BENCH_SPECGRID_SCALE_CELLS", ""
+    ).split(",") if c] or ladder
+    base = len(sets) * len(masks) * len(windows)
+    tile = resolve_tile_cells(None)
+    out = {"specgrid_scale_shape": f"T{t}_N{n}_P{p}_S{base}",
+           "specgrid_scale_tile_cells": tile,
+           "specgrid_scale_ladder": {}}
+    import math as _math
+
+    for target in ladder:
+        draws = max(1, _math.ceil(target / base))
+        space = CellSpace(
+            regressor_sets=tuple(sets), universes=tuple(masks),
+            windows=windows, bootstrap=draws,
+        )
+        label = f"{target:.0e}".replace("e+0", "e")
+        if label in out["specgrid_scale_ladder"]:
+            # env-configured targets can collide at one significant digit
+            # (120000 and 140000 are both "1e5") — fall back to the exact
+            # count rather than silently overwriting a rung
+            label = str(target)
+        with _timed(f"bench.specgrid_scale_{label}_cold") as cold_t:
+            _, cold_stats = run_cellspace(
+                y, x, masks, space, sink=TopKSink(k=64), mask=masks["All"],
+            )
+        # timing pass: warm repeat under the recompile sentinel ONLY —
+        # tracemalloc hooks every allocation and has been measured to
+        # double this sweep's wall, so the memory pass runs separately
+        with recompile_watch(f"specgrid_scale_{label}", warm=True) as delta:
+            with _timed(f"bench.specgrid_scale_{label}_warm") as warm_t:
+                frame, stats = run_cellspace(
+                    y, x, masks, space, sink=TopKSink(k=64),
+                    mask=masks["All"],
+                )
+        # memory pass: same sweep under tracemalloc; only the peak is read
+        tracemalloc.start()
+        run_cellspace(y, x, masks, space, sink=TopKSink(k=64),
+                      mask=masks["All"])
+        _, peak_bytes = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # the bound: one tile's Gram stats + one tile's result rows — at
+        # the ENGINE's effective (draw-aligned) tile width, not the knob
+        q = p + 1
+        eff_tile = stats["tile_cells"]
+        tile_mb = (
+            stats["spec_pad"] * t * q * q * x.dtype.itemsize  # Gram stats
+            + eff_tile * (p + 1) * 200                        # frame rows
+        ) / 2**20
+        rung = {
+            "cells": len(space),
+            "draws": draws,
+            "tile_cells": eff_tile,
+            "cold_s": round(cold_t.s, 4),
+            "warm_s": round(warm_t.s, 4),
+            "cells_per_s": round(len(space) / warm_t.s, 1),
+            "tiles": stats["tiles"],
+            "spec_pad": stats["spec_pad"],
+            "topk_rows": len(frame),
+            "peak_host_mb": round(peak_bytes / 2**20, 2),
+            "tile_bound_mb": round(tile_mb, 2),
+            "warm_cache_growth": delta.grew if delta is not None else None,
+        }
+        out["specgrid_scale_ladder"][label] = rung
+        top = rung  # the last (largest) rung feeds the flat gated series
+    # flat leaves = the gated series; the nested ladder is attribution
+    out["specgrid_scale_cells_per_s"] = top["cells_per_s"]
+    out["specgrid_scale_peak_host_mb"] = top["peak_host_mb"]
+    out["specgrid_scale_tile_bound_mb"] = top["tile_bound_mb"]
+    out["specgrid_scale_cells"] = top["cells"]
+    return out
+
+
 def _bench_serving(fast: bool):
     """Warm microbatched serving path on a synthetic state (the online
     E[r] query service, ``fm_returnprediction_tpu/serving``): build a
@@ -1224,6 +1346,52 @@ def _bench_mesh8(fast: bool):
     return _mesh8_child_run(real_shape=False)
 
 
+def _mesh8_specgrid_probe():
+    """Sharded-vs-single-device spec-grid ladder — runs INSIDE the mesh8
+    child (8 virtual CPU devices). The PR-7 ``shard_map`` shim un-broke
+    this path (BENCH_r03-r05 disclosed its AttributeError); this probe is
+    the re-verification artifact: a real sharded solve through the
+    declarative ``parallel.partition`` rules, its wall against the
+    single-device route at the same small shape, and the route
+    differential. Called by ``_mesh8_child_run``'s child script."""
+    import jax
+
+    from fm_returnprediction_tpu import specgrid
+
+    t = int(os.environ.get("FMRP_BENCH_MESH8_SPECGRID_MONTHS", 120))
+    n = int(os.environ.get("FMRP_BENCH_MESH8_SPECGRID_FIRMS", 2048))
+    p = 8
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    grid = specgrid.SpecGrid(tuple(
+        specgrid.Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in (3, 8) for u in masks
+    ))
+    n_dev = len(jax.devices())
+    mesh = specgrid.specgrid_mesh(n_dev)
+    with _timed("bench.mesh8_specgrid_single_cold"):
+        res_single = specgrid.run_spec_grid(y, x, masks, grid)
+    with _timed("bench.mesh8_specgrid_single_warm") as single_t:
+        res_single = specgrid.run_spec_grid(y, x, masks, grid)
+    with _timed("bench.mesh8_specgrid_sharded_cold") as shard_cold_t:
+        res_shard = specgrid.run_spec_grid(y, x, masks, grid, mesh=mesh)
+    with _timed("bench.mesh8_specgrid_sharded_warm") as shard_t:
+        res_shard = specgrid.run_spec_grid(y, x, masks, grid, mesh=mesh)
+    a, b = res_single.coef, res_shard.coef
+    both_nan = np.isnan(a) & np.isnan(b)
+    diff = float(np.max(np.abs(np.where(both_nan, 0.0, a)
+                               - np.where(both_nan, 0.0, b))))
+    return {
+        "devices": n_dev,
+        "shape": f"T{t}_N{n}_S{len(grid)}",
+        "single_warm_s": round(single_t.s, 4),
+        "sharded_cold_s": round(shard_cold_t.s, 4),
+        "sharded_warm_s": round(shard_t.s, 4),
+        "max_coef_diff": diff,
+    }
+
+
 def _mesh8_child_run(real_shape: bool):
     import subprocess
     import sys
@@ -1239,7 +1407,9 @@ def _mesh8_child_run(real_shape: bool):
         child = (
             "import json, sys, bench\n"
             "wall, stages = bench._run_pipeline_timed(sys.argv[1])\n"
-            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+            "probe = bench._mesh8_specgrid_probe()\n"
+            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages,"
+            " 'specgrid': probe}))\n"
         )
         argv = [sys.executable, "-c", child, raw_dir]
     else:
@@ -1255,7 +1425,9 @@ def _mesh8_child_run(real_shape: bool):
             "    write_synthetic_cache(raw, SyntheticConfig(\n"
             "        n_firms=n, n_months=t))\n"
             "    wall, stages = bench._run_pipeline_timed(raw)\n"
-            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages}))\n"
+            "probe = bench._mesh8_specgrid_probe()\n"
+            "print('MESH8 ' + json.dumps({'wall': wall, 'stages': stages,"
+            " 'specgrid': probe}))\n"
         )
         argv = [sys.executable, "-c", child, str(t), str(n)]
 
@@ -1289,13 +1461,18 @@ def _mesh8_child_run(real_shape: bool):
     if proc.returncode != 0 or not lines:
         return {"mesh8_error": (stderr or stdout)[-300:]}
     got = json.loads(lines[-1][len("MESH8 "):])
-    return {
+    out = {
         "mesh8_pipeline_wall_s": round(got["wall"], 4),
         "mesh8_pipeline_stage_s": _round_stages(got["stages"]),
         "mesh8_shape": f"T{t}_N{n}",
         "mesh8_scale": "real" if real_shape else "small",
         "mesh8_device": "cpu-virtual-8",
     }
+    # the sharded spec-grid ladder the child probed (the re-verification
+    # of the path PR 7's shard_map shim un-broke)
+    for k, v in got.get("specgrid", {}).items():
+        out[f"mesh8_specgrid_{k}"] = v
+    return out
 
 
 def _cpu_fallback_possible(timeout_s: int) -> bool:
@@ -1516,6 +1693,7 @@ def main() -> None:
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
+    sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_obs)  # _OBS=0 handled in-section

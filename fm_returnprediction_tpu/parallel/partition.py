@@ -1,0 +1,173 @@
+"""Declarative partition rules — regex → ``PartitionSpec``, in one table.
+
+Every sharded program so far threaded its ``PartitionSpec``s by hand at the
+call site (``fm_sharded.monthly_cs_ols_sharded`` builds its ``in_specs``
+tuple inline, ``shard_panel`` hard-codes three specs). That scales to three
+arrays; the pod-scale spec-grid path shards a *pytree* of panel inputs and
+an (S, T, Q, Q) sufficient-statistics tree along two different axes, and
+hand-threading specs per call site is exactly how layouts drift apart.
+
+This module adopts the ``match_partition_rules`` shape from SNIPPETS.md [2]
+(the fmengine/EasyLM idiom used to shard transformer TrainStates): a rule
+table of ``(regex, PartitionSpec)`` pairs is matched against the '/'-joined
+tree path of every leaf, scalars are never partitioned, and an unmatched
+leaf is an ERROR — a new tensor added to a sharded program must be placed
+deliberately, not silently replicated.
+
+Two rule tables ship here and are the single source of truth for the
+spec-grid mesh path (``specgrid.sharded``):
+
+- ``SPECGRID_PANEL_RULES``  — the contraction side: the dense panel shards
+  over FIRMS (the axis with proven Gram additivity, ``tests/test_specgrid``),
+  tiny per-spec index/selector arrays replicate.
+- ``SPECGRID_STATS_RULES``  — the solve side: the (S, T, Q, Q) Gram stats
+  and everything downstream of them shard over the SPEC (cell) axis — the
+  solve is vmapped per spec, so the partition is communication-free.
+
+Both tables use one mesh axis (default name ``"cells"``): the two stages
+run sequentially, so the same devices carry firms during contraction and
+cells during the solve — the same axis-reuse discipline as ``mesh.py``'s
+firms/boot note.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import jax
+
+__all__ = [
+    "match_partition_rules",
+    "named_tree_paths",
+    "tree_shardings",
+    "SPECGRID_PANEL_RULES",
+    "SPECGRID_STATS_RULES",
+    "specgrid_axis",
+    "specgrid_panel_rules",
+    "specgrid_stats_rules",
+]
+
+#: the one mesh-axis name of the spec-grid path (firms during contraction,
+#: cells during the solve — sequential stages reuse the same devices)
+SPECGRID_AXIS = "cells"
+
+
+def specgrid_axis() -> str:
+    """The spec-grid mesh axis name (one definition, no string literals
+    scattered across call sites)."""
+    return SPECGRID_AXIS
+
+
+def named_tree_paths(tree: Any, sep: str = "/"):
+    """``[(path, leaf), ...]`` with dict keys / NamedTuple fields /
+    sequence indices joined by ``sep`` — the names the rule regexes see."""
+    out = []
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+        elif hasattr(node, "_fields"):  # NamedTuple
+            for k, v in zip(node._fields, node):
+                walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(f"{prefix}{sep}{i}" if prefix else str(i), v)
+        else:
+            out.append((prefix, node))
+
+    walk("", tree)
+    return out
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree: Any):
+    """Map a pytree of arrays to a same-structure pytree of PartitionSpecs.
+
+    Each leaf's '/'-joined tree path is matched against ``rules`` in order
+    (``re.search``, first hit wins — SNIPPETS.md [2]); scalar leaves get
+    ``P()`` without consulting the table; a leaf no rule matches raises —
+    silent replication of a new tensor is how sharded programs rot.
+    """
+
+    def get_spec(name: str, leaf: Any) -> P:
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()  # never partition scalars
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"partition rule not found for leaf: {name!r}")
+
+    # rebuild the tree shape with the SAME walker that names the leaves —
+    # round-tripping through jax treedefs would reorder dict keys (they
+    # flatten sorted) out from under the insertion-ordered names
+    def rebuild(prefix: str, node: Any):
+        if isinstance(node, dict):
+            return {
+                k: rebuild(f"{prefix}/{k}" if prefix else str(k), v)
+                for k, v in node.items()
+            }
+        if hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(
+                rebuild(f"{prefix}/{k}" if prefix else str(k), v)
+                for k, v in zip(node._fields, node)
+            ))
+        if isinstance(node, (list, tuple)):
+            vals = [
+                rebuild(f"{prefix}/{i}" if prefix else str(i), v)
+                for i, v in enumerate(node)
+            ]
+            return type(node)(vals) if isinstance(node, list) else tuple(vals)
+        return get_spec(prefix, node)
+
+    return rebuild("", tree)
+
+
+def tree_shardings(mesh: Mesh, rules: Sequence[Tuple[str, P]], tree: Any):
+    """``match_partition_rules`` with every spec wrapped in a
+    ``NamedSharding`` on ``mesh`` — the form ``jax.device_put`` and
+    ``jit(in_shardings=...)`` consume."""
+    specs = match_partition_rules(rules, tree)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+# -- the spec-grid tables ---------------------------------------------------
+
+
+def specgrid_panel_rules(axis: str = SPECGRID_AXIS) -> Tuple[Tuple[str, P], ...]:
+    """Contraction side: (T, N)-shaped panel tensors shard over firms on
+    axis 1, the (U, T, N) universe stack on axis 2; per-spec index/selector
+    arrays (uidx, col_sel, window, sel_aug) and the (T, P) center replicate
+    — they are KBs against the panel's GBs and every shard reads all of
+    them."""
+    return (
+        (r"(^|/)(y|mask|row_weights)$", P(None, axis)),
+        (r"(^|/)x$", P(None, axis, None)),
+        (r"(^|/)universes$", P(None, None, axis)),
+        (r"(^|/)(uidx|col_sel|window|sel_aug|center)$", P()),
+    )
+
+
+def specgrid_stats_rules(axis: str = SPECGRID_AXIS) -> Tuple[Tuple[str, P], ...]:
+    """Solve side: every leaf of ``SpecGramStats`` with a leading spec axis
+    (and the per-spec selectors) shards over cells — the solve is vmapped
+    per spec, so the partition is communication-free; the shared (T, P)
+    center replicates."""
+    return (
+        (r"(^|/)(gram|moment|n|ysum|yy)$", P(axis)),
+        (r"(^|/)(sel_aug|uidx|col_sel|window)$", P(axis)),
+        (r"(^|/)center$", P()),
+    )
+
+
+#: the default-axis instantiations, for callers/tests that read the tables
+SPECGRID_PANEL_RULES: Tuple[Tuple[str, P], ...] = specgrid_panel_rules()
+SPECGRID_STATS_RULES: Tuple[Tuple[str, P], ...] = specgrid_stats_rules()

@@ -379,6 +379,8 @@ def run_pipeline(
     make_bootstrap: bool = False,
     make_serving: bool = True,
     make_specgrid: bool = False,
+    specgrid_cells: Optional[int] = None,
+    specgrid_sink: Optional[str] = None,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
     checkpoint_dir=None,
@@ -391,6 +393,12 @@ def run_pipeline(
 
     ``dtype=None`` resolves the DTYPE setting (float32 on TPU by default;
     float64 requires jax_enable_x64 and is the CPU parity configuration).
+
+    ``make_specgrid`` runs the scenario sweep on the spec-grid tile engine;
+    ``specgrid_cells`` scales it to at least that many cells (the
+    bootstrap-draw dimension grows; cells stream tile by tile so memory
+    stays one-tile-bounded) and ``specgrid_sink`` picks the streaming
+    aggregation (``frame``/``topk``/``summary``/``parquet``).
 
     ``checkpoint_dir`` arms per-stage checkpoint-resume
     (``resilience.StageCheckpointer``): each reporting stage (Table 1,
@@ -454,6 +462,8 @@ def run_pipeline(
             make_bootstrap=make_bootstrap,
             make_serving=make_serving,
             make_specgrid=make_specgrid,
+            specgrid_cells=specgrid_cells,
+            specgrid_sink=specgrid_sink,
             bootstrap_replicates=bootstrap_replicates,
             use_mesh=use_mesh,
             checkpoint_dir=checkpoint_dir,
@@ -474,6 +484,8 @@ def _run_pipeline_guarded(
     make_bootstrap,
     make_serving,
     make_specgrid,
+    specgrid_cells,
+    specgrid_sink,
     bootstrap_replicates,
     use_mesh,
     checkpoint_dir,
@@ -723,15 +735,26 @@ def _run_pipeline_guarded(
     specgrid_scenarios = None
     if make_specgrid:
         from fm_returnprediction_tpu.specgrid import run_scenarios
+        from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
 
         with timer.stage("specgrid"):
             # subperiod halves × all three universes × all models on the
-            # Gram engine (one fused program per winsor/weight variant)
+            # tile engine: lazy cell enumeration, one fused program per
+            # tile batch, streamed through the configured sink
+            # (``--specgrid-cells`` scales the bootstrap-draw dimension;
+            # ``--specgrid-sink``/FMRP_SPECGRID_SINK picks the sink)
             specgrid_scenarios = _frame_stage(
                 "specgrid_scenarios",
-                lambda: run_scenarios(panel, subset_masks, factors_dict),
+                lambda: run_scenarios(
+                    panel, subset_masks, factors_dict,
+                    cells=specgrid_cells, sink=specgrid_sink,
+                    output_dir=output_dir,
+                ),
             )
-            if guard:
+            if guard and resolve_sink_name(specgrid_sink) == "frame":
+                # non-frame sinks (argument- OR env-selected) emit their
+                # own schema (leaderboard, moment table, part manifest) —
+                # the tidy-frame contract only applies to the full frame
                 specgrid_scenarios = _contracts.screen_artifact(
                     "specgrid_scenarios", specgrid_scenarios,
                     _contracts.frame_rules(
@@ -875,6 +898,19 @@ def _main() -> None:
              "specgrid_scenarios.csv",
     )
     parser.add_argument(
+        "--specgrid-cells", type=int, default=None, metavar="N",
+        help="scale the spec-grid sweep to at least N cells (the "
+             "bootstrap-draw dimension grows to cover it; cells stream "
+             "tile by tile so memory stays one-tile-bounded)",
+    )
+    parser.add_argument(
+        "--specgrid-sink", default=None,
+        choices=["frame", "topk", "summary", "parquet"],
+        help="spec-grid streaming sink: full tidy frame (default), "
+             "top-k-by-|tstat| leaderboard, running summary moments, or "
+             "parquet part spill (default follows FMRP_SPECGRID_SINK)",
+    )
+    parser.add_argument(
         "--no-guard", action="store_true",
         help="disable the data-integrity guardrails (stage-boundary "
              "contracts + in-program numerical sentinels; default follows "
@@ -920,7 +956,10 @@ def _main() -> None:
         synthetic=args.synthetic,
         synthetic_config=cfg if args.synthetic else None,
         make_bootstrap=args.bootstrap > 0,
-        make_specgrid=args.specgrid,
+        make_specgrid=(args.specgrid or args.specgrid_cells is not None
+                       or args.specgrid_sink is not None),
+        specgrid_cells=args.specgrid_cells,
+        specgrid_sink=args.specgrid_sink,
         bootstrap_replicates=args.bootstrap or 10_000,
         checkpoint_dir=args.checkpoint_dir,
         guard=False if args.no_guard else None,

@@ -201,7 +201,7 @@ def subset_sweep(
         return {}
     from fm_returnprediction_tpu.specgrid.specs import resolve_route
 
-    if resolve_route(route) == "gram":
+    if resolve_route(route, allowed=("gram", "stacked")) == "gram":
         return _subset_sweep_gram(
             panel, subset_masks, names, return_col, window, min_periods,
             n_deciles, min_obs, make_deciles,

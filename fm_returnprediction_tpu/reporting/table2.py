@@ -187,7 +187,11 @@ def build_table_2(
     col_idx = {c: i for i, c in enumerate(needed)}
     subset_names = list(subset_masks)
 
-    if mesh is None and _resolve_route(route) == "gram":
+    # resolve BEFORE the mesh short-circuit: a leaked
+    # FMRP_SPECGRID_ROUTE=coreset must reject loudly on this parity
+    # surface even when the mesh path (which ignores the route) is taken
+    resolved_route = _resolve_route(route, allowed=("gram", "stacked"))
+    if mesh is None and resolved_route == "gram":
         from fm_returnprediction_tpu.specgrid import run_spec_grid, table2_grid
 
         grid = table2_grid(

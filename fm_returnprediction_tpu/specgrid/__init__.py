@@ -11,17 +11,51 @@ as a per-cell conditioning referee.
 - ``specs``     — declarative ``Spec``/``SpecGrid`` + Table 2/Figure 1
   presets and the ``route=`` flag resolver.
 - ``grams``     — the mask-einsum panel→Gram contraction (firm-chunked,
-  no stacked designs).
+  no stacked designs, optional coreset row weights).
 - ``solve``     — padded batched Gram solve, FM/NW aggregation, the QR
   referee, and the program-trace counters ``bench.py`` records.
+- ``cellspace`` — the lazy, index-addressable scenario cell product
+  (universe × window × winsor × NW weight × predictor set × bootstrap
+  draw) and its fixed-width tiling.
+- ``engine``    — the tile driver: fused per-tile solves streamed into
+  sinks, one tile of state live at a time.
+- ``sinks``     — streaming aggregation sinks (full frame, top-k,
+  running summary, parquet spill).
+- ``sharded``   — the mesh route: firm-sharded contraction psum +
+  spec-sharded solve, placements from ``parallel.partition``'s rules.
+- ``coreset``   — sampled-and-reweighted panel compression, the
+  disclosed ``route="coreset"`` approximation tier.
 - ``scenarios`` — robustness grids (subperiods, size universes, winsor
-  levels, NW weights) → one tidy DataFrame.
+  levels, NW weights, bootstrap draws) → one tidy DataFrame via the
+  tile engine.
 """
 
+from fm_returnprediction_tpu.specgrid.cellspace import (
+    Cell,
+    CellSpace,
+    CellTile,
+    scenario_space,
+)
+from fm_returnprediction_tpu.specgrid.coreset import (
+    CoresetPlan,
+    coreset_plan,
+)
+from fm_returnprediction_tpu.specgrid.engine import (
+    block_bootstrap_months,
+    run_cellspace,
+)
 from fm_returnprediction_tpu.specgrid.grams import (
     SpecGramStats,
     auto_firm_chunk,
     contract_spec_grams,
+)
+from fm_returnprediction_tpu.specgrid.sinks import (
+    FrameSink,
+    ParquetSink,
+    Sink,
+    SummarySink,
+    TopKSink,
+    resolve_sink,
 )
 from fm_returnprediction_tpu.specgrid.scenarios import (
     run_scenarios,
@@ -46,23 +80,57 @@ from fm_returnprediction_tpu.specgrid.specs import (
     table2_grid,
 )
 
+# the mesh route loads lazily (PEP 562): a plain package import — every
+# Table 2 build, every single-device run — must not pay for jax.sharding
+# and the shard_map machinery it will never execute
+_SHARDED_NAMES = ("resolve_specgrid_mesh", "sharded_grid_parts",
+                  "specgrid_mesh")
+
+
+def __getattr__(name):
+    if name in _SHARDED_NAMES:
+        from fm_returnprediction_tpu.specgrid import sharded
+
+        return getattr(sharded, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
 __all__ = [
+    "Cell",
+    "CellSpace",
+    "CellTile",
+    "CoresetPlan",
+    "FrameSink",
+    "ParquetSink",
+    "Sink",
     "Spec",
     "SpecGrid",
     "SpecGramStats",
     "SpecGridResult",
+    "SummarySink",
+    "TopKSink",
     "auto_firm_chunk",
+    "block_bootstrap_months",
     "contract_spec_grams",
+    "coreset_plan",
     "figure1_grid",
     "product_grid",
     "program_trace_counts",
     "resolve_route",
+    "resolve_sink",
+    "resolve_specgrid_mesh",
+    "run_cellspace",
     "run_scenarios",
     "run_spec_grid",
     "run_spec_grid_on_panel",
     "run_spec_grid_weights",
     "scenario_grid",
+    "scenario_space",
+    "sharded_grid_parts",
     "solve_spec_stats",
+    "specgrid_mesh",
     "subperiod_windows",
     "table2_grid",
     "winsor_variant",

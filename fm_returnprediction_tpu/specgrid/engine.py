@@ -1,0 +1,447 @@
+"""The tile engine: lazy cell enumeration → fused tile solves → sinks.
+
+``run_cellspace`` is the pod-scale sweep driver: it walks a ``CellSpace``
+tile by tile, solves each tile's distinct specs through the PR-3 fused
+Gram program (single-device or the ``parallel.partition``-ruled mesh path),
+expands the solve into per-cell rows — weight schemes are slices of the
+same program, bootstrap draws are month-block re-aggregations of the same
+per-spec slope series — and streams every tile's rows into a sink
+(``specgrid.sinks``). At no point does the engine hold more than one tile
+of specs, solve leaves, or result rows; a 10⁵-cell sweep's peak
+incremental footprint is one tile plus whatever the sink retains.
+
+Compile discipline: every tile solves in FIXED-width spec batches
+(``spec_pad``), padded by repeating the batch's first spec, against the
+SPACE's pinned union-column order and the space's full static weight
+tuple — so the whole sweep reuses ONE compiled fused program (plus the QR
+referee's, when a batch trips it); ``PROGRAM_TRACES``/``record_trace``
+make the count auditable and ``bench.py``'s ``specgrid_scale`` section
+runs the warm repeat under ``recompile_watch``.
+
+Routes: ``"gram"`` (exact; the default) and ``"coreset"`` (the
+sampled-and-reweighted approximation tier, ``specgrid.coreset`` — each
+cell discloses its realized sampling rate; the QR referee is off by
+construction there). The reporting parity surfaces never come through
+here and keep rejecting ``"coreset"`` outright.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.specgrid.cellspace import (
+    Cell,
+    CellSpace,
+    CellTile,
+    resolve_tile_cells,
+)
+from fm_returnprediction_tpu.specgrid.sinks import Sink, resolve_sink
+from fm_returnprediction_tpu.specgrid.specs import SpecGrid
+
+__all__ = ["run_cellspace", "block_bootstrap_months"]
+
+
+# -- bootstrap draws --------------------------------------------------------
+
+def block_bootstrap_months(t: int, draw: int, seed: int = 0,
+                           block: Optional[int] = None) -> np.ndarray:
+    """Deterministic circular moving-block month resample for one draw.
+
+    All cells of a draw share ONE resample (the paired bootstrap — cross-
+    spec comparisons stay meaningful); ``draw`` 0 is reserved for the point
+    estimate and never resampled. The block length defaults to 6 (≥ the
+    default NW lag window, preserving the short-range serial correlation
+    the NW weighting models)."""
+    if draw < 1:
+        raise ValueError("draw 0 is the point estimate; draws start at 1")
+    block = block or 6
+    rng = np.random.default_rng((int(seed), int(draw)))
+    n_blocks = math.ceil(t / block)
+    starts = rng.integers(0, t, n_blocks)
+    idx = (starts[:, None] + np.arange(block)[None, :]).reshape(-1) % t
+    return idx[:t]
+
+
+def _nw_se_np(vals: np.ndarray, nw_lags: int, weight: str) -> float:
+    """Numpy mirror of ``ops.newey_west.nw_mean_se`` on a compacted valid
+    series — the bootstrap draws re-aggregate resampled series host-side
+    (tiny O(T) work; a device dispatch per draw would dominate)."""
+    n = vals.size
+    if n < 2:
+        return float("nan")
+    u = vals - vals.mean()
+    gamma0 = float(u @ u)
+    acc = 0.0
+    for k in range(1, nw_lags + 1):
+        gamma_k = float(u[k:] @ u[:-k]) if k < n else 0.0
+        if weight == "reference":
+            w = max(1.0 - k / n, 0.0)
+        elif weight == "textbook":
+            w = 1.0 - k / (nw_lags + 1.0)
+        else:
+            raise ValueError(f"Unknown NW weight scheme: {weight}")
+        acc += w * gamma_k
+    var_mean = (gamma0 + 2.0 * acc) / n**2
+    # negative small-sample HAC variance is legal and reads as NaN — the
+    # same contract as the jax path (guard/checks NW-tap note)
+    return float(np.sqrt(var_mean)) if var_mean >= 0 else float("nan")
+
+
+def _fm_aggregate_np(slopes, r2, n_obs, month_valid,
+                     nw_lags: int, min_months: int, weight: str):
+    """Numpy mirror of ``ops.fama_macbeth.fama_macbeth_summary`` over a
+    (T, P) slope series — applied to month-RESAMPLED series for bootstrap
+    draws (same dropna/min-months/NW semantics; order of the input rows is
+    the resampled order, which is what the autocovariances should see)."""
+    slopes = np.asarray(slopes, float)
+    month_valid = np.asarray(month_valid, bool)
+    slope_valid = month_valid[:, None] & np.isfinite(slopes)
+    count = slope_valid.sum(axis=0)
+    p = slopes.shape[1]
+    coef = np.full(p, np.nan)
+    tstat = np.full(p, np.nan)
+    nw_se = np.full(p, np.nan)
+    for j in range(p):
+        vals = slopes[slope_valid[:, j], j]
+        se = _nw_se_np(vals, nw_lags, weight)
+        if vals.size:
+            mean = float(vals.mean())
+        else:
+            mean = np.nan
+        nw_se[j] = se
+        if count[j] >= min_months:
+            coef[j] = mean
+            tstat[j] = mean / se if se and np.isfinite(se) else np.nan
+    r2 = np.asarray(r2, float)
+    r2_valid = month_valid & np.isfinite(r2)
+    mean_r2 = float(r2[r2_valid].mean()) if r2_valid.any() else float("nan")
+    n_months = int(month_valid.sum())
+    mean_n = (float(np.asarray(n_obs, float)[month_valid].mean())
+              if n_months else float("nan"))
+    return coef, tstat, nw_se, mean_r2, mean_n, n_months
+
+
+# -- tile grouping ----------------------------------------------------------
+
+def _winsor_groups(tile: CellTile) -> Iterator[Tuple[float, List[Cell]]]:
+    """Split a tile's cells into contiguous same-winsor runs (winsor is the
+    outermost dimension, so a tile straddles at most a few)."""
+    cells: List[Cell] = []
+    for cell in tile.cells():
+        if cells and cell.winsor != cells[-1].winsor:
+            yield cells[-1].winsor, cells
+            cells = []
+        cells.append(cell)
+    if cells:
+        yield cells[-1].winsor, cells
+
+
+class _TileSolver:
+    """Solves one winsor-group's distinct specs in fixed ``spec_pad``-wide
+    batches and serves per-cell views; one instance per group, dropped
+    when the group's rows have been emitted."""
+
+    def __init__(self, engine: "_Engine", x_level, cells: List[Cell]):
+        self.engine = engine
+        space = engine.space
+        seen: Dict[int, Cell] = {}
+        for c in cells:
+            seen.setdefault(space.spec_index(c.index), c)
+        self.spec_rows: Dict[int, Tuple[int, int]] = {}
+        self.results: List[Dict[str, object]] = []
+        ids = list(seen)
+        pad = engine.spec_pad
+        for b, start in enumerate(range(0, len(ids), pad)):
+            block_ids = ids[start:start + pad]
+            for row, sid in enumerate(block_ids):
+                self.spec_rows[sid] = (b, row)
+            # pad to the fixed program width by repeating the block's first
+            # spec; padded rows are never read back
+            padded = block_ids + [block_ids[0]] * (pad - len(block_ids))
+            grid = SpecGrid(
+                tuple(seen[sid].spec(tag=space.tag) for sid in padded),
+                nw_lags=space.nw_lags, min_months=space.min_months,
+                union=space.union_predictors,
+            )
+            self.results.append(engine.solve_block(grid, x_level))
+
+    def cell_view(self, cell: Cell):
+        """(per-weight SpecGridResult, local spec row) for one cell."""
+        b, row = self.spec_rows[self.engine.space.spec_index(cell.index)]
+        return self.results[b][cell.weight], row
+
+
+class _Engine:
+    def __init__(self, y, x, universe_masks, space: CellSpace, *,
+                 mask, route: str, mesh, referee: bool,
+                 firm_chunk, label_of, seed: int,
+                 coreset_m, coreset_budget_mb, tile_cells):
+        from fm_returnprediction_tpu.specgrid.sharded import (
+            resolve_specgrid_mesh,
+        )
+
+        self.space = space
+        self.union = space.union_predictors
+        self.y = jnp.asarray(y)
+        self.x_base = jnp.asarray(x)
+        if self.x_base.shape[-1] != len(self.union):
+            raise ValueError(
+                f"x holds {self.x_base.shape[-1]} columns but the space's "
+                f"union has {len(self.union)} ({list(self.union)}) — slice "
+                "the union tensor in space.union_predictors order"
+            )
+        self.mask = mask
+        # device-resident once: run_spec_grid_weights re-stacks the
+        # universe dict per spec batch, and host numpy masks would pay a
+        # (U, T, N) host-to-device transfer on every tile block
+        self.universe_masks = {
+            n: jnp.asarray(m) for n, m in universe_masks.items()
+        }
+        self.mesh = resolve_specgrid_mesh(mesh)
+        self.referee = referee
+        self.firm_chunk = firm_chunk
+        self.label_of = label_of or {}
+        self.seed = int(seed)
+        self.route = route
+        self._union_pos = {c: i for i, c in enumerate(self.union)}
+        # tile width rounds UP to a multiple of the draw count: draws are
+        # the innermost radix, so aligned tiles never split a spec's draw
+        # run across tiles — a straddled spec would re-run its (T, N)
+        # panel contraction once per tile it touches
+        want = resolve_tile_cells(tile_cells)
+        self.tile_cells = min(
+            len(space),
+            math.ceil(want / space.bootstrap) * space.bootstrap,
+        )
+        self.spec_pad = min(
+            space.n_specs,
+            max(1, math.ceil(self.tile_cells / space.bootstrap)),
+        )
+        t, n = self.y.shape
+        self._resample_cache: Dict[int, np.ndarray] = {}
+        self._winsor_cache: Optional[Tuple[float, object]] = None
+        self._rate_cache: Dict[Tuple[str, Optional[Tuple[int, int]]], float] = {}
+
+        self.plan = None
+        self.row_weights = None
+        if route == "coreset":
+            from fm_returnprediction_tpu.specgrid.coreset import (
+                coreset_plan,
+                resolve_coreset_m,
+            )
+
+            q = len(self.union) + 1
+            m = resolve_coreset_m(
+                n, coreset_m, coreset_budget_mb, t=t, q=q,
+                itemsize=self.x_base.dtype.itemsize,
+            )
+            base_mask = (np.asarray(mask, bool) if mask is not None
+                         else np.isfinite(np.asarray(y)))
+            self.plan = coreset_plan(
+                np.asarray(y), np.asarray(x), base_mask, m, seed=self.seed,
+            )
+            self.row_weights = jnp.asarray(
+                self.plan.row_weights, self.x_base.dtype
+            )
+        elif route != "gram":
+            raise ValueError(
+                f"the tile engine solves route='gram' or 'coreset', got "
+                f"{route!r} (the stacked route lives in reporting.fusion)"
+            )
+
+    # -- solve plumbing ----------------------------------------------------
+
+    def x_at_level(self, level: float):
+        """The union tensor re-winsorized at ``level`` — single-slot cache
+        (winsor is the outermost dimension; levels arrive contiguously)."""
+        if self._winsor_cache is not None and self._winsor_cache[0] == level:
+            return self._winsor_cache[1]
+        if level == 1.0:
+            x_level = self.x_base
+        else:
+            from fm_returnprediction_tpu.specgrid.scenarios import (
+                winsor_variant,
+            )
+
+            if self.mask is None:
+                raise ValueError(
+                    "winsor levels beyond the stored base clip need the "
+                    "panel validity mask (mask=...)"
+                )
+            x_level = winsor_variant(self.x_base, jnp.asarray(self.mask),
+                                     float(level))
+        self._winsor_cache = (level, x_level)
+        return x_level
+
+    def solve_block(self, grid: SpecGrid, x_level):
+        from fm_returnprediction_tpu.specgrid.solve import (
+            run_spec_grid_weights,
+        )
+
+        return run_spec_grid_weights(
+            x=x_level, y=self.y, universe_masks=self.universe_masks,
+            grid=grid, weights=self.space.weights, referee=self.referee,
+            firm_chunk=self.firm_chunk, mesh=self.mesh,
+            row_weights=self.row_weights,
+        )
+
+    def resample(self, draw: int) -> np.ndarray:
+        idx = self._resample_cache.get(draw)
+        if idx is None:
+            idx = block_bootstrap_months(int(self.y.shape[0]), draw,
+                                         seed=self.seed)
+            self._resample_cache[draw] = idx
+            if len(self._resample_cache) > 8:  # bounded; draws arrive in order
+                self._resample_cache.pop(next(iter(self._resample_cache)))
+        return idx
+
+    def coreset_rate(self, cell: Cell) -> float:
+        key = (cell.universe, cell.window)
+        rate = self._rate_cache.get(key)
+        if rate is None:
+            t = int(self.y.shape[0])
+            win = None
+            if cell.window is not None:
+                win = np.zeros(t, bool)
+                win[cell.window[0]:min(cell.window[1], t)] = True
+            rate = self.plan.rate_under(
+                np.asarray(self.universe_masks[cell.universe]).astype(bool),
+                win,
+            )
+            self._rate_cache[key] = rate
+        return rate
+
+    # -- row emission ------------------------------------------------------
+
+    def rows_for(self, cell: Cell, res, row: int) -> List[dict]:
+        space = self.space
+        pos = [self._union_pos[c] for c in cell.predictors]
+        if cell.draw == 0:
+            coef = res.coef[row]
+            tstat = res.tstat[row]
+            nw_se = res.nw_se[row]
+            mean_r2 = float(res.mean_r2[row])
+            mean_n = float(res.mean_n[row])
+            n_months = int(res.n_months[row])
+        else:
+            idx = self.resample(cell.draw)
+            coef_c, tstat_c, nw_c, mean_r2, mean_n, n_months = (
+                _fm_aggregate_np(
+                    res.slopes[row][idx], res.r2[row][idx],
+                    res.n_obs[row][idx], res.month_valid[row][idx],
+                    space.nw_lags, space.min_months, cell.weight,
+                )
+            )
+            coef = np.full(len(self.union), np.nan)
+            tstat = np.full(len(self.union), np.nan)
+            nw_se = np.full(len(self.union), np.nan)
+            coef[pos] = coef_c[pos]
+            tstat[pos] = tstat_c[pos]
+            nw_se[pos] = nw_c[pos]
+        refereed = row in res.referee_specs
+        rows = []
+        for col, p in zip(cell.predictors, pos):
+            r = {
+                "cell": cell.index,
+                "model": cell.set_name,
+                "universe": cell.universe,
+                "window": cell.window_name,
+                "winsor_pct": float(cell.winsor),
+                "nw_weight": cell.weight,
+                "predictor": self.label_of.get(col, col),
+                "coef": float(coef[p]),
+                "tstat": float(tstat[p]),
+                "nw_se": float(nw_se[p]),
+                "mean_r2": mean_r2,
+                "mean_n": mean_n,
+                "n_months": n_months,
+                "refereed": refereed,
+            }
+            if space.bootstrap > 1:
+                r["draw"] = cell.draw
+            if self.route == "coreset":
+                r["route"] = "coreset"
+                r["coreset_m"] = self.plan.m_per_month
+                r["coreset_rate"] = self.coreset_rate(cell)
+                r["suspect_months"] = int(res.suspect_months[row])
+            rows.append(r)
+        return rows
+
+
+def run_cellspace(
+    y,
+    x,
+    universe_masks: Dict[str, object],
+    space: CellSpace,
+    sink=None,
+    tile_cells: Optional[int] = None,
+    route: str = "gram",
+    mesh=None,
+    referee: bool = True,
+    mask=None,
+    firm_chunk: Optional[int] = None,
+    label_of: Optional[Dict[str, str]] = None,
+    seed: int = 0,
+    coreset_m: Optional[int] = None,
+    coreset_budget_mb: Optional[float] = None,
+    output_dir=None,
+):
+    """Stream a ``CellSpace`` sweep through a sink.
+
+    ``x`` must hold ``space.union_predictors`` in order; ``universe_masks``
+    must cover every universe the space names. ``sink`` is a ``Sink``, a
+    sink name (``sinks.SINK_NAMES``), or None (the ``FMRP_SPECGRID_SINK``/
+    ``"frame"`` default). Returns ``(sink.finish(), stats_dict)`` where the
+    stats disclose cells/rows/tiles/seconds (the bench's cells/s series
+    reads them).
+    """
+    from fm_returnprediction_tpu import telemetry
+
+    sink_obj: Sink = resolve_sink(sink, output_dir=output_dir)
+    engine = _Engine(
+        y, x, universe_masks, space,
+        mask=mask, route=route, mesh=mesh, referee=referee,
+        firm_chunk=firm_chunk, label_of=label_of, seed=seed,
+        coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
+        tile_cells=tile_cells,
+    )
+    cells_counter = telemetry.registry().counter(
+        "fmrp_specgrid_cells_total",
+        help="scenario cells streamed through the spec-grid tile engine",
+    )
+    n_tiles = 0
+    with telemetry.timed("specgrid.cellspace", cells=len(space),
+                         tile=engine.tile_cells, route=route) as sweep_t:
+        for tile in space.tiles(engine.tile_cells):
+            with telemetry.span("specgrid.tile", start=tile.start,
+                                stop=tile.stop):
+                frames: List[dict] = []
+                for level, cells in _winsor_groups(tile):
+                    solver = _TileSolver(engine, engine.x_at_level(level),
+                                         cells)
+                    for cell in cells:
+                        res, row = solver.cell_view(cell)
+                        frames.extend(engine.rows_for(cell, res, row))
+                    del solver  # one tile of solve leaves live at a time
+                sink_obj.consume(pd.DataFrame(frames))
+                cells_counter.inc(len(tile))
+            n_tiles += 1
+    stats = {
+        "cells": len(space),
+        "rows": sink_obj.rows_seen,
+        "tiles": n_tiles,
+        "tile_cells": engine.tile_cells,
+        "spec_pad": engine.spec_pad,
+        "seconds": sweep_t.s,
+        "cells_per_s": (len(space) / sweep_t.s) if sweep_t.s > 0 else None,
+        "route": route,
+    }
+    if engine.plan is not None:
+        stats["coreset_m"] = engine.plan.m_per_month
+        stats["coreset_exact_months"] = engine.plan.exact_months
+    return sink_obj.finish(), stats

@@ -80,6 +80,7 @@ def contract_spec_grams(
     window: jnp.ndarray,
     firm_chunk: Optional[int] = None,
     center: Optional[jnp.ndarray] = None,
+    row_weights: Optional[jnp.ndarray] = None,
 ) -> SpecGramStats:
     """Contract the (T, N, P) union panel into (S, T, Q, Q) Gram stats.
 
@@ -98,6 +99,12 @@ def contract_spec_grams(
         algebraically valid (the intercept absorbs shifts; slopes and R²
         are invariant) and shard-additivity holds for a FIXED center, so
         sharded callers must share one.
+    row_weights : optional (T, N) non-negative per-row weights multiplying
+        each spec's 0/1 validity — the coreset route's importance weights
+        (``specgrid.coreset``). ``n`` then accumulates Σw (the UNBIASED
+        estimate of the full-sample row count), and every moment is the
+        correspondingly weighted sum. ``None`` (the default) traces the
+        exact historical unweighted jaxpr.
 
     Validity per spec = universe ∧ finite(y) ∧ finite(selected x) ∧ window
     — exactly ``ops.ols.row_validity`` restricted to the spec's columns,
@@ -146,9 +153,15 @@ def contract_spec_grams(
         )                                     # (S, T, c)
         xa = jnp.concatenate([jnp.ones_like(yc)[..., None], xz], axis=-1)
 
+        rw = None
+        if row_weights is not None:
+            rw = jnp.asarray(row_weights, dtype)[:, sl]   # (T, c)
+
         g_parts, m_parts, n_parts, ys_parts, yy_parts = [], [], [], [], []
         for s in range(s_specs):              # static: S is a shape
             w = valid[s].astype(dtype)        # (T, c)
+            if rw is not None:
+                w = w * rw
             b = xa * w[..., None]             # the ONE large temporary
             g_parts.append(jnp.einsum("tnp,tnq->tpq", b, xa,
                                       precision=_PRECISION))

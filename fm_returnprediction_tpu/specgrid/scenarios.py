@@ -1,10 +1,12 @@
 """Robustness scenario grids — subperiods × universes × winsor × weights.
 
 The ROADMAP's "as many scenarios as you can imagine" workload, built on
-the Gram engine: ONE fused program per winsor variant covers every
-model × universe × sample-window cell, with every NW weight scheme
-re-aggregated inside that same program, and the results land in one tidy
-DataFrame.
+the Gram engine: the cell product (now extended by bootstrap draws) is
+enumerated LAZILY through ``cellspace.CellSpace`` and solved tile by tile
+by ``engine.run_cellspace`` — every NW weight scheme re-aggregates inside
+one fused program per tile batch, results stream through a configurable
+sink, and a million-cell sweep never materializes its spec list or its
+full frame.
 
 Winsor variants: the panel's characteristics are stored winsorized at
 [1%, 99%] (``get_factors``, reference ``src/calc_Lewellen_2014.py:572``).
@@ -22,7 +24,9 @@ program per variant.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import math
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -30,7 +34,6 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from fm_returnprediction_tpu.specgrid.solve import run_spec_grid_weights
 from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
 
 __all__ = [
@@ -147,57 +150,72 @@ def run_scenarios(
     min_months: int = 10,
     return_col: str = "retx",
     referee: bool = True,
-) -> pd.DataFrame:
-    """The scenario sweep: one tidy row per (spec, predictor).
+    bootstrap: int = 1,
+    cells: Optional[int] = None,
+    tile_cells: Optional[int] = None,
+    sink=None,
+    route: str = "gram",
+    mesh=None,
+    seed: int = 0,
+    coreset_m: Optional[int] = None,
+    coreset_budget_mb: Optional[float] = None,
+    output_dir=None,
+    return_stats: bool = False,
+):
+    """The scenario sweep: one tidy row per (cell, predictor).
 
-    Columns: scenario dimensions (model/universe/window/winsor/nw_weight),
-    the FM estimates (coef/tstat/nw_se), the cell diagnostics
-    (mean_r2/mean_n/n_months) and ``refereed`` (True when the batched-QR
-    referee re-solved the cell). Each (winsor, weight) combination is one
-    fused Gram program; predictors are reported under their display labels.
+    Returns the sink's result frame, or ``(frame, stats)`` with
+    ``return_stats=True`` (the bench reads the stats' cells/s).
+
+    Columns: the cell's global address (``cell``), scenario dimensions
+    (model/universe/window/winsor/nw_weight, plus ``draw`` when bootstrap
+    draws are requested), the FM estimates (coef/tstat/nw_se), the cell
+    diagnostics (mean_r2/mean_n/n_months) and ``refereed`` (True when the
+    batched-QR referee re-solved the cell); coreset-route cells add their
+    sampling disclosure (route/coreset_m/coreset_rate/suspect_months).
+
+    The enumeration is LAZY (``cellspace.CellSpace`` — the cell product is
+    addressed by index, never materialized) and the execution streams tile
+    by tile through ``specgrid.engine.run_cellspace`` into ``sink`` (a
+    ``sinks.Sink``, a sink name, or None → the ``FMRP_SPECGRID_SINK`` /
+    full-frame default), so a 10⁵-cell sweep holds one tile at a time.
+    Every NW weight scheme still re-aggregates inside one fused Gram
+    program per tile batch, and ``cells=N`` scales the bootstrap-draw
+    dimension until the space holds at least N cells (the pod-scale knob
+    ``--specgrid-cells`` rides). ``mesh`` (or ``FMRP_SPECGRID_MESH``)
+    routes the solve through the declarative sharded path.
     """
     from fm_returnprediction_tpu.models.lewellen import MODELS
+    from fm_returnprediction_tpu.specgrid.cellspace import scenario_space
+    from fm_returnprediction_tpu.specgrid.engine import run_cellspace
 
     models = models if models is not None else MODELS
     universes = list(universes) if universes is not None else list(subset_masks)
     label_of = {col: label for label, col in variables_dict.items()}
 
     t = len(panel.months)
-    specs, meta = _scenario_cells(variables_dict, universes, t, models,
-                                  subperiods)
-    grid0 = SpecGrid(specs, nw_lags=nw_lags, min_months=min_months)
-    y = jnp.asarray(panel.var(return_col))
-    x_base = jnp.asarray(panel.select(grid0.union_predictors))
-    mask = jnp.asarray(panel.mask)
-
-    rows = []
-    for level in winsor_levels:
-        x = winsor_variant(x_base, mask, float(level))
-        # ONE contraction+solve program per winsor level: every NW weight
-        # scheme re-aggregates the same Gram solve inside that program
-        results = run_spec_grid_weights(
-            y, x, {n: subset_masks[n] for n in universes}, grid0,
-            tuple(weights), referee=referee,
+    space = scenario_space(
+        variables_dict, universes, t, models=models, subperiods=subperiods,
+        winsor_levels=winsor_levels, weights=weights, bootstrap=bootstrap,
+        nw_lags=nw_lags, min_months=min_months,
+    )
+    if cells is not None and cells > len(space):
+        # grow the draw dimension (the only one that scales freely) until
+        # the space covers the requested cell count
+        base = len(space) // space.bootstrap
+        space = dataclasses.replace(
+            space, bootstrap=max(space.bootstrap, math.ceil(cells / base))
         )
-        for weight in weights:
-            res = results[weight]
-            for s, spec in enumerate(grid0.specs):
-                model_name, universe, win_name = meta[s]
-                pos = grid0.column_positions(spec)
-                for col, p in zip(spec.predictors, pos):
-                    rows.append({
-                        "model": model_name,
-                        "universe": universe,
-                        "window": win_name,
-                        "winsor_pct": float(level),
-                        "nw_weight": weight,
-                        "predictor": label_of.get(col, col),
-                        "coef": float(res.coef[s, p]),
-                        "tstat": float(res.tstat[s, p]),
-                        "nw_se": float(res.nw_se[s, p]),
-                        "mean_r2": float(res.mean_r2[s]),
-                        "mean_n": float(res.mean_n[s]),
-                        "n_months": int(res.n_months[s]),
-                        "refereed": s in res.referee_specs,
-                    })
-    return pd.DataFrame(rows)
+
+    y = jnp.asarray(panel.var(return_col))
+    x_base = jnp.asarray(panel.select(list(space.union_predictors)))
+    frame, stats = run_cellspace(
+        y, x_base, {n: subset_masks[n] for n in universes}, space,
+        sink=sink, tile_cells=tile_cells, route=route, mesh=mesh,
+        referee=referee, mask=jnp.asarray(panel.mask), label_of=label_of,
+        seed=seed, coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
+        output_dir=output_dir,
+    )
+    if return_stats:
+        return frame, stats
+    return frame

@@ -1,0 +1,304 @@
+"""Mesh-sharded spec-grid solve — firm-sharded contraction, spec-sharded solve.
+
+The pod-scale leg of the spec-grid engine (ROADMAP item 3). Two sequential
+stages over ONE 1-D mesh axis (``parallel.partition.specgrid_axis``):
+
+1. **Contraction** — the dense ``(T, N, P)`` panel shards over FIRMS. Each
+   device contracts its local firm slice with ``grams.contract_spec_grams``
+   against a GLOBAL per-month center (two psums compute the masked column
+   means before contracting, so every shard shares one fixed shift — the
+   precondition of the additivity property ``tests/test_specgrid.py`` pins)
+   and one psum of the additive Gram/moment/count leaves produces the exact
+   global ``SpecGramStats``, replicated.
+2. **Solve** — the ``(S, T, Q, Q)`` stats re-place SPEC-sharded (the solve
+   is vmapped per spec: zero communication) and the shared program tail
+   ``solve._solve_and_aggregate`` runs under jit, XLA partitioning it along
+   the operand sharding; only the guard sentinels' scalar sums cross the
+   mesh.
+
+Every placement in both stages is drawn from the declarative rule tables in
+``parallel.partition`` (``match_partition_rules`` over the named arg tree —
+the SNIPPETS [2] idiom), not hand-threaded per call site. The spec axis is
+padded to the mesh size with intercept-only ghost specs (month_valid is
+identically False there, so they solve to exact zeros and are dropped on
+the host); the firm axis pads with NaN/False slots exactly as
+``mesh.shard_panel`` does.
+
+Numerics: psum accumulation orders differ from the single-device chunked
+loop, so the sharded route matches the single-device route to the PR-3
+differential tolerances (≤1e-6; observed ~1e-13 at f64), not bit-for-bit —
+``tests/test_specgrid_scale.py`` pins the differential on the virtual CPU
+mesh. Single-device execution never ROUTES through this path and stays
+bit-compatible (the module itself loads lazily: the package ``__init__``
+defers it via PEP 562 and the tile engine imports it only to resolve the
+mesh policy, so a plain Table-2 import never pays for it).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fm_returnprediction_tpu.parallel.mesh import (
+    make_mesh,
+    pad_to_multiple,
+    place_global,
+    shard_map,
+)
+from fm_returnprediction_tpu.parallel.partition import (
+    match_partition_rules,
+    specgrid_axis,
+    specgrid_panel_rules,
+    specgrid_stats_rules,
+    tree_shardings,
+)
+from fm_returnprediction_tpu.specgrid.grams import (
+    SpecGramStats,
+    auto_firm_chunk,
+    contract_spec_grams,
+)
+
+__all__ = ["resolve_specgrid_mesh", "sharded_grid_parts", "specgrid_mesh"]
+
+
+def specgrid_mesh(n_devices: Optional[int] = None):
+    """A 1-D mesh over ``n_devices`` local devices named with the spec-grid
+    axis (``parallel.partition.specgrid_axis``) — the mesh every rule table
+    in the sharded path resolves against."""
+    return make_mesh(n_devices=n_devices, axis_name=specgrid_axis())
+
+
+def resolve_specgrid_mesh(mesh=None):
+    """The spec-grid mesh policy: an explicit ``mesh`` argument wins, then
+    ``FMRP_SPECGRID_MESH`` (unset/``0``/``1`` → None = the bit-compatible
+    single-device default; ``auto`` → all local devices; ``N`` → exactly N,
+    erroring if unavailable — same "exactly N" contract as
+    ``mesh.default_mesh``)."""
+    if mesh is not None:
+        return mesh
+    want = os.environ.get("FMRP_SPECGRID_MESH", "").strip().lower()
+    if want in ("", "0", "1"):
+        return None
+    if want == "auto":
+        n = len(jax.devices())
+        return specgrid_mesh(n) if n > 1 else None
+    n = int(want)
+    if n <= 1:
+        return None
+    return specgrid_mesh(n)
+
+
+@functools.lru_cache(maxsize=32)
+def _contract_program(mesh, firm_chunk: int, has_rw: bool, dtype_key: str):
+    """The firm-sharded contraction, jitted once per (mesh, chunk, weighted,
+    dtype) combo — ``jax.jit``'s cache keys on the function object, so a
+    per-call closure would retrace every sweep tile (the same lru idiom as
+    ``parallel.fm_sharded._jitted_fm``)."""
+    axis = mesh.axis_names[0]
+
+    def kernel(y_l, x_l, uni_l, uidx, col_sel, window, rw_l):
+        from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+        from fm_returnprediction_tpu.telemetry import record_trace
+
+        PROGRAM_TRACES["specgrid_sharded_contract"] += 1
+        record_trace("specgrid_sharded_contract")
+        dtype = x_l.dtype
+        # global fixed center via psum of the local masked column sums —
+        # every shard must contract against the SAME shift for the Gram
+        # additivity to hold (grams.contract_spec_grams docstring)
+        fin = jnp.isfinite(x_l)
+        s_glob = jax.lax.psum(jnp.where(fin, x_l, 0.0).sum(axis=1), axis)
+        c_glob = jax.lax.psum(fin.sum(axis=1), axis)
+        center = s_glob / jnp.maximum(c_glob, 1).astype(dtype)
+        stats = contract_spec_grams(
+            y_l, x_l, uni_l, uidx, col_sel, window,
+            firm_chunk=firm_chunk, center=center, row_weights=rw_l,
+        )
+        gram, moment, n, ysum, yy = jax.lax.psum(
+            (stats.gram, stats.moment, stats.n, stats.ysum, stats.yy), axis
+        )
+        return SpecGramStats(gram, moment, n, ysum, yy, center)
+
+    # the in_specs come from the rule table, matched against a template
+    # tree with each argument's rank (shape values are irrelevant to the
+    # match; 2s keep every leaf non-scalar so the table is consulted)
+    template = {
+        "y": np.empty((2, 2)), "x": np.empty((2, 2, 2)),
+        "universes": np.empty((2, 2, 2)), "uidx": np.empty((2,)),
+        "col_sel": np.empty((2, 2)), "window": np.empty((2, 2)),
+    }
+    if has_rw:
+        template["row_weights"] = np.empty((2, 2))
+    specs = match_partition_rules(specgrid_panel_rules(axis), template)
+    order = ("y", "x", "universes", "uidx", "col_sel", "window") + (
+        ("row_weights",) if has_rw else ()
+    )
+    in_specs = tuple(specs[k] for k in order)
+    if not has_rw:
+        def kernel_norw(y_l, x_l, uni_l, uidx, col_sel, window):
+            return kernel(y_l, x_l, uni_l, uidx, col_sel, window, None)
+
+        body = shard_map(
+            kernel_norw, mesh=mesh, in_specs=in_specs,
+            out_specs=SpecGramStats(*([P()] * 6)),
+        )
+    else:
+        body = shard_map(
+            kernel, mesh=mesh, in_specs=in_specs,
+            out_specs=SpecGramStats(*([P()] * 6)),
+        )
+    return jax.jit(body)
+
+
+@functools.lru_cache(maxsize=32)
+def _solve_program(nw_lags: int, min_months: int, weights: Tuple[str, ...],
+                   guard: bool, dtype_key: str):
+    """The spec-sharded solve+FM tail, jitted once per hyperparameter
+    combo. Inputs arrive spec-sharded (placed by the rule table); jit
+    follows the operand sharding, so the vmapped per-spec solve partitions
+    with zero communication."""
+    from fm_returnprediction_tpu.specgrid.solve import _solve_and_aggregate
+
+    out_dtype = np.dtype(dtype_key)
+
+    @jax.jit
+    def run(stats, col_sel):
+        from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+        from fm_returnprediction_tpu.telemetry import record_trace
+
+        PROGRAM_TRACES["specgrid_sharded_solve"] += 1
+        record_trace("specgrid_sharded_solve")
+        return _solve_and_aggregate(
+            stats, col_sel, out_dtype,
+            nw_lags=nw_lags, min_months=min_months, weights=weights,
+            guard=guard,
+        )
+
+    return run
+
+
+# single-slot memo of the padded + mesh-placed panel: the tile engine
+# calls the sharded route once per spec batch with the SAME panel tensors
+# (only the per-spec selectors change), and re-padding + re-placing the
+# (T, N, P) union tensor per batch — a full copy plus device placement —
+# would dominate exactly the sweep the sharding exists to speed up. Keyed
+# by (mesh, input array identities); the strong references in the cache
+# entry keep the ids stable while cached (arrays are immutable across the
+# repo). Single-threaded access; a miss just rebuilds.
+_PLACED_PANEL_CACHE: Optional[tuple] = None
+
+
+def _placed_panel(mesh, y, x, universes, row_weights):
+    global _PLACED_PANEL_CACHE
+    key = (mesh, id(y), id(x), id(universes),
+           id(row_weights) if row_weights is not None else None)
+    cached = _PLACED_PANEL_CACHE
+    if cached is not None and cached[0] == key:
+        return cached[2], cached[3]
+    axis = mesh.axis_names[0]
+    d = int(mesh.shape[axis])
+    y_p = pad_to_multiple(jnp.asarray(y), axis=1, multiple=d, fill=jnp.nan)
+    x_p = pad_to_multiple(jnp.asarray(x), axis=1, multiple=d, fill=jnp.nan)
+    uni_p = pad_to_multiple(jnp.asarray(universes), axis=2, multiple=d,
+                            fill=False)
+    panel_tree = {"y": y_p, "x": x_p, "universes": uni_p}
+    if row_weights is not None:
+        panel_tree["row_weights"] = pad_to_multiple(
+            jnp.asarray(row_weights, x_p.dtype), axis=1, multiple=d, fill=0.0
+        )
+    shardings = tree_shardings(mesh, specgrid_panel_rules(axis), panel_tree)
+    placed = {
+        k: place_global(v, shardings[k]) for k, v in panel_tree.items()
+    }
+    n_local = y_p.shape[1] // d
+    _PLACED_PANEL_CACHE = (key, (y, x, universes, row_weights), placed,
+                           n_local)
+    return placed, n_local
+
+
+def sharded_grid_parts(
+    y, x, universes, uidx, col_sel, window, *,
+    mesh,
+    row_weights=None,
+    nw_lags: int,
+    min_months: int,
+    weights: Tuple[str, ...],
+    firm_chunk: Optional[int],
+    guard: bool,
+):
+    """The mesh route of ``solve.run_spec_grid_weights``: returns the same
+    host-side ``(cs, fms, suspect[, counters])`` tuple as the single-device
+    AOT program, computed as firm-sharded contraction → psum → spec-sharded
+    solve. Placement comes from ``parallel.partition``'s rule tables."""
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"spec-grid sharding wants a 1-D mesh, got axes {mesh.axis_names}"
+        )
+    axis = mesh.axis_names[0]
+    d = int(mesh.shape[axis])
+    t, n_firms, p = x.shape
+    s_specs = int(col_sel.shape[0])
+
+    # -- stage 1: firm-sharded contraction ---------------------------------
+    placed, n_local = _placed_panel(mesh, y, x, universes, row_weights)
+    chunk = firm_chunk or auto_firm_chunk(t, n_local, p + 1,
+                                          placed["x"].dtype.itemsize)
+    chunk = min(chunk, n_local)
+
+    has_rw = row_weights is not None
+    contract = _contract_program(mesh, int(chunk), has_rw,
+                                 str(placed["x"].dtype))
+    small = (jnp.asarray(uidx), jnp.asarray(col_sel), jnp.asarray(window))
+    if has_rw:
+        stats = contract(placed["y"], placed["x"], placed["universes"],
+                         *small, placed["row_weights"])
+    else:
+        stats = contract(placed["y"], placed["x"], placed["universes"],
+                         *small)
+
+    # -- stage 2: spec-sharded solve ---------------------------------------
+    # ghost specs pad S to the mesh size: intercept-only selector, zero
+    # stats → month_valid ≡ False → exact-zero leaves, dropped below
+    def pad_s(a, fill=0.0):
+        return pad_to_multiple(a, axis=0, multiple=d, fill=fill)
+
+    stats_p = SpecGramStats(
+        pad_s(stats.gram), pad_s(stats.moment), pad_s(stats.n),
+        pad_s(stats.ysum), pad_s(stats.yy), stats.center,
+    )
+    col_sel_p = pad_s(jnp.asarray(col_sel), fill=False)
+    solve_tree = {
+        "gram": stats_p.gram, "moment": stats_p.moment, "n": stats_p.n,
+        "ysum": stats_p.ysum, "yy": stats_p.yy, "center": stats_p.center,
+        "col_sel": col_sel_p,
+    }
+    s_shard = tree_shardings(mesh, specgrid_stats_rules(axis), solve_tree)
+    stats_sharded = SpecGramStats(
+        *(place_global(solve_tree[k], s_shard[k])
+          for k in ("gram", "moment", "n", "ysum", "yy", "center"))
+    )
+    col_sharded = place_global(col_sel_p, s_shard["col_sel"])
+
+    solve = _solve_program(nw_lags, min_months, tuple(weights), guard,
+                           str(placed["y"].dtype))
+    out = jax.device_get(solve(stats_sharded, col_sharded))
+
+    # drop the ghost specs on the host (leading axis of every per-spec leaf)
+    def trim(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a[:s_specs] if getattr(a, "ndim", 0) >= 1
+            and a.shape[0] == stats_p.gram.shape[0] else a,
+            tree,
+        )
+
+    if guard:
+        cs, fms, suspect, counters = out
+        return trim(cs), trim(fms), suspect[:s_specs], counters
+    cs, fms, suspect = out
+    return trim(cs), trim(fms), suspect[:s_specs]

@@ -116,6 +116,30 @@ def _compiled_grid_program(args, static_kwargs):
     return exe
 
 
+# single-slot memo of the stacked (U, T, N) universe tensor: the tile
+# engine calls run_spec_grid_weights once per spec batch with the SAME
+# mask dict, and re-stacking (a full device copy, plus a host-to-device
+# transfer for numpy masks) per batch would tax every tile. Keyed by the
+# member arrays' identities; the strong references in the key tuple keep
+# those ids stable while cached (masks are treated as immutable across
+# the repo — in-place mutation between calls is outside the contract).
+# Single-threaded access (the engine and reporting paths are
+# sequential); a miss just rebuilds.
+_UNIVERSE_STACK_CACHE: Optional[tuple] = None
+
+
+def _universe_stack(universe_masks: Dict[str, object], names) -> jnp.ndarray:
+    global _UNIVERSE_STACK_CACHE
+    members = tuple(universe_masks[n] for n in names)
+    key = tuple(id(m) for m in members)
+    cached = _UNIVERSE_STACK_CACHE
+    if cached is not None and cached[0] == key:
+        return cached[2]
+    stacked = jnp.stack([jnp.asarray(m) for m in members])
+    _UNIVERSE_STACK_CACHE = (key, members, stacked)
+    return stacked
+
+
 class SpecSolve(NamedTuple):
     """Per-month Gram-solve leaves, spec-major."""
 
@@ -289,7 +313,7 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
     static_argnames=("nw_lags", "min_months", "weights", "firm_chunk", "guard"),
 )
 def _spec_grid_program(
-    y, x, universes, uidx, col_sel, window,
+    y, x, universes, uidx, col_sel, window, row_weights=None, *,
     nw_lags: int, min_months: int, weights: Tuple[str, ...],
     firm_chunk: Optional[int], guard: bool = False,
 ):
@@ -299,13 +323,29 @@ def _spec_grid_program(
     ``weights`` is a static tuple of NW weight schemes: the expensive
     panel contraction and solve run once, and each scheme adds only its
     own O(S·T·P) aggregation inside the same program (the scenario sweep
-    products over weight schemes without re-contracting the panel)."""
+    products over weight schemes without re-contracting the panel).
+    ``row_weights`` (optional (T, N)) is the coreset route's importance
+    weighting — ``None`` keeps the exact historical jaxpr."""
     PROGRAM_TRACES["specgrid_program"] += 1  # trace-time side effect
     from fm_returnprediction_tpu.telemetry import record_trace
 
     record_trace("specgrid_program")  # compile-event hook (registry + span)
     stats = contract_spec_grams(y, x, universes, uidx, col_sel, window,
-                                firm_chunk=firm_chunk)
+                                firm_chunk=firm_chunk,
+                                row_weights=row_weights)
+    return _solve_and_aggregate(
+        stats, col_sel, y.dtype,
+        nw_lags=nw_lags, min_months=min_months, weights=weights, guard=guard,
+    )
+
+
+def _solve_and_aggregate(
+    stats, col_sel, out_dtype, *,
+    nw_lags: int, min_months: int, weights: Tuple[str, ...], guard: bool,
+):
+    """Padded Gram solve + per-weight FM aggregation — the program tail the
+    fused single-device program and the spec-sharded mesh path share
+    (``specgrid.sharded`` jits this alone over spec-sharded stats)."""
     s_specs = col_sel.shape[0]
     sel_aug = jnp.concatenate(
         [jnp.ones((s_specs, 1), bool), col_sel], axis=1
@@ -315,7 +355,6 @@ def _spec_grid_program(
         sol, counters = solve_spec_stats(stats, sel_aug, guard=True)
     else:
         sol = solve_spec_stats(stats, sel_aug)
-    out_dtype = y.dtype
     # unselected predictor columns carry NaN: the FM summary's per-column
     # dropna then reports NaN coef/tstat there, and consumers slicing a
     # spec's own columns never see them
@@ -347,6 +386,8 @@ def run_spec_grid(
     grid: SpecGrid,
     referee: bool = True,
     firm_chunk: Optional[int] = None,
+    mesh=None,
+    row_weights=None,
 ) -> SpecGridResult:
     """Solve a whole spec grid from raw panel tensors.
 
@@ -355,11 +396,16 @@ def run_spec_grid(
     from a ``DensePanel``). ``universe_masks`` maps universe name →
     (T, N) bool. With ``referee=True`` (default) any spec containing a
     suspect month is re-solved by the per-cell batched-QR route, so its
-    numbers are EXACTLY the existing Table 2 path's.
+    numbers are EXACTLY the existing Table 2 path's. ``mesh`` (a
+    ``jax.sharding.Mesh``, or None for the bit-compatible single-device
+    default) routes the contraction and solve through the declarative
+    sharded path (``specgrid.sharded``); ``row_weights`` is the coreset
+    route's (T, N) importance weighting.
     """
     return run_spec_grid_weights(
         y, x, universe_masks, grid, (grid.weight,),
-        referee=referee, firm_chunk=firm_chunk,
+        referee=referee, firm_chunk=firm_chunk, mesh=mesh,
+        row_weights=row_weights,
     )[grid.weight]
 
 
@@ -371,30 +417,56 @@ def run_spec_grid_weights(
     weights: Tuple[str, ...],
     referee: bool = True,
     firm_chunk: Optional[int] = None,
+    mesh=None,
+    row_weights=None,
 ) -> Dict[str, SpecGridResult]:
     """``run_spec_grid`` for several NW weight schemes at once: the panel
     contraction and Gram solve run ONCE inside one program; each scheme
     only re-aggregates the tiny per-month series (``grid.weight`` is
-    ignored in favor of ``weights``)."""
+    ignored in favor of ``weights``).
+
+    With ``mesh=None`` (default) the single-device AOT program runs,
+    bit-compatible with every prior release. A ``mesh`` dispatches to the
+    sharded path: firm-sharded contraction (psum of the additive Gram
+    stats — the property the PR-3 tests pin) followed by a spec-sharded
+    solve, with every placement drawn from the declarative rule tables in
+    ``parallel.partition`` rather than hand-threaded specs.
+    """
     names = list(universe_masks)
     y = jnp.asarray(y)
     x = jnp.asarray(x)
-    universes = jnp.stack([jnp.asarray(universe_masks[n]) for n in names])
+    universes = _universe_stack(universe_masks, names)
     t = y.shape[0]
     uidx = jnp.asarray(grid.universe_index(names))
     col_sel = jnp.asarray(grid.column_selector())
     window_np = grid.window_masks(t)
+    if row_weights is not None:
+        row_weights = jnp.asarray(row_weights, x.dtype)
+        # the QR referee re-solves on the FULL panel — mixing it into a
+        # weighted (coreset) solve would splice two different estimands
+        # into one result frame; coreset cells disclose their suspect
+        # counts instead (``specgrid.engine``)
+        referee = False
 
     guard = _guardchk.guard_active()
-    program_args = (y, x, universes, uidx, col_sel, window_np)
-    exe = _compiled_grid_program(
-        program_args,
-        dict(
-            nw_lags=grid.nw_lags, min_months=grid.min_months,
-            weights=tuple(weights), firm_chunk=firm_chunk, guard=guard,
-        ),
+    static_kwargs = dict(
+        nw_lags=grid.nw_lags, min_months=grid.min_months,
+        weights=tuple(weights), firm_chunk=firm_chunk, guard=guard,
     )
-    out = jax.device_get(exe(*program_args))
+    if mesh is not None:
+        from fm_returnprediction_tpu.specgrid.sharded import (
+            sharded_grid_parts,
+        )
+
+        out = sharded_grid_parts(
+            y, x, universes, uidx, col_sel, jnp.asarray(window_np),
+            mesh=mesh, row_weights=row_weights, **static_kwargs,
+        )
+    else:
+        program_args = (y, x, universes, uidx, col_sel, window_np,
+                        row_weights)
+        exe = _compiled_grid_program(program_args, static_kwargs)
+        out = jax.device_get(exe(*program_args))
     if guard:
         cs, fms, suspect, guard_counters = out
         _guardchk.record("specgrid.grid_program", guard_counters)
@@ -406,6 +478,10 @@ def run_spec_grid_weights(
         flagged = [int(s) for s in np.nonzero(suspect_months > 0)[0]]
 
     out: Dict[str, SpecGridResult] = {}
+    # duplicate specs (the tile engine pads batches by repeating a spec)
+    # share one referee solve — without this, a suspect padded spec costs
+    # spec_pad full-panel QR re-solves per weight instead of one
+    referee_cache: Dict[tuple, tuple] = {}
     for w, fm in zip(weights, fms):
         slopes = np.array(cs.slopes)
         intercept = np.array(cs.intercept)
@@ -422,18 +498,23 @@ def run_spec_grid_weights(
         for s in flagged:
             spec = grid.specs[s]
             pos = grid.column_positions(spec)
-            mask = universes[uidx[s]] & jnp.asarray(window_np[s])[:, None]
-            PROGRAM_TRACES["specgrid_referee_calls"] += 1
-            from fm_returnprediction_tpu.telemetry import record_trace
+            cache_key = (w, spec.predictors, spec.universe, spec.window)
+            cached = referee_cache.get(cache_key)
+            if cached is None:
+                mask = universes[uidx[s]] & jnp.asarray(window_np[s])[:, None]
+                PROGRAM_TRACES["specgrid_referee_calls"] += 1
+                from fm_returnprediction_tpu.telemetry import record_trace
 
-            record_trace("specgrid_referee")  # compile-event hook
-            ref_cs, ref_fm = jax.device_get(
-                fama_macbeth(
-                    y, x[:, :, jnp.asarray(pos)], mask,
-                    nw_lags=grid.nw_lags, min_months=grid.min_months,
-                    weight=w, solver="qr",
+                record_trace("specgrid_referee")  # compile-event hook
+                cached = jax.device_get(
+                    fama_macbeth(
+                        y, x[:, :, jnp.asarray(pos)], mask,
+                        nw_lags=grid.nw_lags, min_months=grid.min_months,
+                        weight=w, solver="qr",
+                    )
                 )
-            )
+                referee_cache[cache_key] = cached
+            ref_cs, ref_fm = cached
             slopes[s] = np.nan
             slopes[s][:, pos] = ref_cs.slopes
             intercept[s] = ref_cs.intercept
@@ -465,6 +546,7 @@ def run_spec_grid_on_panel(
     return_col: str = "retx",
     referee: bool = True,
     firm_chunk: Optional[int] = None,
+    mesh=None,
 ) -> SpecGridResult:
     """``run_spec_grid`` with the union tensor sliced from a DensePanel."""
     y = jnp.asarray(panel.var(return_col))
@@ -472,4 +554,4 @@ def run_spec_grid_on_panel(
     needed = {s.universe for s in grid.specs}
     masks = {n: m for n, m in subset_masks.items() if n in needed}
     return run_spec_grid(y, x, masks, grid, referee=referee,
-                         firm_chunk=firm_chunk)
+                         firm_chunk=firm_chunk, mesh=mesh)

@@ -66,16 +66,33 @@ class Spec:
 
 @dataclasses.dataclass(frozen=True)
 class SpecGrid:
-    """An ordered batch of specs + the shared FM hyperparameters."""
+    """An ordered batch of specs + the shared FM hyperparameters.
+
+    ``union`` optionally PINS the union-column order (a superset of every
+    spec's predictors): the tile engine (``specgrid.engine``) slices one
+    union tensor for a whole ``CellSpace`` and solves it in fixed-width
+    spec batches, so every batch must agree on the column axis — and on
+    the program signature — regardless of which specs it happens to hold.
+    ``None`` keeps the historical first-seen derivation."""
 
     specs: Tuple[Spec, ...]
     nw_lags: int = 4
     min_months: int = 10
     weight: str = "reference"
+    union: Optional[Tuple[str, ...]] = None
 
     def __post_init__(self):
         if not self.specs:
             raise ValueError("a SpecGrid needs at least one spec")
+        if self.union is not None:
+            missing = {
+                c for s in self.specs for c in s.predictors
+            } - set(self.union)
+            if missing:
+                raise ValueError(
+                    f"pinned union is missing predictor columns "
+                    f"{sorted(missing)}"
+                )
 
     def __len__(self) -> int:
         return len(self.specs)
@@ -83,7 +100,10 @@ class SpecGrid:
     @property
     def union_predictors(self) -> List[str]:
         """Union of every spec's predictor columns, first-seen order — the
-        column order of the ``x`` tensor the engine contracts."""
+        column order of the ``x`` tensor the engine contracts (or the
+        pinned ``union`` when one was supplied)."""
+        if self.union is not None:
+            return list(self.union)
         union: List[str] = []
         for spec in self.specs:
             for col in spec.predictors:
@@ -207,18 +227,35 @@ def product_grid(
                     min_months=min_months, weight=weight)
 
 
-def resolve_route(route: Optional[str] = None, default: str = "gram") -> str:
+def resolve_route(
+    route: Optional[str] = None,
+    default: str = "gram",
+    allowed: Optional[Tuple[str, ...]] = None,
+) -> str:
     """The reporting-route flag: ``route=`` argument wins, then the
     ``FMRP_SPECGRID_ROUTE`` env var, then ``default``. "gram" solves the
     cells from shared Gram sufficient statistics (one fused program, no
     stacked designs); "stacked" is the pre-existing QR route under the
-    ``reporting.fusion`` split/fuse policy."""
+    ``reporting.fusion`` split/fuse policy; "coreset" is the gram route on
+    a sampled-and-reweighted panel (``specgrid.coreset`` — a disclosed
+    approximation tier for grids whose exact contraction exceeds the
+    memory/compute budget; the reporting entry points reject it, only the
+    scenario engine accepts the approximation)."""
     import os
 
     if route is None:
         route = os.environ.get("FMRP_SPECGRID_ROUTE", default)
-    if route not in ("gram", "stacked"):
+    if route not in ("gram", "stacked", "coreset"):
         raise ValueError(
-            f"route={route!r}: expected 'gram' or 'stacked'"
+            f"route={route!r}: expected 'gram', 'stacked' or 'coreset'"
+        )
+    if allowed is not None and route not in allowed:
+        # paper-parity surfaces (Table 2, the figure sweep) must fail loudly
+        # rather than silently approximate when FMRP_SPECGRID_ROUTE=coreset
+        # leaks in from a scenario-sweep environment
+        raise ValueError(
+            f"route={route!r} is not available here (allowed: {allowed}) — "
+            "the coreset tier is a disclosed approximation for the scenario "
+            "engine, not the parity reporting paths"
         )
     return route

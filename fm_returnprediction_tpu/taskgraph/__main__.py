@@ -29,6 +29,16 @@ def main(argv=None) -> int:
                              "(failures recorded in the state DB)")
     parser.add_argument("--synthetic", action="store_true",
                         help="use the synthetic fake-WRDS backend")
+    parser.add_argument("--specgrid-cells", type=int, default=None,
+                        metavar="N",
+                        help="scale the specgrid task's sweep to at least "
+                             "N cells (bootstrap-draw dimension grows; "
+                             "tiles stream so memory stays bounded)")
+    parser.add_argument("--specgrid-sink", default=None,
+                        choices=["frame", "topk", "summary", "parquet"],
+                        help="specgrid task streaming sink (default "
+                             "follows FMRP_SPECGRID_SINK, else the full "
+                             "tidy frame)")
     parser.add_argument("--notebooks", action="store_true",
                         help="include the notebook conversion/execution tasks")
     parser.add_argument("--db", default=None, help="state db path")
@@ -50,7 +60,9 @@ def main(argv=None) -> int:
     apply_backend(args.backend)
     enable_compilation_cache()
 
-    tasks = build_tasks(synthetic=args.synthetic)
+    tasks = build_tasks(synthetic=args.synthetic,
+                        specgrid_cells=args.specgrid_cells,
+                        specgrid_sink=args.specgrid_sink)
     if args.notebooks:
         tasks += build_notebook_tasks()
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"

@@ -290,13 +290,49 @@ def _serve_state(processed_dir: Path) -> None:
     )
 
 
-def _specgrid(processed_dir: Path, output_dir: Path) -> None:
+SPECGRID_KNOBS_FILE = "specgrid_scenarios.knobs.json"
+
+
+def _specgrid_effective_knobs(cells: Optional[int],
+                              sink: Optional[str]) -> dict:
+    """The knobs that shape the artifact: cell count + RESOLVED sink name
+    (CLI argument or ``FMRP_SPECGRID_SINK`` — tile width is excluded
+    deliberately, tiling is pinned bit-identical on the frame)."""
+    from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
+
+    return {"cells": cells, "sink": resolve_sink_name(sink)}
+
+
+def _specgrid_knobs_unchanged(output_dir: Path, cells: Optional[int],
+                              sink: Optional[str]) -> bool:
+    """``uptodate`` check: the cached CSV only counts as current when the
+    knobs it was BUILT under (sidecar written by ``_specgrid``) match this
+    invocation's effective knobs — a knob change in either direction
+    (default→topk or topk→default) re-runs; without this, a leaderboard
+    CSV would be served as the tidy scenario frame by a later default
+    run. A missing sidecar reads as a default-knob build (pre-sidecar
+    artifacts were only ever default)."""
+    want = _specgrid_effective_knobs(cells, sink)
+    try:
+        with open(Path(output_dir) / SPECGRID_KNOBS_FILE) as f:
+            have = json.load(f)
+    except (OSError, ValueError):
+        have = {"cells": None, "sink": "frame"}
+    return have == want
+
+
+def _specgrid(processed_dir: Path, output_dir: Path,
+              cells: Optional[int] = None,
+              sink: Optional[str] = None) -> None:
     """Panel checkpoint → spec-grid robustness sweep CSV.
 
-    Runs the Gram-contraction scenario grids (``specgrid.run_scenarios``:
-    subperiod halves × the three size universes × all models) and writes
-    the tidy result frame. Compute is replicated on every process (same
-    contract as ``_reports``); only the primary writes."""
+    Runs the scenario grids (``specgrid.run_scenarios``: subperiod halves
+    × the three size universes × all models) through the lazy tile engine
+    and writes the sink's result frame. ``cells`` scales the sweep to a
+    pod-scale cell count via bootstrap draws; ``sink`` picks the streaming
+    aggregation (``--specgrid-cells``/``--specgrid-sink`` on the CLI).
+    Compute is replicated on every process (same contract as
+    ``_reports``); only the primary writes."""
     from fm_returnprediction_tpu.panel.dense import DensePanel
     from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
     from fm_returnprediction_tpu.specgrid import run_scenarios
@@ -306,18 +342,28 @@ def _specgrid(processed_dir: Path, output_dir: Path) -> None:
     with open(processed_dir / FACTORS_FILE) as f:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
-    frame = run_scenarios(panel, masks, factors_dict)
+    frame = run_scenarios(panel, masks, factors_dict, cells=cells,
+                          sink=sink, output_dir=output_dir)
 
     from fm_returnprediction_tpu.guard import checks as _guard_checks
     from fm_returnprediction_tpu.guard import contracts as _contracts
+    from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
 
-    if _guard_checks.guard_active():
+    if _guard_checks.guard_active() and resolve_sink_name(sink) == "frame":
+        # non-frame sinks (argument- OR env-selected) emit their own
+        # schema (leaderboard, moment table, part manifest) — only the
+        # tidy frame carries the contract
         _contracts.check_frame(frame, "specgrid_scenarios")
     output_dir.mkdir(parents=True, exist_ok=True)
-    _primary_writes(
-        "specgrid_saved",
-        lambda: frame.to_csv(output_dir / SPECGRID_FILE, index=False),
-    )
+
+    def _save() -> None:
+        frame.to_csv(output_dir / SPECGRID_FILE, index=False)
+        # sidecar: the knobs this artifact was built under, read by the
+        # task's uptodate check (_specgrid_knobs_unchanged)
+        with open(output_dir / SPECGRID_KNOBS_FILE, "w") as f:
+            json.dump(_specgrid_effective_knobs(cells, sink), f)
+
+    _primary_writes("specgrid_saved", _save)
 
 
 def _parity(raw_dir: Path, output_dir: Path) -> None:
@@ -360,6 +406,8 @@ def build_tasks(
     raw_dir: Optional[Path] = None,
     processed_dir: Optional[Path] = None,
     output_dir: Optional[Path] = None,
+    specgrid_cells: Optional[int] = None,
+    specgrid_sink: Optional[str] = None,
 ) -> List[Task]:
     """Assemble the DAG against the configured directory tree."""
     raw_dir = Path(raw_dir or config("RAW_DATA_DIR"))
@@ -417,12 +465,23 @@ def build_tasks(
         ),
         Task(
             name="specgrid",
-            actions=[lambda: _specgrid(processed_dir, output_dir)],
+            actions=[lambda: _specgrid(processed_dir, output_dir,
+                                       cells=specgrid_cells,
+                                       sink=specgrid_sink)],
             # reads only the panel checkpoint — a reports-only refresh
             # must not re-run the scenario sweep
             file_dep=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
             targets=[output_dir / SPECGRID_FILE],
             task_dep=["build_panel"],
+            # knob-aware staleness: the artifact only counts as current
+            # when the knobs it was built under (sidecar) match this
+            # invocation's effective --specgrid-cells/--specgrid-sink/
+            # FMRP_SPECGRID_SINK — a change in EITHER direction re-runs
+            uptodate=[
+                lambda: _specgrid_knobs_unchanged(
+                    output_dir, specgrid_cells, specgrid_sink
+                )
+            ],
             doc="Panel checkpoint → Gram spec-grid robustness sweep CSV",
         ),
         Task(

@@ -12,8 +12,9 @@ gateable report:
   ``real_pipeline_stage_s.table_2``);
 - series are classified by direction from their naming convention
   (``*_s``/``*_ms``/``*_mb``/``*_pct`` lower-is-better; ``*_qps``/
-  ``*speedup*``/``*rows_per_s``/``vs_baseline`` higher-is-better;
-  anything else is reported but never gated);
+  ``*speedup*``/``*_per_s`` throughputs (rows_per_s, cells_per_s)/
+  ``vs_baseline`` higher-is-better — the throughput check precedes the
+  ``*_s`` seconds check; anything else is reported but never gated);
 - per series, the **noise band** is fitted from the history itself: the
   robust scale of the *worsening* consecutive steps (improvements are
   the expected trajectory, not noise), floored at ``floor_rel`` (25%).
@@ -127,7 +128,7 @@ def direction(key: str) -> Optional[str]:
     if (
         leaf.endswith("_qps")
         or "speedup" in leaf
-        or leaf.endswith("rows_per_s")
+        or leaf.endswith("_per_s")  # rows_per_s, cells_per_s, ... throughput
         or leaf == "vs_baseline"
     ):
         return "higher"

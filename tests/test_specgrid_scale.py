@@ -1,0 +1,550 @@
+"""Pod-scale spec-grid: CellSpace tiling, sinks, sharding rules, coreset.
+
+The ISSUE-8 contracts:
+
+- tile-boundary equality — the streamed full-frame sink is BIT-IDENTICAL
+  to the materialized (one-tile) route, whatever the tile width (per-spec
+  independence of the fused program, pinned end to end through the frame);
+- sharded-vs-single-device differential on the virtual CPU mesh, with the
+  placements coming from ``parallel.partition``'s declarative rules;
+- top-k sink determinism under tie values and across tile widths;
+- coreset route disclosure fields (rate/m/suspect counts) + determinism;
+- the lazy enumeration itself: index addressing, tiling coverage, and the
+  one-compiled-program discipline of the tile engine.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from fm_returnprediction_tpu.specgrid import (
+    CellSpace,
+    FrameSink,
+    SummarySink,
+    TopKSink,
+    block_bootstrap_months,
+    program_trace_counts,
+    resolve_sink,
+    run_cellspace,
+    run_spec_grid,
+    scenario_space,
+    specgrid_mesh,
+)
+from fm_returnprediction_tpu.specgrid.cellspace import resolve_tile_cells
+
+pytestmark = [pytest.mark.specgrid, pytest.mark.specgrid_scale]
+
+
+def _panel(rng, t=36, n=120, p=6, nan_frac=0.05):
+    x = rng.standard_normal((t, n, p))
+    beta = rng.standard_normal(p) * 0.1
+    y = x @ beta + 0.2 * rng.standard_normal((t, n))
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan)
+    x[rng.random((t, n, p)) < nan_frac] = np.nan
+    size = rng.random(n)
+    masks = {
+        "All": mask,
+        "Big": mask & (size > 0.4)[None, :],
+    }
+    return y, x, masks
+
+
+def _space(p=6, **kw):
+    names = tuple(f"x{i}" for i in range(p))
+    defaults = dict(
+        regressor_sets=(("m2", names[:2]), ("m4", names[:4]), ("m6", names)),
+        universes=("All", "Big"),
+        windows=(("full", None), ("late", (18, 36))),
+    )
+    defaults.update(kw)
+    return CellSpace(**defaults)
+
+
+# -- CellSpace addressing ---------------------------------------------------
+
+def test_cellspace_indexing_roundtrip():
+    space = _space(winsor_levels=(1.0, 5.0), weights=("reference", "textbook"),
+                   bootstrap=3)
+    assert len(space) == 2 * 2 * 3 * 2 * 2 * 3
+    # decode every index once; the dimension odometer must roll
+    # innermost-last and never repeat
+    seen = set()
+    for i in range(len(space)):
+        c = space.cell(i)
+        key = (c.winsor, c.weight, c.set_name, c.universe, c.window_name,
+               c.draw)
+        assert key not in seen
+        seen.add(key)
+        assert c.index == i
+    assert len(seen) == len(space)
+    # outermost dimension is winsor: the first half of the space is level 1
+    assert all(space.cell(i).winsor == 1.0 for i in range(len(space) // 2))
+    with pytest.raises(IndexError):
+        space.cell(len(space))
+
+
+def test_cellspace_tiles_cover_exactly_once():
+    space = _space(bootstrap=2)
+    for width in (1, 7, 64, 10_000):
+        tiles = list(space.tiles(width))
+        idx = [c.index for t in tiles for c in t.cells()]
+        assert idx == list(range(len(space)))
+        assert all(len(t) <= width for t in tiles)
+
+
+def test_cellspace_spec_index_shared_across_draws_and_weights():
+    space = _space(weights=("reference", "textbook"), bootstrap=4)
+    by_spec = {}
+    for i in range(len(space)):
+        c = space.cell(i)
+        sid = space.spec_index(i)
+        key = (c.set_name, c.universe, c.window_name)
+        by_spec.setdefault(sid, set()).add(key)
+    # one spec id ↔ one (set, universe, window) triple
+    assert all(len(v) == 1 for v in by_spec.values())
+    assert len(by_spec) == space.n_specs
+
+
+def test_resolve_tile_cells_env(monkeypatch):
+    assert resolve_tile_cells(64) == 64
+    monkeypatch.setenv("FMRP_SPECGRID_TILE", "33")
+    assert resolve_tile_cells() == 33
+    with pytest.raises(ValueError):
+        resolve_tile_cells(0)
+
+
+# -- tile-boundary equality -------------------------------------------------
+
+def test_streamed_frame_bit_identical_to_materialized():
+    """The acceptance bit-identity: any tile width through the FrameSink
+    equals the one-tile materialized run EXACTLY — per-spec independence
+    of the fused program carried through sinks and frame assembly."""
+    rng = np.random.default_rng(11)
+    y, x, masks = _panel(rng)
+    space = _space(weights=("reference", "textbook"), bootstrap=2)
+    ref, ref_stats = run_cellspace(y, x, masks, space,
+                                   sink="frame", tile_cells=len(space),
+                                   mask=masks["All"])
+    assert ref_stats["tiles"] == 1
+    for width in (5, 16, 50):
+        got, stats = run_cellspace(y, x, masks, space, sink="frame",
+                                   tile_cells=width, mask=masks["All"])
+        # tile width rounds up to a draw-run multiple so a spec's draws
+        # never straddle tiles (no re-contraction of a straddled spec)
+        effective = min(len(space),
+                        -(-width // space.bootstrap) * space.bootstrap)
+        assert stats["tile_cells"] == effective
+        assert stats["tiles"] == -(-len(space) // effective)
+        pd.testing.assert_frame_equal(got, ref)
+
+
+def test_tile_engine_single_compiled_program():
+    """A multi-tile sweep costs ONE fused-program trace (fixed spec_pad,
+    pinned union, full static weight tuple) — the compile discipline the
+    bench's recompile_watch enforces at scale."""
+    rng = np.random.default_rng(13)
+    y, x, masks = _panel(rng, nan_frac=0.0)
+    space = _space(bootstrap=2)
+    before = program_trace_counts()
+    _, stats = run_cellspace(y, x, masks, space, sink="summary",
+                             tile_cells=7, mask=masks["All"])
+    after = program_trace_counts()
+    assert stats["tiles"] >= 3
+    assert (after.get("specgrid_program", 0)
+            - before.get("specgrid_program", 0)) == 1
+
+
+def test_run_scenarios_rides_the_tile_engine():
+    """``run_scenarios`` output through the lazy engine: same tidy schema,
+    winsor-major row order, and the cell address column."""
+    rng = np.random.default_rng(17)
+
+    class _MiniPanel:
+        def __init__(self, y, x, mask, names):
+            self._y, self._x, self.mask = y, x, mask
+            self._names = names
+            self.months = np.arange(y.shape[0])
+
+        def var(self, name):
+            return self._y
+
+        def select(self, cols):
+            idx = [self._names.index(c) for c in cols]
+            return self._x[:, :, idx]
+
+    from fm_returnprediction_tpu.models.lewellen import ModelSpec
+    from fm_returnprediction_tpu.specgrid import run_scenarios
+
+    y, x, masks = _panel(rng, p=3)
+    panel = _MiniPanel(y, x, masks["All"], ["c0", "c1", "c2"])
+    variables = {"V0": "c0", "V1": "c1", "V2": "c2"}
+    models = [ModelSpec("Model A", ["V0", "V1"]),
+              ModelSpec("Model B", ["V0", "V1", "V2"])]
+    frame, stats = run_scenarios(
+        panel, masks, variables, models=models, universes=["All", "Big"],
+        subperiods=2, tile_cells=4, return_stats=True,
+    )
+    assert stats["tiles"] == -(-stats["cells"] // 4)
+    # 2 models × 2 universes × 3 windows, rows = Σ predictors per model
+    assert len(frame) == 2 * 3 * (2 + 3)
+    assert list(frame["cell"]) == sorted(frame["cell"])
+    # cells=N scales the draw dimension until the space covers N
+    big, big_stats = run_scenarios(
+        panel, masks, variables, models=models, universes=["All", "Big"],
+        subperiods=2, cells=100, sink="summary", return_stats=True,
+    )
+    assert big_stats["cells"] >= 100
+    assert {"column", "count", "mean"} <= set(big.columns)
+
+
+# -- sinks ------------------------------------------------------------------
+
+def test_topk_sink_deterministic_under_ties():
+    """Exact tie values resolve by the cell's global address, so any tile
+    width — and any consume order of equal values — yields the same
+    board."""
+    cols = ["cell", "model", "predictor", "tstat"]
+    rows = [
+        [0, "a", "p0", 2.0], [1, "a", "p0", -2.0], [2, "a", "p0", 2.0],
+        [3, "a", "p0", 1.0], [4, "a", "p0", -3.0], [5, "a", "p0", 2.0],
+    ]
+    frame = pd.DataFrame(rows, columns=cols)
+    boards = []
+    for split in (1, 2, 3, 6):
+        sink = TopKSink(k=4)
+        for start in range(0, len(frame), split):
+            sink.consume(frame.iloc[start:start + split].reset_index(drop=True))
+        boards.append(sink.finish())
+    for b in boards[1:]:
+        pd.testing.assert_frame_equal(b, boards[0])
+    board = boards[0]
+    # |t|: 3.0 first, then the 2.0 ties in cell order (0, 1, 2)
+    assert list(board["cell"]) == [4, 0, 1, 2]
+    # NaN metrics never enter the board
+    sink = TopKSink(k=10)
+    sink.consume(pd.DataFrame([[9, "a", "p0", np.nan]], columns=cols))
+    assert len(sink.finish()) == 0
+
+
+def test_summary_sink_matches_full_frame_moments():
+    rng = np.random.default_rng(19)
+    y, x, masks = _panel(rng)
+    space = _space()
+    full, _ = run_cellspace(y, x, masks, space, sink="frame",
+                            mask=masks["All"])
+    summary, _ = run_cellspace(y, x, masks, space, sink="summary",
+                               tile_cells=9, mask=masks["All"])
+    row = summary.set_index("column").loc["tstat"]
+    ref = full["tstat"].to_numpy()
+    fin = np.isfinite(ref)
+    assert row["count"] == fin.sum()
+    np.testing.assert_allclose(row["mean"], ref[fin].mean(), rtol=1e-12)
+    np.testing.assert_allclose(row["std"], ref[fin].std(ddof=1), rtol=1e-10)
+    np.testing.assert_allclose(row["min"], ref[fin].min(), rtol=1e-12)
+
+
+def test_parquet_sink_spills_parts(tmp_path):
+    rng = np.random.default_rng(23)
+    y, x, masks = _panel(rng)
+    space = _space()
+    manifest, stats = run_cellspace(
+        y, x, masks, space, sink="parquet", tile_cells=10,
+        mask=masks["All"], output_dir=tmp_path,
+    )
+    assert len(manifest) == stats["tiles"]
+    assert manifest["rows"].sum() == stats["rows"]
+    parts = [pd.read_parquet(p) if str(p).endswith("parquet")
+             else pd.read_csv(p) for p in manifest["path"]]
+    whole = pd.concat(parts, ignore_index=True)
+    full, _ = run_cellspace(y, x, masks, space, sink="frame",
+                            mask=masks["All"])
+    assert len(whole) == len(full)
+    np.testing.assert_allclose(whole["coef"], full["coef"], rtol=0, atol=0)
+
+
+def test_resolve_sink_env(monkeypatch):
+    from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
+
+    assert isinstance(resolve_sink(None), FrameSink)
+    assert resolve_sink_name(None) == "frame"
+    monkeypatch.setenv("FMRP_SPECGRID_SINK", "topk")
+    assert isinstance(resolve_sink(None), TopKSink)
+    # the name resolver must see the env-selected sink too — guard gates
+    # on it to skip the tidy-frame contract for non-frame schemas
+    assert resolve_sink_name(None) == "topk"
+    assert resolve_sink_name(SummarySink()) == "summary"
+    assert isinstance(resolve_sink("summary"), SummarySink)
+    s = SummarySink()
+    assert resolve_sink(s) is s
+    with pytest.raises(ValueError):
+        resolve_sink("parquet")  # needs an output dir
+    with pytest.raises(ValueError):
+        resolve_sink("nope")
+
+
+# -- bootstrap draws --------------------------------------------------------
+
+def test_bootstrap_draws_deterministic_and_distinct():
+    rng = np.random.default_rng(29)
+    y, x, masks = _panel(rng)
+    space = _space(bootstrap=4)
+    f1, _ = run_cellspace(y, x, masks, space, sink="frame",
+                          mask=masks["All"], seed=7)
+    f2, _ = run_cellspace(y, x, masks, space, sink="frame",
+                          mask=masks["All"], seed=7)
+    pd.testing.assert_frame_equal(f1, f2)
+    # draw 0 is the point estimate; other draws move the coef
+    one = f1[(f1.model == "m4") & (f1.universe == "All")
+             & (f1.window == "full") & (f1.predictor == "x0")]
+    assert len(one) == 4
+    assert one[one.draw == 0]["coef"].notna().all()
+    assert one["coef"].nunique() > 1
+    # the resample itself is deterministic and covers T indices
+    idx = block_bootstrap_months(36, draw=1, seed=7)
+    np.testing.assert_array_equal(idx, block_bootstrap_months(36, 1, seed=7))
+    assert idx.shape == (36,) and idx.min() >= 0 and idx.max() < 36
+    with pytest.raises(ValueError):
+        block_bootstrap_months(36, draw=0)
+
+
+def test_draw_zero_matches_no_bootstrap_run():
+    """Adding the draw dimension must not move the point estimates."""
+    rng = np.random.default_rng(31)
+    y, x, masks = _panel(rng)
+    space1 = _space()
+    space4 = _space(bootstrap=4)
+    f1, _ = run_cellspace(y, x, masks, space1, sink="frame",
+                          mask=masks["All"])
+    f4, _ = run_cellspace(y, x, masks, space4, sink="frame",
+                          mask=masks["All"])
+    point = f4[f4.draw == 0].drop(columns=["cell", "draw"]).reset_index(
+        drop=True)
+    base = f1.drop(columns=["cell"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(point, base)
+
+
+# -- sharded solve ----------------------------------------------------------
+
+def test_partition_rules_match_and_unmatched_raises():
+    from jax.sharding import PartitionSpec as P
+
+    from fm_returnprediction_tpu.parallel.partition import (
+        match_partition_rules,
+        specgrid_panel_rules,
+        specgrid_stats_rules,
+    )
+
+    tree = {
+        "y": np.zeros((4, 8)), "x": np.zeros((4, 8, 3)),
+        "universes": np.zeros((2, 4, 8)), "uidx": np.zeros(5),
+        "col_sel": np.zeros((5, 3)), "window": np.zeros((5, 4)),
+        "scalar": np.float64(1.0),
+    }
+    specs = match_partition_rules(specgrid_panel_rules("cells"), tree)
+    assert specs["y"] == P(None, "cells")
+    assert specs["x"] == P(None, "cells", None)
+    assert specs["universes"] == P(None, None, "cells")
+    assert specs["uidx"] == P()
+    assert specs["scalar"] == P()  # scalars never partition
+    stats = match_partition_rules(
+        specgrid_stats_rules("cells"),
+        {"gram": np.zeros((5, 4, 4, 4)), "center": np.zeros((4, 3))},
+    )
+    assert stats["gram"] == P("cells")
+    assert stats["center"] == P()
+    with pytest.raises(ValueError, match="partition rule not found"):
+        match_partition_rules(specgrid_panel_rules(), {"mystery": np.zeros(9)})
+
+
+def test_sharded_solve_matches_single_device():
+    """The acceptance differential: the mesh route (declared partition
+    rules, psum'd firm contraction, spec-sharded solve) matches the
+    single-device route to the PR-3 tolerances on the virtual CPU mesh —
+    including a padded spec count (S=6 over 8 devices exercises the ghost
+    specs)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-virtual-device CPU backend")
+    from fm_returnprediction_tpu.specgrid import Spec, SpecGrid
+
+    rng = np.random.default_rng(37)
+    y, x, masks = _panel(rng, t=30, n=96, p=5)
+    names = [f"x{i}" for i in range(5)]
+    grid = SpecGrid(tuple(
+        Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in (2, 5) for u in masks
+    ) + (Spec("late | All", tuple(names[:3]), "All", window=(10, 30)),))
+    mesh = specgrid_mesh(len(jax.devices()))
+    single = run_spec_grid(y, x, masks, grid)
+    shard = run_spec_grid(y, x, masks, grid, mesh=mesh)
+    for field in ("coef", "tstat", "nw_se", "mean_r2", "mean_n",
+                  "slopes", "intercept", "r2", "n_obs"):
+        a = np.asarray(getattr(single, field), float)
+        b = np.asarray(getattr(shard, field), float)
+        both_nan = np.isnan(a) & np.isnan(b)
+        np.testing.assert_allclose(
+            np.where(both_nan, 0.0, a), np.where(both_nan, 0.0, b),
+            rtol=1e-6, atol=1e-6, err_msg=field,
+        )
+    np.testing.assert_array_equal(single.month_valid, shard.month_valid)
+    np.testing.assert_array_equal(single.n_months, shard.n_months)
+
+
+def test_sharded_engine_sweep_matches_single_device_sweep():
+    """End to end through the tile engine: a mesh-routed sweep equals the
+    single-device sweep to solver tolerance, frame for frame."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-virtual-device CPU backend")
+    rng = np.random.default_rng(41)
+    y, x, masks = _panel(rng, nan_frac=0.0)
+    space = _space()
+    mesh = specgrid_mesh(len(jax.devices()))
+    f_single, _ = run_cellspace(y, x, masks, space, sink="frame",
+                                mask=masks["All"])
+    f_mesh, _ = run_cellspace(y, x, masks, space, sink="frame",
+                              mask=masks["All"], mesh=mesh)
+    assert list(f_single.columns) == list(f_mesh.columns)
+    for col in ("coef", "tstat", "nw_se", "mean_r2"):
+        a = f_single[col].to_numpy()
+        b = f_mesh[col].to_numpy()
+        both_nan = np.isnan(a) & np.isnan(b)
+        np.testing.assert_allclose(
+            np.where(both_nan, 0, a), np.where(both_nan, 0, b),
+            rtol=1e-6, atol=1e-6, err_msg=col,
+        )
+
+
+def test_resolve_specgrid_mesh_env(monkeypatch):
+    from fm_returnprediction_tpu.specgrid import resolve_specgrid_mesh
+
+    monkeypatch.delenv("FMRP_SPECGRID_MESH", raising=False)
+    assert resolve_specgrid_mesh(None) is None
+    monkeypatch.setenv("FMRP_SPECGRID_MESH", "0")
+    assert resolve_specgrid_mesh(None) is None
+    if len(jax.devices()) >= 2:
+        monkeypatch.setenv("FMRP_SPECGRID_MESH", "2")
+        mesh = resolve_specgrid_mesh(None)
+        assert mesh is not None and mesh.devices.size == 2
+        monkeypatch.setenv("FMRP_SPECGRID_MESH", "auto")
+        assert resolve_specgrid_mesh(None).devices.size == len(jax.devices())
+    explicit = specgrid_mesh(1)
+    assert resolve_specgrid_mesh(explicit) is explicit
+
+
+# -- coreset route ----------------------------------------------------------
+
+def test_coreset_route_disclosure_and_determinism():
+    rng = np.random.default_rng(43)
+    y, x, masks = _panel(rng, n=300, nan_frac=0.0)
+    space = _space(p=6)
+    f, stats = run_cellspace(y, x, masks, space, sink="frame",
+                             mask=masks["All"], route="coreset",
+                             coreset_m=128, seed=5)
+    assert stats["route"] == "coreset"
+    assert stats["coreset_m"] == 128
+    assert {"route", "coreset_m", "coreset_rate", "suspect_months"} <= set(
+        f.columns
+    )
+    assert (f["route"] == "coreset").all()
+    assert (f["coreset_m"] == 128).all()
+    assert ((f["coreset_rate"] > 0) & (f["coreset_rate"] <= 1)).all()
+    # the referee is structurally off on the approximation tier
+    assert not f["refereed"].any()
+    f2, _ = run_cellspace(y, x, masks, space, sink="frame",
+                          mask=masks["All"], route="coreset",
+                          coreset_m=128, seed=5)
+    pd.testing.assert_frame_equal(f, f2)
+
+
+def test_coreset_estimates_approach_exact_with_budget():
+    """The unbiasedness story: a generous draw budget lands near the exact
+    route; months with fewer valid rows than m stay exactly equal."""
+    rng = np.random.default_rng(47)
+    y, x, masks = _panel(rng, n=250, nan_frac=0.0)
+    space = _space(p=6, regressor_sets=(("m4", tuple(f"x{i}" for i in range(4))),),
+                   universes=("All",), windows=(("full", None),))
+    x4 = x[:, :, :4]
+    exact, _ = run_cellspace(y, x4, masks, space, sink="frame",
+                             mask=masks["All"])
+    approx, _ = run_cellspace(y, x4, masks, space, sink="frame",
+                              mask=masks["All"], route="coreset",
+                              coreset_m=200, seed=3)
+    np.testing.assert_allclose(approx["coef"], exact["coef"],
+                               rtol=0.5, atol=0.02)
+    # m >= every month's width → the plan is exact and so are the numbers
+    from fm_returnprediction_tpu.specgrid import coreset_plan
+
+    plan = coreset_plan(y, x4, masks["All"], m_per_month=10_000, seed=0)
+    assert plan.exact_months == y.shape[0]
+    ex2, _ = run_cellspace(y, x4, masks, space, sink="frame",
+                           mask=masks["All"], route="coreset",
+                           coreset_m=10_000)
+    for col in ("coef", "tstat", "mean_r2"):
+        np.testing.assert_allclose(ex2[col], exact[col], rtol=1e-10,
+                                   atol=1e-12, err_msg=col)
+
+
+def test_coreset_rejected_by_reporting_routes():
+    from fm_returnprediction_tpu.specgrid import resolve_route
+
+    assert resolve_route("coreset") == "coreset"
+    with pytest.raises(ValueError, match="not available here"):
+        resolve_route("coreset", allowed=("gram", "stacked"))
+
+
+def test_taskgraph_specgrid_knob_staleness(tmp_path, monkeypatch):
+    """The specgrid task's uptodate gate: a knob change in EITHER
+    direction (incl. env-selected sinks) invalidates the cached artifact;
+    matching knobs — and legacy sidecar-less default builds — stay
+    current."""
+    import json
+
+    from fm_returnprediction_tpu.taskgraph.tasks import (
+        SPECGRID_KNOBS_FILE,
+        _specgrid_effective_knobs,
+        _specgrid_knobs_unchanged,
+    )
+
+    monkeypatch.delenv("FMRP_SPECGRID_SINK", raising=False)
+    # no sidecar: default invocation current, knobbed invocation stale
+    assert _specgrid_knobs_unchanged(tmp_path, None, None)
+    assert not _specgrid_knobs_unchanged(tmp_path, 1000, None)
+    # env-selected sink counts as a knob even with no CLI args
+    monkeypatch.setenv("FMRP_SPECGRID_SINK", "topk")
+    assert not _specgrid_knobs_unchanged(tmp_path, None, None)
+    # sidecar round-trip: built-under knobs must match exactly
+    with open(tmp_path / SPECGRID_KNOBS_FILE, "w") as f:
+        json.dump(_specgrid_effective_knobs(5000, "topk"), f)
+    assert _specgrid_knobs_unchanged(tmp_path, 5000, "topk")
+    assert not _specgrid_knobs_unchanged(tmp_path, 5000, "summary")
+    monkeypatch.delenv("FMRP_SPECGRID_SINK")
+    # back-to-default after a knobbed build is ALSO stale
+    assert not _specgrid_knobs_unchanged(tmp_path, None, None)
+
+
+# -- tier-2: the scale sweep ------------------------------------------------
+
+@pytest.mark.slow
+def test_scale_sweep_streams_bounded():
+    """Tier-2: a ~2·10⁴-cell sweep through the top-k sink — completes,
+    covers every cell exactly once, keeps the full frame unmaterialized,
+    and costs one fused-program trace."""
+    rng = np.random.default_rng(53)
+    y, x, masks = _panel(rng, t=48, n=200, p=6)
+    base = _space(weights=("reference",))
+    space = dataclasses.replace(base, bootstrap=-(-20_000 // base.n_specs))
+    assert len(space) >= 20_000
+    sink = TopKSink(k=32)
+    before = program_trace_counts()
+    board, stats = run_cellspace(y, x, masks, space, sink=sink,
+                                 tile_cells=512, mask=masks["All"])
+    after = program_trace_counts()
+    assert stats["cells"] == len(space)
+    assert sink.cells_seen == len(space)
+    assert len(board) == 32
+    assert board["tstat"].abs().is_monotonic_decreasing
+    assert (after.get("specgrid_program", 0)
+            - before.get("specgrid_program", 0)) == 1
