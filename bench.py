@@ -1221,6 +1221,192 @@ def _bench_guard(fast: bool):
     }
 
 
+def _bench_registry(fast: bool):
+    """The registry's executable plane as a tracked series (ROADMAP item
+    5): cold compile-and-store vs cold-WITH-registry fetch for the same
+    program set, and the ledger's fresh-vs-deserialized provenance split
+    so the compile seconds the registry saves are a number the regress
+    sentinel watches, not a one-off claim.
+
+    - ``registry_cold_compile_s``   — warm-up of every serving bucket with
+      an EMPTY registry armed (lower+compile, entries stored). Contains
+      "compile" so the regress sentinel reports without gating (the wall
+      swings with persistent-cache state, like every compile series).
+    - ``registry_warm_fetch_s``     — the same warm-up in a fresh executor
+      against the POPULATED registry: every program deserializes, nothing
+      traces or compiles (asserted: fresh==0, trace growth==0, and a
+      repeat under ``recompile_watch(warm=True)`` growing nothing).
+    - ``registry_programs_per_s``   — fetch throughput (higher-is-better
+      series for the sentinel).
+    - ``registry_compile_s_saved``  — store-time compile seconds the fetch
+      did NOT pay (the ledger's ``saved_s`` sum).
+    - ``registry_provenance``       — per-program fresh/deserialized counts
+      and seconds (``telemetry.perf.provenance_summary``).
+
+    FMRP_BENCH_REGISTRY=0 skips."""
+    if os.environ.get("FMRP_BENCH_REGISTRY", "1") == "0":
+        return {}
+    import tempfile
+
+    from fm_returnprediction_tpu.registry import Registry, warm_from_registry
+    from fm_returnprediction_tpu.registry.store import using_registry
+    from fm_returnprediction_tpu.serving.executor import BucketedExecutor
+    from fm_returnprediction_tpu.serving.state import build_serving_state
+    from fm_returnprediction_tpu.telemetry import cost_ledger, recompile_watch
+    from fm_returnprediction_tpu.telemetry.perf import provenance_summary
+
+    t, n, p = (60, 64, 3) if fast else (240, 512, 5)
+    rng = np.random.default_rng(2014)
+    y = rng.standard_normal((t, n)).astype(np.float32)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    mask = np.ones((t, n), bool)
+    state = build_serving_state(y, x, mask, window=24, min_periods=12)
+
+    ledger = cost_ledger()
+    with tempfile.TemporaryDirectory() as td:
+        with using_registry(td):
+            seq0 = ledger.last_seq
+            with _timed("bench.registry_cold") as cold:
+                BucketedExecutor(state).warmup()  # compile + store
+            with _timed("bench.registry_fetch") as fetch:
+                svc, report = warm_from_registry(state=state)
+            svc.close()
+            # the warm repeat must not compile: deserialized executables
+            # never touch the XLA compile path, so cache growth is zero
+            with recompile_watch("registry_warm_repeat", warm=True) as delta:
+                svc2, repeat = warm_from_registry(state=state)
+            svc2.close()
+            store_bytes = sum(r["bytes"] for r in Registry(td).ls())
+            summary = provenance_summary(ledger.since(seq0))
+    out = {}
+    if not fast and os.environ.get("FMRP_BENCH_REGISTRY_PIPE", "1") == "1":
+        out.update(_registry_pipeline_children())
+    return {
+        **out,
+        "registry_cold_compile_s": round(cold.s, 4),
+        "registry_warm_fetch_s": round(fetch.s, 4),
+        "registry_cold_vs_fetch_ratio": (
+            round(cold.s / fetch.s, 2) if fetch.s > 0 else None
+        ),
+        "registry_programs_per_s": (
+            round(report.deserialized / fetch.s, 2) if fetch.s > 0 else None
+        ),
+        "registry_deserialized": report.deserialized,
+        "registry_fresh_compiles_on_fetch": report.fresh_compiles,
+        "registry_trace_growth_on_fetch": report.trace_growth,
+        "registry_repeat_zero_compile": repeat.zero_compile,
+        "registry_warm_repeat_cache_growth": delta.grew,
+        "registry_compile_s_saved": round(report.saved_s + repeat.saved_s, 4),
+        "registry_store_bytes": store_bytes,
+        "registry_provenance": {
+            prog: {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in d.items()
+            }
+            for prog, d in summary.items()
+        },
+        "registry_shape": f"T{t}_N{n}_P{p}",
+    }
+
+
+_REGISTRY_CHILD_CODE = """
+import json, sys, time
+t0 = time.time()
+from fm_returnprediction_tpu.pipeline import run_pipeline
+from fm_returnprediction_tpu.data.synthetic import SyntheticConfig
+res = run_pipeline(
+    synthetic=True, synthetic_config=SyntheticConfig(n_firms=48, n_months=60),
+    make_figure=False, compile_pdf=False, make_deciles=False,
+)
+from fm_returnprediction_tpu.telemetry.perf import provenance_summary
+print(json.dumps({
+    "wall_s": round(time.time() - t0, 3),
+    "provenance": provenance_summary(),
+}))
+"""
+
+
+def _registry_pipeline_children() -> dict:
+    """Cold-PROCESS pipeline walls, the acceptance comparison shape: a
+    plain cold process vs a cold process with a populated registry (+ the
+    persistent XLA cache the registry layers on). Three children at a
+    small synthetic shape (process wall includes interpreter + jax
+    import, identically on both sides):
+
+    - ``registry_pipeline_cold_without_s`` — fresh XLA cache, no registry;
+    - a populate child (fresh XLA cache B + empty registry) — its wall is
+      reported as ``registry_pipeline_populate_s`` (disclosure: includes
+      serialize+store);
+    - ``registry_pipeline_cold_with_s`` — XLA cache B (warm) + the
+      populated registry: the AOT programs deserialize, the rest rides
+      the XLA cache."""
+    import json
+    import subprocess
+    import sys
+    import tempfile
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def child(xla_dir: str, registry_dir: str | None) -> dict:
+        env = dict(_child_env(repo_root))
+        env["JAX_CACHE_DIR"] = xla_dir
+        env.pop("FMRP_REGISTRY_DIR", None)
+        if registry_dir is not None:
+            env["FMRP_REGISTRY_DIR"] = registry_dir
+        code = (
+            "from fm_returnprediction_tpu.settings import "
+            "enable_compilation_cache\nenable_compilation_cache()\n"
+            + _REGISTRY_CHILD_CODE
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=600, cwd=repo_root,
+        )
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or "")[-300:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        xla_a, xla_b = os.path.join(td, "xla_a"), os.path.join(td, "xla_b")
+        reg = os.path.join(td, "registry")
+        without = child(xla_a, None)
+        populate = child(xla_b, reg)
+        with_reg = child(xla_b, reg)
+        for label, res in (("without", without), ("populate", populate),
+                           ("with", with_reg)):
+            if "error" in res:
+                out[f"registry_pipeline_{label}_error"] = res["error"]
+        if "wall_s" in without:
+            out["registry_pipeline_cold_without_s"] = without["wall_s"]
+        if "wall_s" in populate:
+            out["registry_pipeline_populate_s"] = populate["wall_s"]
+        if "wall_s" in with_reg:
+            out["registry_pipeline_cold_with_s"] = with_reg["wall_s"]
+            prov = with_reg.get("provenance", {})
+            out["registry_pipeline_fetched_programs"] = sum(
+                d.get("deserialized", 0) for d in prov.values()
+            )
+            out["registry_pipeline_fresh_aot_compiles"] = sum(
+                d.get("fresh", 0) + d.get("uncached", 0)
+                + d.get("persistent-cache", 0) for d in prov.values()
+            )
+        if "wall_s" in without and "wall_s" in with_reg and with_reg["wall_s"]:
+            out["registry_pipeline_cold_with_vs_without"] = round(
+                without["wall_s"] / with_reg["wall_s"], 3
+            )
+        # disclosure: at this synthetic shape the child walls are
+        # dominated by interpreter+jax import (~4 s) and the per-program
+        # compiles are sub-second, so the ratio is a mechanism check; the
+        # real-shape cold−warm gap closure is the TPU/real-cache rounds'
+        # number. On CPU the specgrid program is deliberately NOT stored
+        # (custom-call pointer hazard — registry.executables) and rides
+        # the persistent XLA cache instead, counted under
+        # registry_pipeline_fresh_aot_compiles.
+        out["registry_pipeline_shape"] = "T60_N48_synthetic_process_walls"
+    return out
+
+
 def _jax_cache_stats() -> dict:
     """Entry count + bytes of the persistent XLA compilation cache
     (``_cache/jax``) — the artifact-side evidence for whether the split
@@ -1697,6 +1883,7 @@ def main() -> None:
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_obs)  # _OBS=0 handled in-section
+    sections.append(_bench_registry)  # _REGISTRY=0 handled in-section
     sections.append(_bench_fuseprobe)  # real ladder on TPU, small on CPU
     sections.append(_bench_mesh8)  # real shape when _MESH8=1, small else
 

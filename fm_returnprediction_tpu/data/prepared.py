@@ -53,11 +53,13 @@ import numpy as np
 
 from fm_returnprediction_tpu.panel.daily import CompactDaily
 from fm_returnprediction_tpu.panel.dense import DensePanel
+from fm_returnprediction_tpu.registry import integrity as _integrity
 from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
 
 __all__ = [
     "PREPARED_DIRNAME",
     "prepared_enabled",
+    "prepared_candidates",
     "raw_fingerprint",
     "save_prepared",
     "load_prepared",
@@ -109,12 +111,24 @@ def raw_fingerprint(raw_dir, dtype, salt: str = "") -> str:
     return h.hexdigest()
 
 
-def _file_sha256(path: Path) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for block in iter(lambda: f.read(1 << 22), b""):
-            h.update(block)
-    return h.hexdigest()
+def prepared_candidates(raw_dir) -> list:
+    """The checkpoint slots to try, preference order. With the registry
+    armed (``FMRP_REGISTRY_DIR``) the slot lives under the registry root
+    — keyed by the raw directory's absolute path, so two raw caches do
+    not share a slot — and the legacy ``<raw_dir>/_prepared`` location
+    stays as a read fallback (a user arming the registry must not re-pay
+    the full ingest their legacy checkpoint already covers). Saves go to
+    the FIRST candidate."""
+    from fm_returnprediction_tpu.registry.store import active_registry
+
+    legacy = Path(raw_dir) / PREPARED_DIRNAME
+    reg = active_registry()
+    if reg is None:
+        return [legacy]
+    slot = hashlib.sha256(
+        str(Path(raw_dir).resolve()).encode()
+    ).hexdigest()[:16]
+    return [reg.prepared_root(slot), legacy]
 
 
 def _write_npy(prepared_dir: Path, name: str, arr: np.ndarray, manifest: dict):
@@ -129,10 +143,7 @@ def _write_npy(prepared_dir: Path, name: str, arr: np.ndarray, manifest: dict):
         os.replace(tmp, path)
     finally:
         tmp.unlink(missing_ok=True)
-    st = path.stat()
-    manifest[f"{name}.npy"] = {
-        "sha256": _file_sha256(path), "size": st.st_size,
-    }
+    manifest[f"{name}.npy"] = _integrity.manifest_entry(path)
 
 
 def save_prepared(
@@ -199,30 +210,15 @@ def _load_payload(prepared_dir: Path, name: str, meta: dict) -> np.ndarray:
 
     Size + npy-header structure always verify (free); the full content
     sha256 re-read is opt-in (``FMRP_PREPARED_VERIFY=1``) because it costs
-    the IO the mmap exists to avoid. Any mismatch or unreadable file is a
-    :class:`CorruptArtifactError` — the caller degrades to a rebuild."""
+    the IO the mmap exists to avoid. Verification is the shared
+    ``registry.integrity`` layer — any mismatch or unreadable file is a
+    :class:`CorruptArtifactError` and the caller degrades to a rebuild."""
     fname = f"{name}.npy"
     entry = meta.get("manifest", {}).get(fname)
     path = prepared_dir / fname
     if entry is None:
         raise CorruptArtifactError(f"{fname} missing from manifest")
-    try:
-        size = path.stat().st_size
-    except OSError as exc:
-        raise CorruptArtifactError(f"{fname} unreadable: {exc!r}") from exc
-    if size != entry.get("size"):
-        raise CorruptArtifactError(
-            f"{fname} is {size} bytes, manifest says {entry.get('size')}"
-        )
-    if _verify_on_load():
-        try:
-            digest = _file_sha256(path)
-        except OSError as exc:  # EIO, perms, concurrent replace — degrade
-            raise CorruptArtifactError(
-                f"{fname} unreadable during verify: {exc!r}"
-            ) from exc
-        if digest != entry.get("sha256"):
-            raise CorruptArtifactError(f"{fname} failed its content sha256")
+    _integrity.verify_entry(path, entry, deep=_verify_on_load())
     try:
         return np.load(path, mmap_mode="r", allow_pickle=False)
     except (OSError, ValueError) as exc:

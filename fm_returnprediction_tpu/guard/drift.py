@@ -121,17 +121,24 @@ def summarize_frame(df) -> dict:
 
 
 def summarize_arrays(arrays: Dict[str, np.ndarray]) -> dict:
-    """Summary of a named array bundle (e.g. the serving state's leaves)."""
-    h = hashlib.sha256()
+    """Summary of a named array bundle (e.g. the serving state's leaves).
+
+    The content hash is the shared ``registry.integrity`` bundle digest —
+    the same definition ``save_array_bundle`` embeds, so a manifest
+    written before the dedup compares sha-for-sha."""
+    from fm_returnprediction_tpu.registry.integrity import array_bundle_digest
+
     columns = {}
     for name in sorted(arrays):
         arr = np.ascontiguousarray(np.asarray(arrays[name]))
-        h.update(f"{name}|{arr.dtype.str}|{arr.shape}|".encode())
-        h.update(arr.tobytes())
         if np.issubdtype(arr.dtype, np.number) or arr.dtype == np.bool_:
             columns[name] = _column_summary(arr.astype(np.float64))
             columns[name]["shape"] = [int(s) for s in arr.shape]
-    return {"kind": "arrays", "sha256": h.hexdigest(), "columns": columns}
+    return {
+        "kind": "arrays",
+        "sha256": array_bundle_digest(arrays),
+        "columns": columns,
+    }
 
 
 def compare_summaries(

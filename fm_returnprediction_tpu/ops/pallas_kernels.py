@@ -52,6 +52,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["masked_cumulative_moments", "rolling_std_fused"]
 
+# version-compat shim (the parallel.mesh shard_map pattern): pallas renamed
+# ``TPUCompilerParams`` → ``CompilerParams``; accept whichever this jax
+# ships so the kernels (and their CPU interpret-mode tests) run on both
+# sides of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _fit_block(dim: int, preferred: int, step: int) -> int:
     """Largest multiple-of-``step`` divisor of ``dim`` that is <= ``preferred``
@@ -138,7 +146,7 @@ def masked_cumulative_moments(
         out_specs=[spec, spec, spec],
         out_shape=[out_shape, out_shape, out_shape],
         scratch_shapes=[pltpu.VMEM((1, 3 * block_n), x.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -210,7 +218,7 @@ def rolling_std_fused(
             pltpu.VMEM((1, 3 * block_n), x.dtype),
             pltpu.VMEM((window, 3 * block_n), x.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -32,6 +32,7 @@ parity targets the reference, not the paper):
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Dict, Tuple
 
@@ -244,6 +245,34 @@ def _enrich_winsorized(values, mask, extras, win_idx: tuple):
 # Trace-time counter for the fused panel program (the test hook the ols /
 # specgrid programs also expose): a warm pipeline repeat must not re-trace.
 TRACES: Dict[str, int] = {"panel_characteristics": 0}
+
+# AOT executable cache for the fused characteristics program, keyed by the
+# same shape/dtype/static signature jit would key on (the specgrid
+# `_compiled_grid_program` idiom): explicit lower→compile through
+# `telemetry.timed_aot_compile` so every panel-program compile lands in
+# the cost ledger AND can be fetched from the registry's executable plane
+# instead of compiled (zero traces on a warm-from-registry cold start).
+_AOT_EXECUTABLES: Dict[str, object] = {}
+_AOT_LOCK = threading.Lock()
+
+
+def _compiled_characteristics_program(args, static_kwargs):
+    """The fused panel program's compiled executable for this signature
+    (compiling — and ledger-recording — it on first use)."""
+    from fm_returnprediction_tpu.telemetry import perf as _perf
+
+    signature = _perf.arg_signature(args, static_kwargs)
+    with _AOT_LOCK:
+        exe = _AOT_EXECUTABLES.get(signature)
+    if exe is None:
+        built = _perf.timed_aot_compile(
+            _panel_characteristics_program, *args,
+            program="panel_characteristics", signature=signature,
+            **static_kwargs,
+        )
+        with _AOT_LOCK:
+            exe = _AOT_EXECUTABLES.setdefault(signature, built)
+    return exe
 
 
 @partial(jax.jit, static_argnames=("var_index", "base_win_idx", "extra_win"))
@@ -478,11 +507,16 @@ def get_factors(
             i for i, n in enumerate(panel.var_names) if n in win_names
         )
         extra_win = tuple(n in win_names for n in new_names)
-        values_dev = _panel_characteristics_program(
+        program_args = (
             values_dev, mask_dev,
             [jnp.asarray(vol_m), jnp.asarray(beta_m)],
-            var_index, base_win_idx, extra_win,
         )
+        static_kwargs = dict(
+            var_index=var_index, base_win_idx=base_win_idx,
+            extra_win=extra_win,
+        )
+        exe = _compiled_characteristics_program(program_args, static_kwargs)
+        values_dev = exe(*program_args)
         final = DensePanel(
             values=values_dev,
             mask=panel.mask,

@@ -272,8 +272,8 @@ def load_or_build_panel(
         include_turnover = bool(int(config("INCLUDE_TURNOVER")))
     timer = timer or StageTimer()
     from fm_returnprediction_tpu.data.prepared import (
-        PREPARED_DIRNAME,
         load_prepared,
+        prepared_candidates,
         prepared_enabled,
         raw_fingerprint,
         save_prepared,
@@ -281,14 +281,22 @@ def load_or_build_panel(
 
     prepared = prepared_dir = fingerprint = None
     if prepared_enabled():
-        prepared_dir = Path(raw_data_dir) / PREPARED_DIRNAME
+        # slot candidates in preference order: the registry root when
+        # armed (FMRP_REGISTRY_DIR — the one root every plane resolves
+        # through), with the legacy <raw_dir>/_prepared as read fallback;
+        # saves target the first candidate
+        candidates = prepared_candidates(raw_data_dir)
+        prepared_dir = candidates[0]
         # the turnover flag changes the base column set, so it is part of
         # the checkpoint key (resolved HERE so key and payload agree)
         fingerprint = raw_fingerprint(
             raw_data_dir, dtype, salt=f"turnover={int(include_turnover)}"
         )
         with timer.stage("load_prepared"):
-            prepared = load_prepared(prepared_dir, fingerprint)
+            for candidate in candidates:
+                prepared = load_prepared(candidate, fingerprint)
+                if prepared is not None:
+                    break
     if prepared is not None:
         base, cd = prepared
         del prepared
@@ -388,6 +396,7 @@ def run_pipeline(
     audit_dir=None,
     trace_dir=None,
     profile_dir=None,
+    registry_dir=None,
 ) -> PipelineResult:
     """The full Lewellen pipeline: data → panel → tables/figure → artifacts.
 
@@ -440,12 +449,25 @@ def run_pipeline(
     ``profile_dir`` additionally wraps the run in a ``jax.profiler``
     DEVICE trace written there (``telemetry.profiling``); every host span
     inside the run also annotates the device trace, so Perfetto shows
-    named device rows beside the host rows the trace exporters produce."""
+    named device rows beside the host rows the trace exporters produce.
+
+    ``registry_dir`` arms the artifact/executable REGISTRY for the run
+    (``registry`` subsystem; ``None`` follows ``FMRP_REGISTRY_DIR``,
+    default off): prepared panels, AOT-compiled executables (serving
+    buckets, the specgrid fused program, the panel characteristics
+    program) and fitted artifacts (serving state, specgrid frame, audit
+    manifest) all resolve through — and publish into — the one registry
+    root, so a later cold process fetches instead of recomputing or
+    recompiling. Registry failures of any kind degrade silently to the
+    compute path (disclosed in the cost ledger)."""
     from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.registry.store import using_registry
 
     if guard is None:
         guard = _guard_checks.guard_active()
-    with _telemetry.tracing(trace_dir), _telemetry.profiling(
+    with using_registry(registry_dir), _telemetry.tracing(
+        trace_dir
+    ), _telemetry.profiling(
         profile_dir
     ), _telemetry.span(
         "run_pipeline", cat="pipeline"
@@ -851,6 +873,68 @@ def _run_pipeline_guarded(
             sentinel.raise_on_drift(audit)
             sentinel.commit(audit)
 
+    from fm_returnprediction_tpu.registry.store import active_registry
+
+    _registry = active_registry()
+    if _registry is not None and jax.process_index() == 0:
+        # artifact-plane publish (one schema-versioned store, shared
+        # integrity manifest): the fitted serving state, the specgrid
+        # frame, and the committed audit manifest become fetchable by a
+        # later cold process / fresh replica (registry.warm). Failures
+        # warn inside and never fail the run.
+        from fm_returnprediction_tpu.guard.drift import MANIFEST_NAME
+        from fm_returnprediction_tpu.registry import artifacts as _rart
+
+        with timer.stage("registry_publish"):
+            try:
+                fp = _pipeline_fingerprint(panel, dtype, _provenance_salt())
+                if serving_state is not None:
+                    saved = (Path(output_dir) / "serving_state.npz"
+                             if output_dir is not None else None)
+                    if saved is not None and saved.exists():
+                        # register the npz save_artifacts already wrote —
+                        # no second serialization of a bundle that is
+                        # hundreds of MB at real shape
+                        _rart.put_files(
+                            _rart.SERVING_STATE_NAME, fp, [saved],
+                            registry=_registry,
+                        )
+                    else:
+                        _rart.put_serving_state(serving_state, fp,
+                                                registry=_registry)
+                    # publish-behind-warmed-executor (the PR-1 ingest
+                    # discipline, extended to the registry): warming here
+                    # sends every serving bucket program through
+                    # timed_aot_compile, which stores the executables — so
+                    # a fresh replica (registry.warm_from_registry)
+                    # reaches quoting-ready with ZERO process-local
+                    # compiles off this one run
+                    from fm_returnprediction_tpu.serving.executor import (
+                        BucketedExecutor,
+                    )
+
+                    BucketedExecutor(serving_state).warmup()
+                if specgrid_scenarios is not None and output_dir is not None:
+                    csv = Path(output_dir) / "specgrid_scenarios.csv"
+                    if csv.exists():
+                        _rart.put_files("specgrid_scenarios", fp, [csv],
+                                        registry=_registry)
+                if audit_dir is not None:
+                    manifest = Path(audit_dir) / MANIFEST_NAME
+                    if manifest.exists():
+                        _rart.put_files("audit_manifest", fp, [manifest],
+                                        registry=_registry)
+            except Exception as exc:  # noqa: BLE001 — the registry is an
+                # accelerant: a publish failure (fingerprint IO, a bucket
+                # warm-up OOM) must not lose the finished PipelineResult
+                import warnings
+
+                warnings.warn(
+                    f"registry publish failed ({exc!r}); run results are "
+                    "unaffected",
+                    stacklevel=2,
+                )
+
     return PipelineResult(
         panel=panel,
         factors_dict=factors_dict,
@@ -936,6 +1020,14 @@ def _main() -> None:
              "run into this directory (host spans annotate the device "
              "timeline; open with Perfetto/TensorBoard)",
     )
+    parser.add_argument(
+        "--registry-dir", default=None,
+        help="arm the artifact/executable registry at this root: AOT "
+             "executables, the prepared panel checkpoint, and fitted "
+             "artifacts are fetched from (and published into) it, so a "
+             "cold process skips recompiles and rebuilds; default "
+             "follows FMRP_REGISTRY_DIR",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -966,6 +1058,7 @@ def _main() -> None:
         audit_dir=args.audit_dir,
         trace_dir=args.trace_dir,
         profile_dir=args.profile_dir,
+        registry_dir=args.registry_dir,
     )
     print(result.table_1.round(3).to_string())
     print()

@@ -1,0 +1,77 @@
+"""One artifact plane: versioned AOT-executable + state registry.
+
+The cross-cutting layer ROADMAP items 1-3 reduce to "fetch from the
+registry" (item 5): a content-addressed tree under ``FMRP_REGISTRY_DIR``
+holding
+
+- serialized AOT-compiled EXECUTABLES (:mod:`.executables`), fetched by
+  ``telemetry.perf.timed_aot_compile`` before any lowering happens — the
+  serving bucket programs, the specgrid fused program, and the panel
+  characteristics program all ride it;
+- schema-versioned ARTIFACTS (:mod:`.artifacts`) — serving states,
+  specgrid frames, audit manifests — and the prepared-inputs panel
+  checkpoint slots, all integrity-guarded by the ONE manifest layer
+  (:mod:`.integrity`) the prepared checkpoint, ``save_array_bundle`` and
+  the guard audit already share;
+- the WARM-POOL protocol (:mod:`.warm`): ``warm_from_registry()`` starts
+  a quoting-ready serving replica with zero process-local compiles.
+
+Maintenance: ``python -m fm_returnprediction_tpu.registry {ls,verify,gc}``.
+Off unless ``FMRP_REGISTRY_DIR`` (or ``--registry-dir``) is set; every
+failure degrades to the compute path that existed before this layer.
+"""
+
+from __future__ import annotations
+
+from fm_returnprediction_tpu.registry.artifacts import (
+    get_entry_dir,
+    get_file,
+    list_entries,
+    load_serving_state,
+    put_files,
+    put_serving_state,
+)
+from fm_returnprediction_tpu.registry.executables import (
+    code_salt,
+    environment_key,
+    executable_key,
+    load_executable,
+    store_executable,
+)
+from fm_returnprediction_tpu.registry.integrity import (
+    CorruptArtifactError,
+    array_bundle_digest,
+    file_sha256,
+)
+from fm_returnprediction_tpu.registry.store import (
+    REGISTRY_ENV,
+    Registry,
+    active_registry,
+    registry_dir,
+    using_registry,
+)
+from fm_returnprediction_tpu.registry.warm import WarmReport, warm_from_registry
+
+__all__ = [
+    "REGISTRY_ENV",
+    "Registry",
+    "CorruptArtifactError",
+    "WarmReport",
+    "active_registry",
+    "array_bundle_digest",
+    "code_salt",
+    "environment_key",
+    "executable_key",
+    "file_sha256",
+    "get_entry_dir",
+    "get_file",
+    "list_entries",
+    "load_executable",
+    "load_serving_state",
+    "put_files",
+    "put_serving_state",
+    "registry_dir",
+    "store_executable",
+    "using_registry",
+    "warm_from_registry",
+]

@@ -1,0 +1,283 @@
+"""Executable plane: serialized AOT-compiled programs, fetch-not-compile.
+
+At real shape a cold process pays lowering+compile for every program the
+warm process already owns (BENCH_r05: 203 s cold vs 89 s warm) — and the
+persistent XLA cache only shortens the *compile* half, per process, after
+a lowering/trace it still pays. This plane closes the rest of the gap:
+``telemetry.perf.timed_aot_compile`` (the one AOT entry the serving
+bucket programs, the specgrid fused program, and the panel
+characteristics program share) first asks the registry for the finished
+executable and only lowers+compiles on a miss, storing the result for
+the next process.
+
+Key. An entry is addressed by a digest over:
+
+- the logical ``program`` name and its shape/dtype/static ``signature``
+  (what jit would key on);
+- the ENVIRONMENT: backend platform + device kind, jax/jaxlib versions,
+  and the x64 flag — a compiled executable is an opaque device binary,
+  valid only for the stack that produced it;
+- a CODE SALT: one hash over every ``.py`` file in this package — the
+  conservative stand-in for a per-program jaxpr fingerprint that needs
+  NO trace to compute, so a registry HIT costs zero traces and zero
+  compiles. Any source change invalidates every entry (a fresh compile
+  and re-store, not a stale answer). The store path, which has the
+  lowered module in hand anyway, additionally records the true module
+  fingerprint (``jaxpr_sha256``) in the entry meta for disclosure.
+
+Payload. ``jax.experimental.serialize_executable`` (un)flattens the
+``Compiled`` object; the payload is a pickle, so entries are loaded ONLY
+after the meta's sha256+size manifest verifies DEEP (the registry is a
+trusted local cache directory, same trust level as the persistent XLA
+cache it layers on).
+
+Degradation. Every failure — absent entry, torn meta, manifest
+mismatch, deserialize error, version skew — returns ``None`` and the
+caller compiles fresh; the miss and its reason are disclosed in the cost
+ledger / metrics, never raised.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import NamedTuple, Optional
+
+from fm_returnprediction_tpu.registry import integrity
+from fm_returnprediction_tpu.registry.store import Registry, active_registry
+
+__all__ = [
+    "environment_key",
+    "code_salt",
+    "executable_key",
+    "store_executable",
+    "load_executable",
+    "LoadedExecutable",
+]
+
+PAYLOAD_FILE = "payload.bin"
+
+_SALT_LOCK = threading.Lock()
+_SALT: Optional[str] = None
+
+
+def environment_key() -> dict:
+    """The fields an executable is only valid under. ``unknown`` entries
+    (no devices yet, exotic jax) still key consistently — two processes in
+    the same container agree, which is the contract that matters."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        backend, device_kind = dev.platform, dev.device_kind
+    except Exception:  # noqa: BLE001 — keying must never break a compile
+        backend, device_kind = "unknown", "unknown"
+    try:
+        import jaxlib
+
+        jaxlib_version = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        jaxlib_version = "unknown"
+    return {
+        "backend": backend,
+        "device_kind": device_kind,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def code_salt() -> str:
+    """One digest over every ``.py`` source file in this package,
+    memoized per process (~1-2 MB of reads, once). The crude-but-safe
+    jaxpr stand-in: any code change — kernel math, masking discipline,
+    static-arg plumbing — invalidates every stored executable, trading
+    occasional unnecessary recompiles for the impossibility of a stale
+    executable answering with old math."""
+    global _SALT
+    if _SALT is None:
+        with _SALT_LOCK:
+            if _SALT is None:
+                pkg_root = Path(__file__).resolve().parent.parent
+                _SALT = integrity.hash_files(pkg_root.rglob("*.py"))
+    return _SALT
+
+
+def executable_key(program: str, signature: str) -> str:
+    """Content address of one executable entry (the entry directory
+    name): digest over program, signature, environment, and code salt."""
+    payload = json.dumps(
+        {
+            "program": program,
+            "signature": signature,
+            "env": environment_key(),
+            "code_salt": code_salt(),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+class LoadedExecutable(NamedTuple):
+    """The fetch result: the live executable, its entry meta, and the
+    verify+deserialize wall seconds (the ledger's ``compile_s`` twin)."""
+
+    compiled: object
+    meta: dict
+    load_s: float
+
+
+def _module_text(lowered, compiled) -> Optional[str]:
+    """The program's module text (StableHLO from the lowering when the
+    caller has it, else the compiled HLO); None when neither prints."""
+    for obj in (lowered, compiled):
+        if obj is None:
+            continue
+        try:
+            return obj.as_text()
+        except Exception:  # noqa: BLE001 — printing is best-effort
+            continue
+    return None
+
+
+def _cpu_unserializable(text: Optional[str]) -> bool:
+    """True when a CPU executable must NOT be stored: XLA CPU lowers
+    linalg (eigh/qr/svd — LAPACK) and several other ops to CUSTOM CALLS
+    whose serialized form embeds raw host function POINTERS, valid only
+    in the producing process (ASLR) — a consumer process calling one
+    segfaults. TPU custom calls resolve by name in the runtime and are
+    unaffected. Unknown module text is treated as unserializable on CPU
+    (a skipped store costs a persistent-cache compile; a bad store costs
+    a crash)."""
+    return text is None or "custom_call" in text or "custom-call" in text
+
+
+def _jaxpr_sha256(text: Optional[str]) -> Optional[str]:
+    """Fingerprint of the module text (disclosure field, computed on the
+    STORE path only — the fetch path never lowers)."""
+    if text is None:
+        return None
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def store_executable(
+    program: str,
+    signature: str,
+    compiled,
+    registry: Optional[Registry] = None,
+    bucket: Optional[int] = None,
+    lowered=None,
+    compile_s: Optional[float] = None,
+) -> Optional[Path]:
+    """Serialize ``compiled`` into the registry; returns the entry dir, or
+    None when the registry is off / the program is unserializable / the
+    write failed (warned, never raised — the caller already holds a
+    working executable, persistence is an accelerant)."""
+    registry = registry or active_registry()
+    if registry is None:
+        return None
+    try:
+        import jax
+
+        if jax.process_index() != 0:
+            return None  # one writer per pod; peers fetch
+        env = environment_key()
+        text = _module_text(lowered, compiled)
+        if env["backend"] == "cpu" and _cpu_unserializable(text):
+            # disclosed skip, not a failure: the program still rides the
+            # persistent XLA cache; storing it would hand the next
+            # process a pointer-baked executable that segfaults
+            from fm_returnprediction_tpu.telemetry import metrics as _m
+
+            _m.registry().counter(
+                "fmrp_registry_store_skipped_total",
+                help="executables not stored (CPU custom-call programs "
+                     "serialize host pointers; see registry.executables)",
+                program=program,
+            ).inc()
+            return None
+        from jax.experimental import serialize_executable as _se
+
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+        meta = {
+            "kind": "executable",
+            "program": program,
+            "signature": signature,
+            "bucket": bucket,
+            "created_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "jaxpr_sha256": _jaxpr_sha256(text),
+            # the lowering+compile seconds this entry cost at store time —
+            # every later fetch reports them as its saved_s (the bench's
+            # compile-seconds-saved series)
+            "compile_s": round(compile_s, 6) if compile_s is not None
+            else None,
+            **env,
+        }
+        entry = registry.executable_dir(executable_key(program, signature))
+        registry.write_entry(entry, {PAYLOAD_FILE: blob}, meta)
+        return entry
+    except Exception as exc:  # noqa: BLE001 — see docstring
+        warnings.warn(
+            f"registry store failed for {program!r} ({exc!r}); "
+            "the compiled program is unaffected",
+            stacklevel=2,
+        )
+        return None
+
+
+def load_executable(
+    program: str,
+    signature: str,
+    registry: Optional[Registry] = None,
+) -> Optional[LoadedExecutable]:
+    """Fetch one executable: key lookup → DEEP manifest verification →
+    environment check → deserialize. Any failure returns None (fresh
+    compile); corruption additionally drops the entry so the next process
+    does not re-pay the failed verification."""
+    registry = registry or active_registry()
+    if registry is None:
+        return None
+    entry = registry.executable_dir(executable_key(program, signature))
+    meta = registry.read_meta(entry)
+    if meta is None:
+        return None
+    env = environment_key()
+    if any(meta.get(k) != v for k, v in env.items()):
+        # defense-in-depth, not the primary gate: the entry ADDRESS
+        # already embeds the environment (a skewed stack computes a
+        # different key and misses at read_meta), so this fires only for
+        # tampered or hash-colliding meta — and still as a metadata-only
+        # miss, before the deep payload hash
+        return None
+    t0 = time.perf_counter()
+    try:
+        # deep: the payload is unpickled below — bytes must prove
+        # themselves against the manifest first
+        integrity.verify_manifest(entry, meta.get("manifest", {}), deep=True)
+    except integrity.CorruptArtifactError:
+        # heal the tree — but re-read first: a concurrent writer may
+        # have re-published this key between our meta read and the
+        # verify (meta unlinked, payload rewritten, new meta sealed);
+        # dropping THEIR valid entry would cost the fleet a recompile.
+        # Only drop when the meta we verified against is still live.
+        if registry.read_meta(entry) == meta:
+            registry.drop(entry)
+        return None
+    try:
+        from jax.experimental import serialize_executable as _se
+
+        payload, in_tree, out_tree = pickle.loads(
+            (entry / PAYLOAD_FILE).read_bytes()
+        )
+        compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 — undeserializable ⇒ miss, not crash
+        return None
+    return LoadedExecutable(compiled, meta, time.perf_counter() - t0)

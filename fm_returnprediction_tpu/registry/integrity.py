@@ -1,0 +1,131 @@
+"""The ONE integrity layer for persisted artifacts.
+
+Before this module the repo had three parallel integrity implementations
+growing in three corners: the prepared-v3 checkpoint's ``meta.json``
+sha256+size manifest (``data.prepared``), the array-bundle content digest
+``utils.cache.save_array_bundle`` embeds and verifies, and the guard
+drift sentinel's per-artifact content hashes (``guard.drift``). All three
+answer the same question — *are these bytes the bytes that were written*
+— with the same answer shape (sha256) and the same failure contract (a
+typed :class:`CorruptArtifactError` the caller degrades on). This module
+is their single home; the registry's executable and artifact planes build
+their manifests from the same helpers, so every persisted thing in the
+repo fails corruption the same way.
+
+Digest definitions are FROZEN: :func:`file_sha256` hashes raw file bytes
+and :func:`array_bundle_digest` reproduces the historical bundle/drift
+digest byte for byte (``name|dtype|shape|`` framing over sorted names) —
+moving the implementations here must not invalidate a single existing
+manifest, bundle checksum, or audit baseline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+from typing import Dict, Iterable, Union
+
+import numpy as np
+
+from fm_returnprediction_tpu.resilience.errors import CorruptArtifactError
+
+__all__ = [
+    "CorruptArtifactError",
+    "file_sha256",
+    "array_bundle_digest",
+    "manifest_entry",
+    "build_manifest",
+    "verify_entry",
+    "verify_manifest",
+    "hash_files",
+]
+
+_CHUNK = 1 << 22
+
+
+def file_sha256(path: Union[Path, str]) -> str:
+    """Streaming sha256 over a file's bytes (the prepared-checkpoint and
+    registry manifest content hash)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(_CHUNK), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def array_bundle_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """Order-independent content hash over (name, dtype, shape, bytes) of
+    every array — the integrity contract ``utils.cache.load_array_bundle``
+    verifies and the drift sentinel's array-artifact identity hash."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(f"{name}|{arr.dtype.str}|{arr.shape}|".encode())
+        h.update(arr.data)
+    return h.hexdigest()
+
+
+def manifest_entry(path: Union[Path, str]) -> dict:
+    """One file's manifest record: ``{"sha256": ..., "size": ...}`` — the
+    shape the prepared checkpoint, the audit manifest, and the registry
+    planes all store."""
+    path = Path(path)
+    return {"sha256": file_sha256(path), "size": path.stat().st_size}
+
+
+def build_manifest(paths: Iterable[Union[Path, str]]) -> Dict[str, dict]:
+    """Manifest over several files, keyed by file NAME (the registry and
+    prepared-checkpoint layout stores payloads flat in one directory)."""
+    return {Path(p).name: manifest_entry(p) for p in paths}
+
+
+def verify_entry(
+    path: Union[Path, str], entry: dict, deep: bool = False
+) -> None:
+    """Check one payload file against its manifest record.
+
+    Structure and size always verify (one ``stat``); the full content
+    re-hash is ``deep`` opt-in because it costs the IO that mmap'd loads
+    exist to avoid. Any mismatch or unreadable file raises the typed
+    :class:`CorruptArtifactError` every resume/degrade path catches."""
+    path = Path(path)
+    name = path.name
+    if not isinstance(entry, dict):
+        raise CorruptArtifactError(f"{name} has no manifest entry")
+    try:
+        size = path.stat().st_size
+    except OSError as exc:
+        raise CorruptArtifactError(f"{name} unreadable: {exc!r}") from exc
+    if size != entry.get("size"):
+        raise CorruptArtifactError(
+            f"{name} is {size} bytes, manifest says {entry.get('size')}"
+        )
+    if deep:
+        try:
+            digest = file_sha256(path)
+        except OSError as exc:  # EIO, perms, concurrent replace — degrade
+            raise CorruptArtifactError(
+                f"{name} unreadable during verify: {exc!r}"
+            ) from exc
+        if digest != entry.get("sha256"):
+            raise CorruptArtifactError(f"{name} failed its content sha256")
+
+
+def verify_manifest(
+    directory: Union[Path, str], manifest: Dict[str, dict], deep: bool = False
+) -> None:
+    """Verify every manifest entry against the files in ``directory``."""
+    directory = Path(directory)
+    for name, entry in manifest.items():
+        verify_entry(directory / name, entry, deep=deep)
+
+
+def hash_files(paths: Iterable[Union[Path, str]]) -> str:
+    """One digest over several files' (name, bytes) — the executable
+    plane's code-version salt (any source change invalidates)."""
+    h = hashlib.sha256()
+    for p in sorted(Path(p) for p in paths):
+        h.update(p.name.encode())
+        h.update(b"|")
+        h.update(p.read_bytes())
+    return h.hexdigest()

@@ -1,0 +1,141 @@
+"""Warm-pool protocol: a fresh process reaches quoting-ready with ZERO
+process-local compiles.
+
+The serving story before this module: warm-up compiles every bucket in
+the starting process (``ERService(warm=True)``), and the persistent XLA
+cache shortens — but does not remove — what the NEXT process pays (each
+replica still traces, lowers, and round-trips the cache). With a
+populated registry, :func:`warm_from_registry` builds a fully-warmed
+:class:`~fm_returnprediction_tpu.serving.service.ERService` whose bucket
+executables all arrive via the executable plane's deserialize path —
+zero jit traces, zero XLA compiles — and whose state comes from the
+artifact plane (or an explicit path/state). The returned
+:class:`WarmReport` carries the evidence: the ledger records of the
+warm-up window split by provenance and the ``fmrp_jit_traces_total``
+growth, both asserted zero-fresh in ``tests/test_registry.py``, and
+differentially pinned bit-identical to the in-process warm-up path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["WarmReport", "warm_from_registry"]
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmReport:
+    """Evidence of how a warm-up was paid for."""
+
+    wall_s: float
+    deserialized: int          # bucket programs fetched from the registry
+    fresh_compiles: int        # programs that had to lower+compile
+    trace_growth: int          # fmrp_jit_traces_total growth in the window
+    programs: Tuple[str, ...]  # "program@provenance" per ledger record
+    saved_s: float             # store-time compile seconds NOT paid
+
+    @property
+    def zero_compile(self) -> bool:
+        """The warm-pool contract: nothing lowered, nothing compiled."""
+        return self.fresh_compiles == 0 and self.trace_growth == 0
+
+
+_PROGRAM = "serving_bucket"  # the program warm-up compiles/fetches
+
+
+def _trace_total() -> int:
+    """The serving-bucket jit-trace count — scoped to THIS program so a
+    concurrent compile elsewhere in the process (another subsystem
+    tracing ols/specgrid) cannot falsify the report."""
+    from fm_returnprediction_tpu.telemetry import metrics as _metrics
+
+    series = _metrics.registry().collect().get("fmrp_jit_traces_total", {})
+    return int(sum(
+        v for key, v in series.items()
+        if dict(key).get("program") == _PROGRAM
+    ))
+
+
+def warm_from_registry(
+    state=None,
+    registry_dir=None,
+    fingerprint: Optional[str] = None,
+    strict: bool = False,
+    **service_kwargs,
+):
+    """Build a quoting-ready ``ERService`` from the registry.
+
+    ``state`` may be a fitted ``ServingState``, a path to a saved
+    ``serving_state.npz``, or None — in which case the artifact plane
+    resolves it (by ``fingerprint``, else the newest registered entry).
+    ``registry_dir`` overrides ``FMRP_REGISTRY_DIR`` for the warm-up.
+    Every bucket the service warms rides ``timed_aot_compile``'s
+    registry fetch; with a populated executable plane nothing in the
+    window traces or compiles.
+
+    Returns ``(service, report)``. ``strict=True`` raises when the
+    zero-compile contract was missed (a partial registry is otherwise a
+    legitimate degraded start: the misses compiled fresh and were stored
+    for the next replica)."""
+    from pathlib import Path
+
+    from fm_returnprediction_tpu.registry import artifacts as _artifacts
+    from fm_returnprediction_tpu.registry.store import using_registry
+    from fm_returnprediction_tpu.serving.service import ERService
+    from fm_returnprediction_tpu.serving.state import ServingState
+    from fm_returnprediction_tpu.telemetry import cost_ledger
+
+    with using_registry(registry_dir) as reg:
+        if state is None:
+            if reg is None:
+                raise ValueError(
+                    "warm_from_registry needs a state, a registry_dir, or "
+                    "FMRP_REGISTRY_DIR set"
+                )
+            state = _artifacts.load_serving_state(fingerprint, registry=reg)
+            if state is None:
+                raise FileNotFoundError(
+                    f"no serving_state artifact in registry {reg.root}"
+                )
+        elif isinstance(state, (str, Path)):
+            state = ServingState.load(state)
+
+        ledger = cost_ledger()
+        seq0 = ledger.last_seq
+        traces0 = _trace_total()
+        t0 = time.perf_counter()
+        service = ERService(state, warm=True, **service_kwargs)
+        wall = time.perf_counter() - t0
+        # evidence is scoped to the serving program: other subsystems
+        # compiling concurrently must not falsify this service's report
+        # (two warm_from_registry calls racing in one process still
+        # cross-attribute — warm one replica's service at a time)
+        window: List = [
+            r for r in ledger.since(seq0) if r.program == _PROGRAM
+        ]
+        report = WarmReport(
+            wall_s=wall,
+            deserialized=sum(
+                1 for r in window if r.provenance == "deserialized"
+            ),
+            fresh_compiles=sum(
+                1 for r in window if r.provenance != "deserialized"
+            ),
+            trace_growth=_trace_total() - traces0,
+            programs=tuple(f"{r.program}@{r.provenance}" for r in window),
+            saved_s=sum(
+                r.saved_s for r in window
+                if r.provenance == "deserialized" and r.saved_s is not None
+            ),
+        )
+    if strict and not report.zero_compile:
+        service.close()
+        raise RuntimeError(
+            "warm_from_registry(strict=True): warm-up was not compile-free "
+            f"(fresh_compiles={report.fresh_compiles}, "
+            f"trace_growth={report.trace_growth}, "
+            f"programs={list(report.programs)})"
+        )
+    return service, report

@@ -24,7 +24,6 @@ Contract:
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import warnings
@@ -48,11 +47,11 @@ def _checkpoint_counter(outcome: str):
 
 
 def _file_sha256(path: Path) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    # the ONE streaming file-hash definition (shared with the prepared
+    # checkpoint and the registry planes)
+    from fm_returnprediction_tpu.registry.integrity import file_sha256
+
+    return file_sha256(path)
 
 
 class StageCheckpointer:

@@ -90,6 +90,14 @@ def _er_kernel(slopes_bar, intercept_bar, x_lo, x_hi, have_coef,
     import jax
     import jax.numpy as jnp
 
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    # trace-time side effect (the ols/specgrid/characteristics idiom):
+    # fmrp_jit_traces_total{program=serving_bucket} counts every lowering
+    # of a bucket program — the warm-pool protocol's zero-trace assertion
+    # (registry.warm) reads it; a registry fetch never traces, so the
+    # counter stays flat on a warm-from-registry start
+    record_trace("serving_bucket")
     ok = valid & jnp.all(jnp.isfinite(x), axis=-1) & have_coef[month_idx]
     xb = jnp.clip(x, x_lo[month_idx], x_hi[month_idx])
     er = intercept_bar[month_idx] + jnp.einsum(
@@ -190,7 +198,11 @@ class BucketedExecutor:
         The AOT compile goes through the cost ledger
         (``telemetry.timed_aot_compile``): lowering+compile wall time,
         ``cost_analysis``/``memory_analysis`` and persistent-cache
-        provenance are accounted per bucket program."""
+        provenance are accounted per bucket program — and with
+        ``FMRP_REGISTRY_DIR`` armed the finished executable FETCHES from
+        the registry's executable plane (zero traces, zero compiles;
+        ``registry.warm_from_registry`` is the replica entry built on
+        this)."""
         import jax
         import jax.numpy as jnp
 

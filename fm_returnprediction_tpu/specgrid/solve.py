@@ -90,7 +90,11 @@ def program_trace_counts() -> Dict[str, int]:
 # compile, instead of riding jit's implicit cache) so the cost ledger can
 # account every grid compile — cost_analysis/memory_analysis FLOPs and
 # bytes, lowering+compile wall time, persistent-cache provenance — the
-# same way the serving executor's bucket programs are accounted.
+# same way the serving executor's bucket programs are accounted. With
+# FMRP_REGISTRY_DIR armed, timed_aot_compile additionally fetches the
+# finished executable from the registry (TPU; on CPU this program's eigh
+# custom calls make it non-serializable — registry.executables — so it
+# rides the persistent XLA cache there).
 _AOT_EXECUTABLES: Dict[str, object] = {}
 _AOT_LOCK = threading.Lock()
 

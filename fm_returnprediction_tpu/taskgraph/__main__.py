@@ -52,6 +52,12 @@ def main(argv=None) -> int:
                         help="capture a jax.profiler device trace of the "
                              "run into this directory (host spans "
                              "annotate the device timeline)")
+    parser.add_argument("--registry-dir", default=None,
+                        help="arm the artifact/executable registry at "
+                             "this root for every task (AOT executables "
+                             "and fitted artifacts fetch instead of "
+                             "compile/rebuild); default follows "
+                             "FMRP_REGISTRY_DIR")
     args = parser.parse_args(argv)
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -68,9 +74,11 @@ def main(argv=None) -> int:
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"
 
     from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.registry.store import using_registry
     from contextlib import ExitStack
 
     with ExitStack() as stack:
+        stack.enter_context(using_registry(args.registry_dir))
         stack.enter_context(telemetry.tracing(args.trace_dir))
         stack.enter_context(telemetry.profiling(args.profile_dir))
         runner = stack.enter_context(TaskRunner(tasks, db_path=db))

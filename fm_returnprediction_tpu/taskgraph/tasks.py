@@ -283,11 +283,73 @@ def _serve_state(processed_dir: Path) -> None:
             _contracts.evaluate(_contracts.serving_state_rules(), state),
             context="serve_state",
         )
+    # the warm-up rides timed_aot_compile: with FMRP_REGISTRY_DIR armed
+    # the bucket executables fetch from (or publish into) the registry's
+    # executable plane, so a later serving replica starts compile-free
     BucketedExecutor(state).warmup()
-    _primary_writes(
-        "serve_state_saved",
-        lambda: state.save(processed_dir / SERVING_FILE),
-    )
+
+    def _save() -> None:
+        state.save(processed_dir / SERVING_FILE)
+        from fm_returnprediction_tpu.registry import artifacts as _rart
+        from fm_returnprediction_tpu.registry.store import active_registry
+
+        if active_registry() is not None:
+            # artifact-plane publish: warm_from_registry resolves the
+            # state from here (fingerprint = the panel checkpoint's
+            # content hash, so the entry answers "the state FOR this
+            # panel"). Register the npz JUST saved above — re-serializing
+            # through put_serving_state would write the multi-hundred-MB
+            # bundle twice at real shape
+            fp = _panel_content_fp(processed_dir / PANEL_FILE)
+            _rart.put_files(
+                _rart.SERVING_STATE_NAME, fp,
+                [processed_dir / SERVING_FILE],
+                meta={"n_months": int(state.n_months),
+                      "n_predictors": int(state.n_predictors)},
+            )
+
+    _primary_writes("serve_state_saved", _save)
+
+
+# (path, size, mtime_ns) → sha256[:32] of the panel checkpoint: the
+# serve_state uptodate check and the publish both need the same content
+# fingerprint, and the file is hundreds of MB at real shape — one hash
+# per (file state, process), not one per consumer
+_PANEL_FP_MEMO: dict = {}
+
+
+def _panel_content_fp(panel: Path) -> str:
+    from fm_returnprediction_tpu.registry.integrity import file_sha256
+
+    st = panel.stat()
+    key = (str(panel), st.st_size, st.st_mtime_ns)
+    hit = _PANEL_FP_MEMO.get(key)
+    if hit is None:
+        hit = file_sha256(panel)[:32]
+        _PANEL_FP_MEMO.clear()  # one live panel per process is the shape
+        _PANEL_FP_MEMO[key] = hit
+    return hit
+
+
+def _serve_state_registry_current(processed_dir: Path) -> bool:
+    """``uptodate`` component for the serve_state task: with the registry
+    armed, the task's effective target set also includes the
+    artifact-plane serving-state entry for the CURRENT panel checkpoint —
+    a newly armed (or emptied/foreign) registry makes the task stale, so
+    ``--registry-dir`` on an up-to-date DAG publishes instead of silently
+    no-opping (the same knob-staleness contract as the specgrid sidecar
+    below). Registry off, or panel checkpoint absent (the file_dep
+    machinery owns that case): no opinion, report current."""
+    from fm_returnprediction_tpu.registry import artifacts as _rart
+    from fm_returnprediction_tpu.registry.store import active_registry
+
+    reg = active_registry()
+    panel = processed_dir / PANEL_FILE
+    if reg is None or not panel.exists():
+        return True
+    return _rart.get_entry_dir(
+        _rart.SERVING_STATE_NAME, _panel_content_fp(panel), registry=reg
+    ) is not None
 
 
 SPECGRID_KNOBS_FILE = "specgrid_scenarios.knobs.json"
@@ -461,6 +523,13 @@ def build_tasks(
             file_dep=[processed_dir / PANEL_FILE],
             targets=[processed_dir / SERVING_FILE],
             task_dep=["build_panel"],
+            # registry-aware staleness: an armed registry missing this
+            # panel's serving-state entry re-runs the task (publish),
+            # instead of --registry-dir silently no-opping on an
+            # up-to-date DAG
+            uptodate=[
+                lambda: _serve_state_registry_current(processed_dir)
+            ],
             doc="Panel checkpoint → warmed online-serving state",
         ),
         Task(

@@ -67,6 +67,7 @@ __all__ = [
     "cost_ledger",
     "record_compiled",
     "timed_aot_compile",
+    "provenance_summary",
     "record_runtime",
     "peak_flops_estimate",
     "profiling",
@@ -99,11 +100,15 @@ class ProgramRecord:
     argument_bytes: Optional[int]
     output_bytes: Optional[int]
     generated_code_bytes: Optional[int]
-    provenance: str  # "fresh" | "persistent-cache" | "uncached"
+    provenance: str  # "fresh" | "persistent-cache" | "uncached" | "deserialized"
     cache_entries_delta: int
     bucket: Optional[int] = None
     t_ns: int = 0  # perf_counter_ns at record time (epoch-anchorable)
     seq: int = 0
+    # "deserialized" records only: the ORIGINAL lowering+compile seconds
+    # the registry entry recorded at store time — the seconds this fetch
+    # did NOT pay (the bench's compile-seconds-saved series)
+    saved_s: Optional[float] = None
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -243,6 +248,8 @@ def record_compiled(
     cache_entries_delta: int = 0,
     cache_enabled: bool = True,
     bucket: Optional[int] = None,
+    provenance: Optional[str] = None,
+    saved_s: Optional[float] = None,
 ) -> ProgramRecord:
     """Account one freshly AOT-compiled program into the ledger, the
     metrics registry, and (when tracing is armed) the current span.
@@ -252,14 +259,21 @@ def record_compiled(
     full price and landed a new cache entry ("fresh"); 0 with the cache
     enabled means XLA served it from the persistent cache
     ("persistent-cache"); with no cache configured provenance is
-    "uncached"."""
+    "uncached". An explicit ``provenance`` overrides that derivation —
+    the registry's executable plane records its fetches as
+    "deserialized" (``lower_s=0``, ``compile_s`` = verify+deserialize
+    wall, ``saved_s`` = the store-time compile seconds the fetch did not
+    pay)."""
     cost = _cost_dict(compiled)
     flops = cost.get("flops")
     bytes_accessed = cost.get("bytes accessed")
-    if not cache_enabled:
-        provenance = "uncached"
-    else:
-        provenance = "fresh" if cache_entries_delta > 0 else "persistent-cache"
+    if provenance is None:
+        if not cache_enabled:
+            provenance = "uncached"
+        else:
+            provenance = (
+                "fresh" if cache_entries_delta > 0 else "persistent-cache"
+            )
     record = cost_ledger().add(
         ProgramRecord(
             program=program,
@@ -276,6 +290,7 @@ def record_compiled(
             cache_entries_delta=int(cache_entries_delta),
             bucket=bucket,
             t_ns=time.perf_counter_ns(),
+            saved_s=float(saved_s) if saved_s is not None else None,
             **_memory_fields(compiled),
         )
     )
@@ -285,11 +300,22 @@ def record_compiled(
         help="AOT programs compiled, by logical program and provenance",
         program=program, provenance=provenance,
     ).inc()
-    reg.counter(
-        "fmrp_program_compile_seconds_total",
-        help="wall seconds spent lowering+compiling, by program",
-        program=program,
-    ).inc(record.lower_s + record.compile_s)
+    if provenance == "deserialized":
+        # a registry fetch's wall is verify+deserialize I/O, not compile —
+        # keeping it out of the compile-seconds series is the whole point
+        # of the fresh-vs-deserialized provenance split
+        reg.counter(
+            "fmrp_registry_fetch_seconds_total",
+            help="wall seconds spent verifying+deserializing registry "
+                 "executables, by program",
+            program=program,
+        ).inc(record.lower_s + record.compile_s)
+    else:
+        reg.counter(
+            "fmrp_program_compile_seconds_total",
+            help="wall seconds spent lowering+compiling, by program",
+            program=program,
+        ).inc(record.lower_s + record.compile_s)
     if record.flops is not None:
         reg.gauge(
             "fmrp_program_flops",
@@ -324,11 +350,20 @@ def timed_aot_compile(jitted, *args, program: str,
     accounting the result via :func:`record_compiled`. Returns the
     ``Compiled`` executable (call it with the array args only).
 
-    The one AOT entry the serving executor and the specgrid program
-    share, so every compiled program in those paths lands in the ledger
-    with the same fields."""
+    The one AOT entry the serving executor, the specgrid program, and
+    the panel characteristics program share, so every compiled program
+    in those paths lands in the ledger with the same fields — and the
+    one place the registry's EXECUTABLE PLANE rides: with
+    ``FMRP_REGISTRY_DIR`` armed, the finished executable is fetched
+    (zero traces, zero compiles; ledger provenance "deserialized")
+    before any lowering happens, and a fresh compile is stored back for
+    the next process. Registry failures of any kind degrade silently to
+    the fresh-compile path."""
     if signature is None:
         signature = arg_signature(args, static_kwargs)
+    fetched = _registry_fetch(program, signature, bucket)
+    if fetched is not None:
+        return fetched
     cache_enabled = _persistent_cache_enabled()
     # one compile-measurement window at a time: provenance comes from a
     # GLOBAL cache-dir entry diff, so two concurrent windows would
@@ -356,7 +391,86 @@ def timed_aot_compile(jitted, *args, program: str,
         cache_enabled=cache_enabled,
         bucket=bucket,
     )
+    _registry_store(program, signature, compiled, lowered=lowered,
+                    bucket=bucket, compile_s=t2 - t0)
     return compiled
+
+
+def _registry_fetch(program: str, signature: str, bucket: Optional[int]):
+    """Executable-plane fetch for :func:`timed_aot_compile`: the loaded
+    executable (ledger-recorded as provenance "deserialized"), or None —
+    registry off, miss, skew, corruption — in which case the caller
+    compiles fresh. Never raises."""
+    try:
+        from fm_returnprediction_tpu.registry import executables as _rexe
+        from fm_returnprediction_tpu.registry.store import active_registry
+
+        reg = active_registry()
+        if reg is None:
+            return None
+        loaded = _rexe.load_executable(program, signature, registry=reg)
+        outcome = "hit" if loaded is not None else "miss"
+        _metrics.registry().counter(
+            "fmrp_registry_executable_fetches_total",
+            help="registry executable-plane lookups by program and outcome",
+            program=program, outcome=outcome,
+        ).inc()
+        if loaded is None:
+            return None
+        record_compiled(
+            program, loaded.compiled, signature,
+            lower_s=0.0, compile_s=loaded.load_s,
+            cache_entries_delta=0,
+            bucket=bucket,
+            provenance="deserialized",
+            saved_s=loaded.meta.get("compile_s"),
+        )
+        return loaded.compiled
+    except Exception:  # noqa: BLE001 — the registry must never break a
+        return None    # compile; a broken tree reads as a miss
+
+
+def _registry_store(program: str, signature: str, compiled, lowered,
+                    bucket: Optional[int], compile_s: float) -> None:
+    """Persist a fresh compile into the registry (no-op when off; store
+    failures warn inside and never propagate)."""
+    try:
+        from fm_returnprediction_tpu.registry import executables as _rexe
+        from fm_returnprediction_tpu.registry.store import active_registry
+
+        reg = active_registry()
+        if reg is None:
+            return
+        _rexe.store_executable(
+            program, signature, compiled, registry=reg, bucket=bucket,
+            lowered=lowered, compile_s=compile_s,
+        )
+    except Exception:  # noqa: BLE001 — persistence is an accelerant
+        pass
+
+
+def provenance_summary(records: Optional[List[ProgramRecord]] = None) -> dict:
+    """Per-program fresh-vs-deserialized accounting over the ledger (or
+    an explicit record window): compile counts by provenance, the wall
+    seconds paid fresh, the verify+deserialize seconds paid on fetches,
+    and the store-time compile seconds those fetches did NOT pay
+    (``saved_s``) — the bench's ``registry_*`` series, so the registry's
+    win is a tracked number instead of a one-off claim."""
+    out: Dict[str, dict] = {}
+    for r in (cost_ledger().records() if records is None else records):
+        d = out.setdefault(r.program, {
+            "fresh": 0, "persistent-cache": 0, "uncached": 0,
+            "deserialized": 0,
+            "fresh_compile_s": 0.0, "deserialize_s": 0.0, "saved_s": 0.0,
+        })
+        d[r.provenance] = d.get(r.provenance, 0) + 1
+        if r.provenance == "deserialized":
+            d["deserialize_s"] += r.lower_s + r.compile_s
+            if r.saved_s is not None:
+                d["saved_s"] += r.saved_s
+        else:
+            d["fresh_compile_s"] += r.lower_s + r.compile_s
+    return out
 
 
 def _persistent_cache_enabled() -> bool:
@@ -371,16 +485,21 @@ def _persistent_cache_enabled() -> bool:
         return False
 
 
+def _sig_part(a) -> str:
+    shape = getattr(a, "shape", None)
+    if shape is not None:
+        return f"{tuple(shape)}:{getattr(a, 'dtype', None)}"
+    if isinstance(a, (list, tuple)):
+        # pytree containers recurse (the panel characteristics program
+        # passes a list of arrays) — repr of a container would embed full
+        # array reprs into the key
+        return "[" + ",".join(_sig_part(x) for x in a) + "]"
+    return repr(a)
+
+
 def arg_signature(args, static_kwargs=None) -> str:
     """Deterministic shape/dtype/static key for an AOT cache + the ledger."""
-    parts = []
-    for a in args:
-        shape = getattr(a, "shape", None)
-        dtype = getattr(a, "dtype", None)
-        if shape is None:
-            parts.append(repr(a))
-        else:
-            parts.append(f"{tuple(shape)}:{dtype}")
+    parts = [_sig_part(a) for a in args]
     if static_kwargs:
         parts.append(
             "|".join(f"{k}={static_kwargs[k]!r}" for k in sorted(static_kwargs))

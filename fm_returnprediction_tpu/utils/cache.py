@@ -254,13 +254,13 @@ _BUNDLE_HASH_KEY = "__sha256__"  # meta-dict slot for the content checksum
 
 def _bundle_digest(arrays: Dict[str, np.ndarray]) -> str:
     """Order-independent content hash over (name, dtype, shape, bytes) of
-    every array — the integrity contract ``load_array_bundle`` verifies."""
-    h = hashlib.sha256()
-    for name in sorted(arrays):
-        arr = np.ascontiguousarray(arrays[name])
-        h.update(f"{name}|{arr.dtype.str}|{arr.shape}|".encode())
-        h.update(arr.data)
-    return h.hexdigest()
+    every array — the integrity contract ``load_array_bundle`` verifies.
+    The ONE definition lives in ``registry.integrity`` (shared with the
+    drift sentinel's array-artifact hash); the digest is byte-identical
+    to every bundle written before the dedup."""
+    from fm_returnprediction_tpu.registry.integrity import array_bundle_digest
+
+    return array_bundle_digest(arrays)
 
 
 def save_array_bundle(

@@ -1,0 +1,478 @@
+"""Registry tests: executable round-trips, corruption, version skew,
+artifact plane, warm-pool zero-compile, shared integrity, CLI.
+
+The contracts pinned here are the ISSUE-9 acceptance surface:
+
+- executable serialize → (new-process) deserialize → BIT-IDENTICAL
+  outputs, ledger provenance "deserialized";
+- corrupt/truncated entry → typed ``CorruptArtifactError`` from the
+  verify surface, transparent rebuild (fresh compile) from the fetch
+  surface;
+- jax-version skew invalidates (never loads a foreign stack's binary);
+- ``warm_from_registry`` reaches quoting-ready with zero process-local
+  compiles (ledger fresh==0 AND ``fmrp_jit_traces_total`` growth==0),
+  differentially pinned bit-identical to the in-process warm-up path;
+- the three historical integrity paths (prepared manifest, array-bundle
+  checksum, drift array hash) share ONE digest definition.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from fm_returnprediction_tpu.registry import (  # noqa: E402
+    CorruptArtifactError,
+    Registry,
+    array_bundle_digest,
+    executable_key,
+    load_executable,
+    warm_from_registry,
+)
+from fm_returnprediction_tpu.registry import artifacts as rart  # noqa: E402
+from fm_returnprediction_tpu.registry import executables as rexe  # noqa: E402
+from fm_returnprediction_tpu.registry.store import (  # noqa: E402
+    META_FILE,
+    active_registry,
+)
+from fm_returnprediction_tpu.telemetry import cost_ledger  # noqa: E402
+from fm_returnprediction_tpu.telemetry import perf as tperf  # noqa: E402
+
+pytestmark = pytest.mark.registry
+
+
+@pytest.fixture
+def reg_dir(tmp_path, monkeypatch):
+    root = tmp_path / "registry"
+    monkeypatch.setenv("FMRP_REGISTRY_DIR", str(root))
+    return root
+
+
+def _program():
+    return jax.jit(lambda a, b: (a @ b + 1.0).sum(axis=0))
+
+
+def _args():
+    return (jnp.arange(12.0).reshape(3, 4), jnp.ones((4, 2)))
+
+
+# -- executable plane --------------------------------------------------------
+
+
+def test_executable_roundtrip_bit_identical(reg_dir):
+    a, b = _args()
+    fresh = tperf.timed_aot_compile(_program(), a, b, program="reg_rt")
+    rec = cost_ledger().records()[-1]
+    assert rec.program == "reg_rt"
+    assert rec.provenance in ("fresh", "persistent-cache", "uncached")
+    want = np.asarray(fresh(a, b))
+
+    fetched = tperf.timed_aot_compile(_program(), a, b, program="reg_rt")
+    rec2 = cost_ledger().records()[-1]
+    assert rec2.provenance == "deserialized"
+    assert rec2.lower_s == 0.0 and rec2.compile_s > 0.0
+    # saved_s carries the store-time compile seconds (the bench series)
+    assert rec2.saved_s is not None and rec2.saved_s > 0.0
+    np.testing.assert_array_equal(np.asarray(fetched(a, b)), want)
+
+
+def test_new_process_deserialize_bit_identical(reg_dir):
+    """The actual cold-start contract: a process that never compiled the
+    program loads the entry and reproduces the outputs bit for bit."""
+    a, b = _args()
+    compiled = tperf.timed_aot_compile(_program(), a, b, program="reg_np")
+    want = np.asarray(compiled(a, b))
+    signature = tperf.arg_signature((a, b), None)
+    out_file = reg_dir.parent / "child_out.npy"
+    child = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from fm_returnprediction_tpu.registry import load_executable\n"
+        f"loaded = load_executable('reg_np', {signature!r})\n"
+        "assert loaded is not None, 'registry miss in child'\n"
+        "a = jnp.arange(12.0).reshape(3, 4); b = jnp.ones((4, 2))\n"
+        f"np.save({str(out_file)!r}, np.asarray(loaded.compiled(a, b)))\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "FMRP_REGISTRY_DIR": str(reg_dir)}
+    proc = subprocess.run(
+        [sys.executable, "-c", child], env=env, capture_output=True,
+        text=True, timeout=240, cwd=str(Path(__file__).parent.parent),
+    )
+    assert proc.returncode == 0, proc.stderr
+    np.testing.assert_array_equal(np.load(out_file), want)
+
+
+def test_corrupt_entry_typed_error_and_transparent_rebuild(reg_dir):
+    a, b = _args()
+    tperf.timed_aot_compile(_program(), a, b, program="reg_corrupt")
+    reg = Registry(reg_dir)
+    key = executable_key(
+        "reg_corrupt", tperf.arg_signature((a, b), None)
+    )
+    entry = reg.executable_dir(key)
+    payload = entry / rexe.PAYLOAD_FILE
+    blob = bytearray(payload.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    payload.write_bytes(blob)
+
+    # the verify surface reports it as the TYPED error / a corrupt row
+    with pytest.raises(CorruptArtifactError):
+        reg.verify_entry(entry, deep=True)
+    bad = reg.verify(deep=True)
+    assert any(key in row["path"] for row in bad)
+
+    # the fetch surface degrades: miss, entry dropped, fresh compile
+    assert load_executable(
+        "reg_corrupt", tperf.arg_signature((a, b), None)
+    ) is None
+    assert not (entry / META_FILE).exists()
+    rebuilt = tperf.timed_aot_compile(_program(), a, b,
+                                      program="reg_corrupt")
+    assert cost_ledger().records()[-1].provenance != "deserialized"
+    assert np.isfinite(np.asarray(rebuilt(a, b))).all()
+
+
+def test_truncated_payload_is_a_miss(reg_dir):
+    a, b = _args()
+    tperf.timed_aot_compile(_program(), a, b, program="reg_trunc")
+    reg = Registry(reg_dir)
+    entry = reg.executable_dir(
+        executable_key("reg_trunc", tperf.arg_signature((a, b), None))
+    )
+    payload = entry / rexe.PAYLOAD_FILE
+    payload.write_bytes(payload.read_bytes()[:16])
+    assert load_executable(
+        "reg_trunc", tperf.arg_signature((a, b), None)
+    ) is None
+
+
+def test_version_skew_invalidates(reg_dir):
+    a, b = _args()
+    tperf.timed_aot_compile(_program(), a, b, program="reg_skew")
+    reg = Registry(reg_dir)
+    entry = reg.executable_dir(
+        executable_key("reg_skew", tperf.arg_signature((a, b), None))
+    )
+    meta = json.loads((entry / META_FILE).read_text())
+    meta["jax"] = "0.0.1-other"
+    (entry / META_FILE).write_text(json.dumps(meta))
+    # manifest still verifies; the ENVIRONMENT check refuses the entry
+    assert load_executable(
+        "reg_skew", tperf.arg_signature((a, b), None)
+    ) is None
+    # by DEFAULT gc retains it (skew is judged against this process's
+    # stack — a shared registry must survive maintenance from a foreign
+    # node); --drop-skewed opts in from the consumers' stack
+    assert reg.gc(keep=10) == []
+    dropped = reg.gc(keep=10, drop_skewed=True)
+    assert any(row["reason"] == "environment skew" for row in dropped)
+
+
+def test_code_salt_in_key(reg_dir, monkeypatch):
+    """A source change (different code salt) must address a DIFFERENT
+    entry — an old executable can never answer for new code."""
+    key_now = executable_key("p", "sig")
+    monkeypatch.setattr(rexe, "_SALT", "something-else")
+    assert executable_key("p", "sig") != key_now
+
+
+def test_cpu_custom_call_program_not_stored(reg_dir):
+    """XLA CPU lowers linalg (eigh/qr/svd — LAPACK) to custom calls whose
+    serialized executables embed raw host function POINTERS: a consumer
+    process calling one segfaults. The store path must skip such programs
+    (they ride the persistent XLA cache instead), disclosed in
+    ``fmrp_registry_store_skipped_total``."""
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("CPU custom-call hazard is a CPU-backend property")
+    from fm_returnprediction_tpu.telemetry import metrics as tmetrics
+
+    a = jnp.eye(4)
+    prog = jax.jit(lambda g: jnp.linalg.eigh(g)[0])
+    compiled = tperf.timed_aot_compile(prog, a, program="reg_eigh")
+    assert np.isfinite(np.asarray(compiled(a))).all()
+    # nothing was stored: the entry is absent and the skip is counted
+    assert load_executable(
+        "reg_eigh", tperf.arg_signature((a,), None)
+    ) is None
+    skipped = tmetrics.registry().collect().get(
+        "fmrp_registry_store_skipped_total", {}
+    )
+    assert any(
+        dict(key).get("program") == "reg_eigh" for key in skipped
+    )
+
+
+def test_registry_off_is_passthrough(monkeypatch):
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    assert active_registry() is None
+    a, b = _args()
+    compiled = tperf.timed_aot_compile(_program(), a, b, program="reg_off")
+    assert cost_ledger().records()[-1].provenance != "deserialized"
+    assert np.isfinite(np.asarray(compiled(a, b))).all()
+
+
+# -- artifact plane ----------------------------------------------------------
+
+
+def test_artifact_roundtrip_and_corruption(reg_dir, tmp_path):
+    src = tmp_path / "payload.csv"
+    src.write_text("a,b\n1,2\n")
+    entry = rart.put_files("frames", "fp1", [src])
+    assert entry is not None
+    got = rart.get_file("frames", "payload.csv", "fp1", deep=True)
+    assert got is not None and got.read_text() == src.read_text()
+
+    # latest-entry resolution: a second fingerprint wins the default
+    src.write_text("a,b\n3,4\n")
+    rart.put_files("frames", "fp2", [src])
+    assert rart.get_entry_dir("frames").name == "fp2"
+
+    # corrupt the payload: deep get raises the TYPED error
+    (entry / "payload.csv").write_text("a,b\n9,9\n")
+    with pytest.raises(CorruptArtifactError):
+        rart.get_file("frames", "payload.csv", "fp1", deep=True)
+
+
+def test_serving_state_artifact_roundtrip(reg_dir, small_state):
+    rart.put_serving_state(small_state, "fpX")
+    loaded = rart.load_serving_state("fpX")
+    assert loaded is not None
+    np.testing.assert_array_equal(loaded.slopes_bar, small_state.slopes_bar)
+    np.testing.assert_array_equal(loaded.coef, small_state.coef)
+    assert loaded.xvars == small_state.xvars
+
+
+# -- warm pool ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_state():
+    from fm_returnprediction_tpu.serving.state import build_serving_state
+
+    rng = np.random.default_rng(7)
+    t, n, p = 30, 24, 3
+    y = rng.standard_normal((t, n)).astype(np.float32)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    mask = np.ones((t, n), bool)
+    return build_serving_state(y, x, mask, window=12, min_periods=6)
+
+
+def test_warm_from_registry_zero_compile_and_bit_identical(
+    reg_dir, small_state, monkeypatch
+):
+    from fm_returnprediction_tpu.serving.service import ERService
+
+    rart.put_serving_state(small_state, "fpW")
+    # populate the executable plane the way a publisher does
+    svc0, _ = warm_from_registry(state=small_state, max_batch=32)
+    svc0.close()
+
+    # the fresh-replica path: state AND executables resolve from the
+    # registry; nothing may trace or compile (record_trace counters)
+    svc, report = warm_from_registry(max_batch=32, strict=True)
+    try:
+        assert report.zero_compile
+        assert report.fresh_compiles == 0
+        assert report.trace_growth == 0
+        assert report.deserialized == len(svc.executor.buckets())
+        assert all(p.endswith("@deserialized") for p in report.programs)
+        assert report.saved_s > 0.0
+
+        # differential pin: bit-identical to the in-process warm-up path
+        m = int(np.nonzero(small_state.have_coef())[0][-1])
+        xs = np.linspace(-1.0, 1.0, small_state.n_predictors * 5).reshape(
+            5, small_state.n_predictors
+        ).astype(small_state.dtype)
+        got = svc.query_many([m] * 5, xs)
+    finally:
+        svc.close()
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    with ERService(small_state, max_batch=32) as ref:
+        want = ref.query_many([m] * 5, xs)
+    np.testing.assert_array_equal(got, want)
+    assert np.isfinite(want).all()
+
+
+def test_warm_from_registry_strict_raises_on_empty_registry(
+    reg_dir, small_state
+):
+    with pytest.raises(RuntimeError, match="not compile-free"):
+        warm_from_registry(state=small_state, max_batch=4, strict=True)
+
+
+def test_warm_from_registry_partial_miss_degrades(reg_dir, small_state):
+    """A partial registry is a legitimate degraded start: misses compile
+    fresh (and are stored), the report discloses them."""
+    svc, report = warm_from_registry(state=small_state, max_batch=8)
+    svc.close()
+    assert report.fresh_compiles == len(report.programs) > 0
+    svc2, report2 = warm_from_registry(state=small_state, max_batch=8,
+                                       strict=True)
+    svc2.close()
+    assert report2.zero_compile
+
+
+# -- shared integrity --------------------------------------------------------
+
+
+def test_one_digest_definition_across_paths(tmp_path):
+    """Bundle checksum, drift array hash, and the registry digest are ONE
+    definition — a manifest written before the dedup compares equal."""
+    from fm_returnprediction_tpu.guard.drift import summarize_arrays
+    from fm_returnprediction_tpu.utils.cache import (
+        load_array_bundle,
+        save_array_bundle,
+    )
+
+    arrays = {
+        "a": np.arange(6.0).reshape(2, 3),
+        "b": np.array([True, False]),
+    }
+    digest = array_bundle_digest(arrays)
+    # the drift sentinel's array-artifact identity hash
+    assert summarize_arrays(arrays)["sha256"] == digest
+    # the bundle embeds and verifies the same digest
+    path = save_array_bundle(tmp_path / "bundle.npz", arrays)
+    loaded, _ = load_array_bundle(path)
+    assert array_bundle_digest(loaded) == digest
+    # the frozen historical definition, byte for byte
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        h.update(f"{name}|{arr.dtype.str}|{arr.shape}|".encode())
+        h.update(arr.data)
+    assert digest == h.hexdigest()
+
+
+def test_prepared_candidates_route_through_registry(tmp_path, monkeypatch):
+    from fm_returnprediction_tpu.data.prepared import prepared_candidates
+
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    raw = tmp_path / "raw"
+    assert prepared_candidates(raw) == [raw / "_prepared"]
+
+    monkeypatch.setenv("FMRP_REGISTRY_DIR", str(tmp_path / "reg"))
+    cands = prepared_candidates(raw)
+    assert len(cands) == 2
+    assert str(cands[0]).startswith(str(tmp_path / "reg"))
+    assert cands[1] == raw / "_prepared"  # legacy read fallback stays
+    # distinct raw dirs get distinct registry slots
+    other = prepared_candidates(tmp_path / "raw2")
+    assert other[0] != cands[0]
+
+
+def test_prepared_slots_visible_to_maintenance(reg_dir):
+    """Prepared checkpoint slots — the tree's largest payloads — must be
+    covered by ls/verify/gc, not just the executable/artifact planes."""
+    from fm_returnprediction_tpu.registry.integrity import manifest_entry
+
+    slot = Registry(reg_dir).prepared_root("slot01")
+    slot.mkdir(parents=True)
+    payload = slot / "base.values.npy"
+    payload.write_bytes(b"\x93NUMPY-fake-payload")
+    (slot / "meta.json").write_text(json.dumps({
+        "fingerprint": "f", "version": 3,
+        "manifest": {"base.values.npy": manifest_entry(payload)},
+    }))
+    reg = Registry(reg_dir)
+    rows = [r for r in reg.ls() if r["kind"] == "prepared"]
+    assert len(rows) == 1 and rows[0]["bytes"] == payload.stat().st_size
+    assert reg.verify(deep=True) == []
+    # readable slots survive gc (they self-overwrite in place)
+    assert reg.gc(keep=1) == []
+    assert (slot / "meta.json").exists()
+    # corruption is flagged; a torn slot (no meta) is collected
+    payload.write_bytes(b"different-bytes-same-len")
+    assert any("base.values.npy" in r["error"] for r in reg.verify(deep=True))
+    (slot / "meta.json").unlink()
+    dropped = reg.gc(keep=1)
+    assert any(r["reason"] == "torn prepared slot" for r in dropped)
+    assert not slot.exists()
+
+
+def test_gc_keeps_complete_signature_sets(reg_dir):
+    """gc groups executables per (program, signature): a complete live
+    bucket set — many signatures of one program — is never thinned by
+    the default retention."""
+    for k in (2, 3, 5):
+        tperf.timed_aot_compile(
+            jax.jit(lambda x, y: (x @ y).sum()),
+            jnp.ones((k, 4)), jnp.ones((4, 2)),
+            program="reg_buckets",
+        )
+    reg = Registry(reg_dir)
+    assert reg.gc(keep=1) == []  # three signatures, three groups
+    assert sum(1 for r in reg.ls() if r.get("program") == "reg_buckets") == 3
+
+
+def test_serve_state_task_stale_until_registry_published(
+    reg_dir, tmp_path, small_state, monkeypatch
+):
+    """--registry-dir on an up-to-date DAG must not silently no-op: the
+    serve_state task reads as STALE while the armed registry lacks this
+    panel's serving-state entry, and current again once published."""
+    from fm_returnprediction_tpu.registry.integrity import file_sha256
+    from fm_returnprediction_tpu.taskgraph.tasks import (
+        PANEL_FILE,
+        _serve_state_registry_current,
+    )
+
+    processed = tmp_path / "processed"
+    processed.mkdir()
+    panel = processed / PANEL_FILE
+    panel.write_bytes(b"panel-checkpoint-bytes")
+
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    assert _serve_state_registry_current(processed)  # registry off: no opinion
+    monkeypatch.setenv("FMRP_REGISTRY_DIR", str(reg_dir))
+    assert not _serve_state_registry_current(processed)  # armed, empty: stale
+    rart.put_serving_state(small_state, file_sha256(panel)[:32])
+    assert _serve_state_registry_current(processed)  # published: current
+
+
+# -- maintenance CLI ---------------------------------------------------------
+
+
+def test_cli_ls_verify_gc(reg_dir, capsys):
+    from fm_returnprediction_tpu.registry.__main__ import main
+
+    a, b = _args()
+    tperf.timed_aot_compile(_program(), a, b, program="reg_cli")
+    assert main(["--registry-dir", str(reg_dir), "ls"]) == 0
+    assert "reg_cli" in capsys.readouterr().out
+
+    assert main(["--registry-dir", str(reg_dir), "verify"]) == 0
+
+    # corrupt → verify exits 1 and names the entry
+    reg = Registry(reg_dir)
+    entry = reg.executable_dir(
+        executable_key("reg_cli", tperf.arg_signature((a, b), None))
+    )
+    payload = entry / rexe.PAYLOAD_FILE
+    payload.write_bytes(payload.read_bytes()[:-4] + b"XXXX")
+    assert main(["--registry-dir", str(reg_dir), "verify"]) == 1
+
+    # gc --dry-run reports, gc drops (keep=0 clears everything)
+    assert main(["--registry-dir", str(reg_dir), "gc", "--keep", "0",
+                 "--dry-run"]) == 0
+    assert (entry / META_FILE).exists()
+    assert main(["--registry-dir", str(reg_dir), "gc", "--keep", "0"]) == 0
+    assert not (entry / META_FILE).exists()
+
+
+def test_cli_no_root_exits_2(monkeypatch, capsys):
+    from fm_returnprediction_tpu.registry.__main__ import main
+
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    assert main(["ls"]) == 2
