@@ -1030,6 +1030,189 @@ def _bench_serving(fast: bool):
     }
 
 
+def _bench_fleet(fast: bool):
+    """Resilient serving fleet under sustained multi-worker load
+    (``serving.fleet``, ISSUE 10): 3 replicas behind the admission-
+    controlled front tier, driven by 8 query workers through three
+    phases —
+
+    - ``fleet_rows_per_s`` / ``fleet_p99_ms_steady``  — (a) steady state
+      (the higher-is-better throughput series the PR-6 regress sentinel
+      gates; a warm repeat runs under ``recompile_watch`` so any fleet
+      re-trace is flagged);
+    - ``fleet_rows_per_s_swap`` / ``fleet_p99_ms_swap`` — (b) THROUGH a
+      two-phase zero-downtime state rollover fired mid-phase;
+    - ``fleet_rows_per_s_kill`` / ``fleet_p99_ms_kill`` — (c) THROUGH a
+      replica kill + supervisor failover fired mid-phase, the
+      replacement starting compile-free from the registry warm pool
+      (``fleet_failover_*`` = its WarmReport evidence).
+
+    ``fleet_journal`` is the write-ahead journal's replay verdict over
+    ALL phases: zero dropped / zero duplicated is the exactly-once proof
+    demanded by the acceptance criteria, reported (not asserted) here
+    and asserted in ``tests/test_fleet.py``. FMRP_BENCH_FLEET=0 skips;
+    _FLEET_QUERIES resizes each phase."""
+    if os.environ.get("FMRP_BENCH_FLEET", "1") == "0":
+        return {}
+    import tempfile
+    import threading as _threading
+
+    from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.registry.store import using_registry
+    from fm_returnprediction_tpu.serving import (
+        ERService,
+        ServingFleet,
+        build_serving_state,
+        ingest_month,
+        replay_journal,
+    )
+
+    t, n, p = (60, 200, 5) if fast else (240, 1000, 5)
+    per_phase = int(os.environ.get(
+        "FMRP_BENCH_FLEET_QUERIES", 300 if fast else 2000
+    ))
+    n_workers = 8
+    rng = np.random.default_rng(2015)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(
+        y, x, mask, window=min(120, t // 2), min_periods=min(60, t // 4)
+    )
+    new_state = ingest_month(
+        state, y[-1], x[-1], mask[-1], np.datetime64("2035-01-31", "ns")
+    )
+
+    out = {}
+    with tempfile.TemporaryDirectory() as root:
+        reg_dir = os.path.join(root, "registry")
+        # populate the warm pool for BOTH versions: one process compiles,
+        # every replica (incl. the failover replacement and the rollover
+        # prepare) fetches — the registry story applied to the fleet
+        with using_registry(reg_dir):
+            ERService(state, max_batch=64, auto_flush=False).close()
+            ERService(new_state, max_batch=64, auto_flush=False).close()
+        journal = os.path.join(root, "journal.jsonl")
+        fleet = ServingFleet(
+            state, 3, max_batch=64, max_latency_ms=1.0,
+            registry_dir=reg_dir, journal=journal,
+        )
+        out["fleet_zero_compile_starts"] = sum(
+            1 for r in fleet.warm_reports.values() if r.zero_compile
+        )
+
+        errors = []
+
+        def drive(action=None):
+            """One phase: n_workers blocking-query threads; ``action``
+            fires from the driver thread once roughly half the phase has
+            completed (the swap/kill lands genuinely mid-load). A failed
+            query must not poison the quantiles with an uninitialized
+            slot OR silently kill its worker — it records NaN and an
+            error entry, disclosed as ``fleet_query_errors``."""
+            mon = rng.integers(t // 2, t, per_phase)
+            frm = rng.integers(0, n, per_phase)
+            lat = np.full(per_phase, np.nan)
+            chunk = per_phase // n_workers
+
+            def worker(k0, k1):
+                for k in range(k0, k1):
+                    t0 = time.perf_counter()
+                    try:
+                        fleet.query(int(mon[k]), x[mon[k], frm[k]])
+                    except Exception as exc:  # noqa: BLE001 - disclosed
+                        errors.append(repr(exc)[:200])
+                        continue
+                    lat[k] = time.perf_counter() - t0
+
+            # mid-phase trigger keys off COMPLETED queries THIS phase:
+            # done + failed, both baselined at phase start, so neither a
+            # shed storm (stalled poll) nor prior-phase errors (premature
+            # trigger under zero load) can misplace the swap/kill
+            base_done = fleet.stats()["agg_n_done"]
+            base_errors = len(errors)
+            t0 = time.perf_counter()
+            threads = [
+                _threading.Thread(target=worker, args=(
+                    k * chunk,
+                    (k + 1) * chunk if k < n_workers - 1 else per_phase,
+                ))
+                for k in range(n_workers)
+            ]
+            for th in threads:
+                th.start()
+            if action is not None:
+                while (
+                    fleet.stats()["agg_n_done"] - base_done
+                    + len(errors) - base_errors
+                    < per_phase // 2
+                ):
+                    time.sleep(0.002)
+                action()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            ok = int(np.isfinite(lat).sum())
+            return (
+                round(ok / wall, 1),
+                round(float(np.nanpercentile(lat, 99) * 1e3), 3)
+                if ok else None,
+            )
+
+        # (a) steady state + warm repeat under the recompile sentinel
+        out["fleet_rows_per_s"], out["fleet_p99_ms_steady"] = drive()
+        with telemetry.recompile_watch("fleet_steady", warm=True):
+            out["fleet_rows_per_s_warm"], _ = drive()
+
+        # (b) through a zero-downtime state swap
+        out["fleet_rows_per_s_swap"], out["fleet_p99_ms_swap"] = drive(
+            action=lambda: fleet.rollover(new_state)
+        )
+        out["fleet_version_after_swap"] = fleet.version
+
+        # (c) through a replica kill + supervised warm-pool failover
+        victim = sorted(fleet.replica_states())[0]
+
+        def kill_and_failover():
+            fleet.kill_replica(victim, reason="bench chaos")
+            fleet.supervisor.tick()   # replace immediately
+
+        out["fleet_rows_per_s_kill"], out["fleet_p99_ms_kill"] = drive(
+            action=kill_and_failover
+        )
+        stats = fleet.stats()
+        out["fleet_requeues"] = stats["requeues_total"]
+        out["fleet_failovers"] = stats["failovers_total"]
+        replacement = max(
+            fleet.warm_reports, key=lambda rid: int(rid.lstrip("r"))
+        )
+        report = fleet.warm_reports[replacement]
+        out["fleet_failover_fresh_compiles"] = report.fresh_compiles
+        out["fleet_failover_deserialized"] = report.deserialized
+        out["fleet_failover_wall_s"] = round(report.wall_s, 4)
+        out["fleet_failover_compile_s_saved"] = round(report.saved_s, 4)
+
+        fleet.drain(timeout=30)
+        fleet.close()
+        out["fleet_query_errors"] = len(errors)
+        if errors:
+            out["fleet_query_error_sample"] = errors[0]
+        replay = replay_journal(journal)
+        out["fleet_journal"] = {
+            "admitted": replay.n_admitted,
+            "done": replay.n_done,
+            "requeues": replay.n_requeues,
+            "shed": replay.n_shed,
+            "dropped": len(replay.dropped),
+            "duplicated": len(replay.duplicated),
+            "clean": bool(replay.clean),
+        }
+    out["fleet_shape"] = f"T{t}_P{p}_R3_Q{per_phase}x4"
+    return out
+
+
 def _bench_resilience(fast: bool):
     """The fault-tolerance layer's numbers (``resilience`` subsystem):
 
@@ -1861,8 +2044,8 @@ def main() -> None:
     # Every section has an off switch so a short accelerator window can be
     # spent on exactly the missing measurement (the tunnel comes and goes;
     # a full run is ~45 min, the real-shape section alone ~10): FMRP_BENCH_
-    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _SPECGRID /
-    # _RESIL / _FUSEPROBE / _MESH8 = 0.
+    # PIPE / _REAL / _KERNEL / _DAILY / _PALLAS / _SERVING / _FLEET /
+    # _SPECGRID / _RESIL / _FUSEPROBE / _MESH8 = 0.
     # Default: all on. mesh8 and fuseprobe run their real-shape ladders on
     # TPU rounds and disclosed small-shape variants on CPU rounds.
     sections = []
@@ -1878,6 +2061,7 @@ def main() -> None:
         sections.append(_bench_pallas)
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
+    sections.append(_bench_fleet)  # _FLEET=0 handled in-section
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section

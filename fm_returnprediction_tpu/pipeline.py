@@ -1028,6 +1028,16 @@ def _main() -> None:
              "cold process skips recompiles and rebuilds; default "
              "follows FMRP_REGISTRY_DIR",
     )
+    parser.add_argument(
+        "--fleet-size", type=int, default=None, metavar="N",
+        help="after the pipeline completes, stand up an N-replica "
+             "serving fleet on the fitted serving state and run the "
+             "admission-controlled query smoke (supervised replicas, "
+             "consistent-hash routing, 429-style load shedding); "
+             "default follows FMRP_FLEET_SIZE when set — "
+             "FMRP_FLEET_RATE/_BURST/_SHED_OCCUPANCY shape admission, "
+             "FMRP_FLEET_JOURNAL arms the request journal",
+    )
     args = parser.parse_args()
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -1065,6 +1075,37 @@ def _main() -> None:
     print(result.table_2.to_string())
     print()
     print(result.timer.report())
+    import os as _os
+
+    fleet_size = args.fleet_size
+    if fleet_size is None and _os.environ.get("FMRP_FLEET_SIZE"):
+        fleet_size = int(_os.environ["FMRP_FLEET_SIZE"])
+    if fleet_size:
+        if result.serving_state is None:
+            print("fleet smoke skipped: no serving state was built "
+                  "(make_serving off or no 'All stocks' subset)")
+        else:
+            # guarded like the registry-publish block: a smoke failure
+            # must not turn the finished pipeline run into a nonzero exit
+            try:
+                import json as _json
+
+                from fm_returnprediction_tpu.serving.fleet import fleet_smoke
+
+                smoke = fleet_smoke(
+                    result.serving_state, fleet_size,
+                    registry_dir=args.registry_dir,
+                )
+                print()
+                print("serving fleet smoke: "
+                      + _json.dumps(smoke, sort_keys=True))
+            except Exception as exc:  # noqa: BLE001 — disclosed, not fatal
+                import warnings
+
+                warnings.warn(
+                    f"fleet smoke failed (pipeline result unaffected): "
+                    f"{exc!r}", stacklevel=1,
+                )
 
 
 if __name__ == "__main__":

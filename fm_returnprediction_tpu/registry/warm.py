@@ -63,6 +63,7 @@ def warm_from_registry(
     registry_dir=None,
     fingerprint: Optional[str] = None,
     strict: bool = False,
+    service_cls=None,
     **service_kwargs,
 ):
     """Build a quoting-ready ``ERService`` from the registry.
@@ -78,7 +79,10 @@ def warm_from_registry(
     Returns ``(service, report)``. ``strict=True`` raises when the
     zero-compile contract was missed (a partial registry is otherwise a
     legitimate degraded start: the misses compiled fresh and were stored
-    for the next replica)."""
+    for the next replica). ``service_cls`` lets a caller substitute an
+    ``ERService`` subclass — the serving FLEET fans its replicas out
+    through here with its replica-aware service class, so every failover
+    replacement starts compile-free too."""
     from pathlib import Path
 
     from fm_returnprediction_tpu.registry import artifacts as _artifacts
@@ -102,11 +106,12 @@ def warm_from_registry(
         elif isinstance(state, (str, Path)):
             state = ServingState.load(state)
 
+        cls = service_cls if service_cls is not None else ERService
         ledger = cost_ledger()
         seq0 = ledger.last_seq
         traces0 = _trace_total()
         t0 = time.perf_counter()
-        service = ERService(state, warm=True, **service_kwargs)
+        service = cls(state, warm=True, **service_kwargs)
         wall = time.perf_counter() - t0
         # evidence is scoped to the serving program: other subsystems
         # compiling concurrently must not falsify this service's report

@@ -22,6 +22,9 @@ __all__ = [
     "IngestRejectedError",
     "ContractViolationError",
     "DriftDetectedError",
+    "ServiceOverloadError",
+    "ReplicaDeadError",
+    "StateRolloverError",
     "InjectedFault",
 ]
 
@@ -71,6 +74,55 @@ class DriftDetectedError(ContractViolationError):
     """A persisted artifact moved beyond its tolerance band relative to the
     previous run's audit manifest (``guard.drift``). The trusted manifest
     is left unmodified so the regression remains reproducible against it."""
+
+
+class ServiceOverloadError(ResilienceError):
+    """The serving fleet shed this request at admission (429-style).
+
+    RETRIABLE by contract: the request was refused before any replica saw
+    it, so a resubmit can never double-serve. Carries the shed decision's
+    evidence so callers and SLO burn attribution need not re-derive it:
+
+    - ``retry_after_s`` — the admission controller's hint for when capacity
+      should exist again (token-bucket refill time, or the estimated queue
+      drain time);
+    - ``reason``        — ``"token_bucket"`` | ``"queue_occupancy"`` |
+      ``"replica_backpressure"`` | ``"no_healthy_replicas"``;
+    - ``queue_depth`` / ``queue_ceiling`` — aggregate pending requests vs
+      the fleet's total queue capacity at decision time (None when the
+      reason carries no queue evidence).
+    """
+
+    def __init__(self, message: str, *, retry_after_s: float = 0.0,
+                 reason: str = "overload", queue_depth=None,
+                 queue_ceiling=None):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = str(reason)
+        self.queue_depth = queue_depth
+        self.queue_ceiling = queue_ceiling
+
+    @property
+    def occupancy(self):
+        """Queue fill fraction at decision time (None without evidence)."""
+        if not self.queue_ceiling:
+            return None
+        return self.queue_depth / self.queue_ceiling
+
+
+class ReplicaDeadError(ResilienceError):
+    """A serving replica died (killed, crashed, or failed its health
+    probe) with this request still queued on it. The fleet front tier
+    catches this and REQUEUES the request on a healthy replica; it only
+    reaches a caller when every requeue attempt is exhausted."""
+
+
+class StateRolloverError(ResilienceError):
+    """A fleet-wide versioned state rollover aborted during the PREPARE
+    phase (validation failure, poisoned candidate state, or a warm-up
+    error on some replica). By protocol nothing has flipped yet — every
+    replica is still serving the previous version — so the fleet remains
+    consistent; the error names the replica and cause."""
 
 
 class InjectedFault(OSError):

@@ -47,6 +47,10 @@ __all__ = [
     "corrupt_panel_permute_firms",
     "corrupt_panel_stale_month",
     "corrupt_panel_scale_spike",
+    "fleet_kill_routed",
+    "fleet_stall_replica",
+    "fleet_trigger_staged_rollover",
+    "poison_serving_state_nan",
 ]
 
 # The installed plan. Plain module global on purpose: the inactive-path
@@ -286,6 +290,80 @@ def corrupt_panel_scale_spike(panel, column: int = -1, scale: float = 1e20):
     values = np.array(np.asarray(panel.values), copy=True)
     values[:, :, column] = values[:, :, column] * values.dtype.type(scale)
     return _panel_replace(panel, values=values)
+
+
+# -- fleet fault mutators ----------------------------------------------------
+#
+# The serving fleet's fault sites (``serving.fleet``) carry LIVE OBJECTS as
+# payloads — the fleet itself, or (fleet, routed replica id) — so a chaos
+# plan can act on fleet topology at a deterministic point in the request
+# stream (the spec's skip/times counters pick WHICH request). Each mutator
+# returns the payload unchanged: these sites poison the WORLD, not the data.
+#
+#   fleet.replica_kill    — visited after a request lands in flight on its
+#                           routed replica; ``fleet_kill_routed`` kills that
+#                           replica mid-flight (the requeue path under test)
+#   fleet.replica_stall   — visited at each replica dispatch with its id;
+#                           ``fleet_stall_replica`` stalls ONE replica so the
+#                           dispatch watchdog + supervisor see a stall
+#   fleet.swap_mid_flight — visited inside the admitted-submit path;
+#                           ``fleet_trigger_staged_rollover`` fires the
+#                           staged version swap between two specific requests
+#   fleet.poison_state    — visited per replica during rollover PREPARE;
+#                           ``poison_serving_state_nan`` corrupts the
+#                           candidate so validation must abort with 0 flips
+
+
+def fleet_kill_routed(rid: Optional[str] = None):
+    """Mutator factory for ``fleet.replica_kill``: kill the replica the
+    triggering request was just routed to (payload ``(fleet, routed_rid)``)
+    — or only when it is ``rid``, for targeted kills."""
+
+    def mutate(payload):
+        fleet, routed = payload
+        if rid is None or routed == rid:
+            fleet.kill_replica(routed, reason="chaos: fleet.replica_kill")
+        return payload
+
+    return mutate
+
+
+def fleet_stall_replica(rid: str, delay_s: float):
+    """Mutator factory for ``fleet.replica_stall``: stall exactly one
+    replica's dispatches (payload is the dispatching replica's id) — the
+    shape a wedged device runner presents to the PR-2 watchdog and the
+    supervisor's timeout-rate probe."""
+
+    def mutate(payload):
+        if payload == rid:
+            time.sleep(delay_s)
+        return payload
+
+    return mutate
+
+
+def fleet_trigger_staged_rollover(payload):
+    """Mutator for ``fleet.swap_mid_flight``: fire the fleet's staged
+    state rollover NOW, from inside the submit path — the swap window
+    lands deterministically between two known requests."""
+    payload.trigger_staged_rollover()
+    return payload
+
+
+def poison_serving_state_nan(state):
+    """A rollover candidate whose every lagged coefficient is NaN — the
+    poisoned-refit shape. Declared catch: the fleet's candidate
+    validation rejects it during PREPARE (``StateRolloverError``, zero
+    replicas flipped)."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    return _dc.replace(
+        state,
+        slopes_bar=np.full_like(np.asarray(state.slopes_bar), np.nan),
+        intercept_bar=np.full_like(np.asarray(state.intercept_bar), np.nan),
+    )
 
 
 def fault_site(site: str, payload=None, path=None):

@@ -10,6 +10,16 @@ compile (`executor`), the request/response front-end with qps/latency/
 occupancy/cache counters (`service`), and incremental month ingest that
 appends a cross-section by sufficient-statistics merge instead of a refit
 (`ingest`).
+
+Above the single service sits the FLEET tier (`fleet`/`supervisor`/
+`journal`): N supervised replicas behind one admission-controlled submit
+path — consistent-hash routing that excludes draining/dead replicas,
+token-bucket + queue-occupancy load shedding (typed 429-style
+``ServiceOverloadError`` with retry-after hints), heartbeat/health-probe
+supervision with drain-and-replace failover through the registry warm
+pool, two-phase zero-downtime state rollover, and a write-ahead request
+journal whose deterministic replay proves zero dropped / zero duplicated
+in-flight requests across swaps and replica deaths.
 """
 
 from fm_returnprediction_tpu.serving.batcher import MicroBatcher, QueueFullError
@@ -18,12 +28,28 @@ from fm_returnprediction_tpu.serving.executor import (
     bucket_for,
     bucket_sizes,
 )
+from fm_returnprediction_tpu.serving.fleet import (
+    AdmissionPolicy,
+    HashRing,
+    ServingFleet,
+    TokenBucket,
+    fleet_smoke,
+)
 from fm_returnprediction_tpu.serving.ingest import ingest_month
+from fm_returnprediction_tpu.serving.journal import (
+    JournalReplay,
+    RequestJournal,
+    replay_journal,
+)
 from fm_returnprediction_tpu.serving.service import ERService
 from fm_returnprediction_tpu.serving.state import (
     ServingState,
     build_serving_state,
     build_serving_state_from_panel,
+)
+from fm_returnprediction_tpu.serving.supervisor import (
+    HealthPolicy,
+    Supervisor,
 )
 
 __all__ = [
@@ -37,4 +63,14 @@ __all__ = [
     "bucket_for",
     "ERService",
     "ingest_month",
+    "ServingFleet",
+    "AdmissionPolicy",
+    "TokenBucket",
+    "HashRing",
+    "fleet_smoke",
+    "RequestJournal",
+    "JournalReplay",
+    "replay_journal",
+    "Supervisor",
+    "HealthPolicy",
 ]

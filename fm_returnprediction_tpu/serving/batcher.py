@@ -46,7 +46,23 @@ class QueueFullError(RuntimeError):
 
     The backpressure contract: the service NEVER blocks a producer on a
     stalled consumer — it fails fast and lets the caller shed or retry.
+    Carries the evidence of the decision so shed logic and SLO burn
+    attribution upstream (the fleet's admission controller) never have to
+    re-derive it: ``queue_depth`` — pending requests at rejection time;
+    ``max_queue`` — the configured ceiling; ``occupancy`` — their ratio.
     """
+
+    def __init__(self, message: str, *, queue_depth=None, max_queue=None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+    @property
+    def occupancy(self):
+        """Queue fill fraction at rejection time (None when unknown)."""
+        if not self.max_queue or self.queue_depth is None:
+            return None
+        return self.queue_depth / self.max_queue
 
 
 class _Pending(NamedTuple):
@@ -74,6 +90,7 @@ class MicroBatcher:
         n_predictors: Optional[int] = None,
         min_bucket: int = 1,
         observer: Optional[Callable] = None,
+        metric_labels: Optional[dict] = None,
     ):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
@@ -105,35 +122,45 @@ class MicroBatcher:
         self._occupancy: deque = deque(maxlen=_METRICS_WINDOW)
         # counters live in the process-wide metrics registry (per-instance
         # instruments aggregated per family); stats() reads .value as the
-        # same plain ints it always returned
+        # same plain ints it always returned. ``metric_labels`` (e.g. the
+        # fleet's ``replica="r0"``) splits every family per labelset —
+        # absent, the export is byte-for-byte what it always was.
+        labels = dict(metric_labels or {})
         reg = telemetry.registry()
         self._m_done = reg.private_counter(
             "fmrp_serving_requests_done_total",
             help="requests answered (result or NaN) by the microbatcher",
+            **labels,
         )
         self._m_rejected = reg.private_counter(
             "fmrp_serving_requests_rejected_total",
             help="submissions refused under backpressure (QueueFullError)",
+            **labels,
         )
         self._m_batches = reg.private_counter(
             "fmrp_serving_batches_total", help="batches dispatched",
+            **labels,
         )
         self._m_failed = reg.private_counter(
             "fmrp_serving_requests_failed_total",
             help="requests whose batch runner raised",
+            **labels,
         )
         self._m_failed_batches = reg.private_counter(
             "fmrp_serving_failed_batches_total",
             help="batches whose runner raised",
+            **labels,
         )
         self._m_latency = reg.private_histogram(
             "fmrp_serving_request_latency_seconds",
             help="submit-to-result latency per request",
+            **labels,
         )
         self._m_occupancy = reg.private_histogram(
             "fmrp_serving_batch_occupancy",
             help="rows per dispatched bucket slot",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            **labels,
         )
         self._thread: Optional[threading.Thread] = None
         if auto_flush:
@@ -188,8 +215,9 @@ class MicroBatcher:
             )
             self._notify(None, False, rejected_depth)
             raise QueueFullError(
-                f"serving queue full ({self.max_queue} pending); "
-                "shed load or retry"
+                f"serving queue full ({rejected_depth} pending of "
+                f"{self.max_queue} ceiling); shed load or retry",
+                queue_depth=rejected_depth, max_queue=self.max_queue,
             )
         telemetry.event(
             "serving.submit", cat="serving",
@@ -309,6 +337,33 @@ class MicroBatcher:
             pass  # to kill the flusher thread or fail a submit
 
     # -- lifecycle / metrics ----------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        """Pending requests right now — a lock-free read (``len`` on a
+        deque is atomic) cheap enough for a fleet admission check on
+        every submit."""
+        return len(self._pending)
+
+    def abort(self, exc: BaseException) -> int:
+        """Abrupt death: stop accepting work and FAIL every queued request
+        with ``exc`` — no drain, no flush. The fleet failover path uses
+        this to model a replica crash: the failed futures are the signal
+        its front tier requeues on, so nothing is silently stranded. A
+        batch already mid-dispatch in the flusher still resolves on its
+        own (each future resolves exactly once either way). Returns how
+        many queued requests were failed."""
+        with self._cv:
+            self._closed = True
+            stranded = list(self._pending)
+            self._pending.clear()
+            self._cv.notify_all()
+        self._m_failed.inc(len(stranded))
+        for r in stranded:
+            if not r.future.cancelled():
+                r.future.set_exception(exc)
+            self._notify(None, False, None)
+        return len(stranded)
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop accepting work, then drain what is already queued — via the

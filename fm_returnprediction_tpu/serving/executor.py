@@ -123,6 +123,7 @@ class BucketedExecutor:
         max_batch: int = 256,
         min_bucket: int = 1,
         dispatch_timeout_s: Optional[float] = None,
+        metric_labels: Optional[dict] = None,
     ):
         import jax.numpy as jnp
 
@@ -152,22 +153,30 @@ class BucketedExecutor:
         # ones — the registry folds a collected instance's final counts
         # into its retained base); the ``hits``/``misses``/... attribute
         # reads below stay plain ints for the service's stats() merge
+        labels = dict(metric_labels or {})
+        # fleet identity for the ``fleet.replica_stall`` chaos site (None
+        # outside a fleet — a targeted stall mutator then never matches)
+        self._replica_tag = labels.get("replica")
         reg = telemetry.registry()
         self._m_hits = reg.private_counter(
             "fmrp_serving_executable_cache_hits_total",
             help="dispatches served by an already-compiled bucket",
+            **labels,
         )
         self._m_misses = reg.private_counter(
             "fmrp_serving_executable_cache_misses_total",
             help="dispatches that had to compile first",
+            **labels,
         )
         self._m_compiles = reg.private_counter(
             "fmrp_serving_executable_compiles_total",
             help="bucket executables built",
+            **labels,
         )
         self._m_timeouts = reg.private_counter(
             "fmrp_serving_dispatch_timeouts_total",
             help="dispatches failed by the watchdog",
+            **labels,
         )
 
     @property
@@ -276,6 +285,11 @@ class BucketedExecutor:
         bench p50 can see."""
 
         def call():
+            # both sites INSIDE the (optionally) watchdogged call: an
+            # injected stall is exactly what a wedged runner looks like —
+            # fleet.replica_stall carries this executor's replica id so a
+            # chaos mutator can stall one replica of a fleet specifically
+            fault_site("fleet.replica_stall", payload=self._replica_tag)
             fault_site("serving.dispatch")
             return exe(*self._state_args, month_idx, x, valid)
 

@@ -71,9 +71,14 @@ class ERService:
         dispatch_timeout_s: Optional[float] = None,
         merge_tolerance: Optional[float] = None,
         slos=None,
+        metric_labels: Optional[dict] = None,
     ):
         self.state = state
         self.timer = StageTimer()
+        # extra labels stamped on every metric family this service's
+        # batcher/executor register (the fleet passes ``replica="rN"`` so
+        # /metrics splits per replica); empty = historical unlabeled export
+        self._metric_labels = dict(metric_labels or {})
         # SLO monitor (telemetry.slo): explicit objectives, else the
         # FMRP_SLO_* env knobs; None when neither is set — the monitor is
         # pure observation, so arming it changes no serving behavior
@@ -111,6 +116,7 @@ class ERService:
             n_predictors=state.n_predictors,
             min_bucket=min_bucket,
             observer=self._observe_request if self.slo is not None else None,
+            metric_labels=self._metric_labels,
         )
         self._quarantined: dict = {}  # month label → rejection reason
         self._n_ingested = 0
@@ -141,6 +147,7 @@ class ERService:
             max_batch=self._max_batch,
             min_bucket=self._min_bucket,
             dispatch_timeout_s=self._dispatch_timeout_s,
+            metric_labels=self._metric_labels,
         )
 
     def _observe_request(self, latency_s, ok, queue_depth) -> None:
@@ -251,9 +258,20 @@ class ERService:
             # trace dir is armed)
             telemetry.dump_flight(f"serving.quarantine:{key}")
             return False
-        # publish: attribute assignment is atomic under the GIL, and
-        # append-only month slots mean an in-flight request resolved on the
-        # old state dispatches correctly on either executor
+        self._publish(new_state, new_exec)
+        self._n_ingested += 1
+        # a successful re-ingest of a quarantined month heals it
+        self._quarantined.pop(key, None)
+        return True
+
+    def _publish(self, new_state, new_exec) -> None:
+        """Atomically flip to an already-WARMED executor + state pair.
+
+        Attribute assignment is atomic under the GIL, and append-only
+        month slots mean an in-flight request resolved on the old state
+        dispatches correctly on either executor. The old executor retires
+        into a short deque so its counters keep aggregating until nothing
+        can still be running on it."""
         with self._swap_lock:
             self._retired.append(self.executor)
             while len(self._retired) > 4:  # nothing in-flight survives 4 swaps
@@ -264,10 +282,51 @@ class ERService:
                 self._exec_prior["timeouts"] += dead.timeouts
             self.state = new_state
             self.executor = new_exec
-        self._n_ingested += 1
-        # a successful re-ingest of a quarantined month heals it
-        self._quarantined.pop(key, None)
-        return True
+
+    # -- versioned state rollover (the fleet's two-phase protocol) ---------
+
+    def prepare_state(self, new_state):
+        """Phase 1 of a zero-downtime state rollover: build and fully WARM
+        an executor for ``new_state`` without publishing anything. The
+        service keeps quoting the current version throughout; a failure
+        here leaves it untouched. Returns the opaque prepared pair for
+        :meth:`commit_state`. (The fleet calls this on every replica
+        first, and flips none of them unless all prepared — so a poisoned
+        candidate can never split the fleet across versions.)"""
+        with self.timer.stage("serving_prepare_state"):
+            new_exec = self._build_executor(new_state)
+            new_exec.warmup()
+        return (new_state, new_exec)
+
+    def commit_state(self, prepared) -> None:
+        """Phase 2: atomically flip to a :meth:`prepare_state` result.
+        Cheap (one attribute swap under the lock) — the compile cost was
+        paid in phase 1, so the fleet's commit loop closes the version
+        window in microseconds per replica."""
+        new_state, new_exec = prepared
+        self._publish(new_state, new_exec)
+
+    def swap_state(self, new_state) -> None:
+        """Single-replica convenience: prepare then commit — the PR-1
+        publish-behind-warmed-executor discipline for an externally built
+        state version (monthly batch refit, registry artifact)."""
+        self.commit_state(self.prepare_state(new_state))
+
+    def kill(self, reason: str = "replica killed") -> int:
+        """Abrupt replica death (failover/chaos path): every queued
+        request FAILS with :class:`ReplicaDeadError` — the fleet requeues
+        on that signal — and the service stops accepting work. No drain,
+        no flush; contrast :meth:`close`. Returns the number of queued
+        requests failed."""
+        from fm_returnprediction_tpu.resilience.errors import ReplicaDeadError
+
+        stranded = self.batcher.abort(ReplicaDeadError(reason))
+        server = getattr(self, "_metrics_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            self._metrics_server = None
+        return stranded
 
     @property
     def degraded(self) -> bool:
@@ -324,12 +383,12 @@ class ERService:
                 reg.gauge(
                     "fmrp_slo_state",
                     help="SLO state by objective: 0 ok, 1 warn, 2 breach",
-                    slo=name,
+                    slo=name, **self._metric_labels,
                 ).set(obj["state_code"])
                 reg.gauge(
                     "fmrp_slo_burn_rate",
                     help="windowed bad fraction over the SLO budget",
-                    slo=name,
+                    slo=name, **self._metric_labels,
                 ).set(obj["burn_rate"])
         else:
             out["slo_state"] = None
@@ -369,8 +428,9 @@ class ERService:
         """Serve :meth:`prometheus_metrics` over HTTP (``GET /metrics``) on
         a daemon thread; returns the bound ``(host, port)``. ``port=0``
         picks a free port. The server dies with :meth:`close`."""
-        import http.server
-        import threading
+        from fm_returnprediction_tpu.telemetry.export import (
+            serve_metrics_http,
+        )
 
         if getattr(self, "_metrics_server", None) is not None:
             raise RuntimeError(
@@ -378,32 +438,10 @@ class ERService:
                 "first (a second bind would orphan the first server's "
                 "daemon thread and socket)"
             )
-        service = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - stdlib naming
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
-                body = service.prometheus_metrics().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # quiet
-                pass
-
-        self._metrics_server = http.server.ThreadingHTTPServer(
-            (host, port), Handler
+        self._metrics_server = serve_metrics_http(
+            self.prometheus_metrics, port=port, host=host,
+            name="fmrp-serving-metrics",
         )
-        threading.Thread(
-            target=self._metrics_server.serve_forever,
-            name="fmrp-serving-metrics", daemon=True,
-        ).start()
         return self._metrics_server.server_address
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,0 +1,203 @@
+"""Replica supervision: health probes, breach detection, drain + replace.
+
+The supervisor is the fleet's control loop. Each :meth:`Supervisor.tick`
+probes every replica's OWN instrumentation — the dispatch-timeout rate
+and quarantine count the resilience layer (PR 2) already maintains, and
+the SLO breach state the telemetry plane (PR 5/6) already computes — and
+walks breaching replicas through a small, explicit state machine::
+
+    HEALTHY ──breach×grace──▶ DRAINING ──queue empty──▶ (close) ─┐
+       ▲                         │ drain budget spent            │
+       │                         ▼                               │
+       │                       DEAD  ◀── probe raised / killed   │
+       │                         │                               │
+       └────── REPLACEMENT ◀─────┴───────────────────────────────┘
+
+- HEALTHY replicas receive traffic (the router's inclusion rule).
+- DRAINING replicas are excluded from routing but keep answering what
+  they already queued; a replica that cannot drain inside
+  ``drain_timeout_ticks`` is force-killed (its queue fails over — the
+  fleet requeues, nothing is stranded).
+- DEAD replicas (probe raised, flusher thread gone, chaos kill) are
+  replaced immediately: the fleet spawns a fresh replica from the current
+  state version via the registry warm pool (``warm_from_registry``), so
+  a failover never pays a query-time compile.
+
+Determinism: ``tick()`` is synchronous and side-effect-complete — tests
+drive the machine tick by tick with no clock dependence. ``start()``
+arms the same loop on a daemon thread for production use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["HealthPolicy", "Supervisor",
+           "HEALTHY", "DRAINING", "DEAD", "STARTING"]
+
+# replica lifecycle states (plain strings: they appear in stats()/journal)
+STARTING = "starting"
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    """When is a replica unfit to serve?
+
+    max_dispatch_timeout_rate : watchdog-failed dispatches per dispatched
+        batch since the last probe; above this the runner is stalling
+        (the PR-2 ``dispatch_timeout_s`` watchdog feeds the numerator).
+    max_quarantined_months    : outstanding quarantined ingest months a
+        replica may carry before it is considered poisoned.
+    fail_on_slo_breach        : an armed SLO monitor reporting ``breach``
+        (state code 2, PR-6 burn rates) counts as a health breach.
+    consecutive_breaches      : probes in a row that must breach before
+        the supervisor drains (flap damping; 1 = act immediately).
+    drain_timeout_ticks       : ticks a DRAINING replica may hold unserved
+        work before it is force-killed and failed over.
+    """
+
+    max_dispatch_timeout_rate: float = 0.05
+    max_quarantined_months: int = 2
+    fail_on_slo_breach: bool = True
+    consecutive_breaches: int = 1
+    drain_timeout_ticks: int = 5
+
+
+class _ProbeState:
+    """Per-replica bookkeeping between ticks (supervisor-private)."""
+
+    __slots__ = ("last_timeouts", "last_batches", "breaches", "drain_ticks")
+
+    def __init__(self) -> None:
+        self.last_timeouts = 0
+        self.last_batches = 0
+        self.breaches = 0
+        self.drain_ticks = 0
+
+
+class Supervisor:
+    """Drives the replica state machine over a :class:`ServingFleet`.
+
+    The fleet owns the replicas and the mutations (decommission, kill,
+    replace); the supervisor owns the POLICY — what the probe evidence
+    means and when to act. ``tick()`` returns the list of actions taken
+    as human-readable strings (also journaled by the fleet), so tests and
+    the bench can assert exactly what supervision did.
+    """
+
+    def __init__(self, fleet, policy: Optional[HealthPolicy] = None):
+        self.fleet = fleet
+        self.policy = policy or HealthPolicy()
+        self._probe: Dict[str, _ProbeState] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.ticks = 0
+
+    # -- probes ------------------------------------------------------------
+
+    def probe(self, rid: str) -> List[str]:
+        """One replica's health verdict: a list of breach reasons (empty =
+        fit). A probe that cannot even read ``stats()`` — or finds the
+        flusher thread dead — reports the hardest breach, ``heartbeat``."""
+        rep = self.fleet.replica(rid)
+        if rep is None:
+            return ["gone"]
+        try:
+            stats = rep.service.stats()
+        except Exception as exc:  # noqa: BLE001 — a dead probe IS the signal
+            return [f"heartbeat:stats-raised:{type(exc).__name__}"]
+        thread = rep.service.batcher._thread
+        if thread is not None and not thread.is_alive():
+            return ["heartbeat:flusher-dead"]
+        ps = self._probe.setdefault(rid, _ProbeState())
+        breaches: List[str] = []
+        timeouts = int(stats.get("dispatch_timeouts") or 0)
+        batches = int(stats.get("n_batches") or 0)
+        d_timeouts = timeouts - ps.last_timeouts
+        d_batches = batches - ps.last_batches
+        ps.last_timeouts, ps.last_batches = timeouts, batches
+        if d_timeouts > 0:
+            rate = d_timeouts / max(1, d_batches)
+            if rate > self.policy.max_dispatch_timeout_rate:
+                breaches.append(f"dispatch_timeout_rate:{rate:.3f}")
+        quarantined = len(stats.get("quarantined_months") or ())
+        if quarantined > self.policy.max_quarantined_months:
+            breaches.append(f"quarantined_months:{quarantined}")
+        if self.policy.fail_on_slo_breach and stats.get("slo_state") == "breach":
+            breaches.append("slo_breach")
+        return breaches
+
+    # -- the control loop --------------------------------------------------
+
+    def tick(self) -> List[str]:
+        """One supervision pass over the whole fleet; returns the actions
+        taken. Deterministic: no clocks, no randomness — state advances
+        only by what the probes saw since the previous tick."""
+        self.ticks += 1
+        actions: List[str] = []
+        for rid, state in self.fleet.replica_states().items():
+            if state == DEAD:
+                new_rid = self.fleet.replace(rid, reason="dead")
+                self._probe.pop(rid, None)
+                actions.append(f"failover:{rid}->{new_rid}")
+            elif state == DRAINING:
+                ps = self._probe.setdefault(rid, _ProbeState())
+                if self.fleet.replica_idle(rid):
+                    new_rid = self.fleet.replace(rid, reason="drained")
+                    self._probe.pop(rid, None)
+                    actions.append(f"replace:{rid}->{new_rid}")
+                elif ps.drain_ticks >= self.policy.drain_timeout_ticks:
+                    self.fleet.kill_replica(
+                        rid, reason="drain budget exhausted"
+                    )
+                    actions.append(f"force-kill:{rid}")
+                else:
+                    ps.drain_ticks += 1
+            elif state == HEALTHY:
+                breaches = self.probe(rid)
+                ps = self._probe.setdefault(rid, _ProbeState())
+                if any(b.startswith("heartbeat") or b == "gone"
+                       for b in breaches):
+                    # no heartbeat = nothing left to drain politely
+                    self.fleet.kill_replica(rid, reason=";".join(breaches))
+                    actions.append(f"kill:{rid}:{breaches[0]}")
+                elif breaches:
+                    ps.breaches += 1
+                    if ps.breaches >= self.policy.consecutive_breaches:
+                        self.fleet.decommission(rid, reasons=breaches)
+                        ps.drain_ticks = 0
+                        actions.append(f"drain:{rid}:{';'.join(breaches)}")
+                else:
+                    ps.breaches = 0
+        return actions
+
+    # -- background mode ---------------------------------------------------
+
+    def start(self, interval_s: float) -> None:
+        """Run ``tick()`` every ``interval_s`` on a daemon thread."""
+        if self._thread is not None:
+            raise RuntimeError("supervisor already started")
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — supervision must survive
+                    pass  # a failed tick; the next one re-probes from scratch
+
+        self._thread = threading.Thread(
+            target=loop, name="fmrp-fleet-supervisor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -58,6 +58,15 @@ def main(argv=None) -> int:
                              "and fitted artifacts fetch instead of "
                              "compile/rebuild); default follows "
                              "FMRP_REGISTRY_DIR")
+    parser.add_argument("--fleet-size", type=int, default=None, metavar="N",
+                        help="after the DAG completes, stand up an "
+                             "N-replica serving fleet on the produced "
+                             "serving_state.npz and run the admission-"
+                             "controlled query smoke (default follows "
+                             "FMRP_FLEET_SIZE when that is set; "
+                             "FMRP_FLEET_RATE/_BURST/_SHED_OCCUPANCY "
+                             "shape admission, FMRP_FLEET_JOURNAL arms "
+                             "the request journal)")
     args = parser.parse_args(argv)
 
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
@@ -104,6 +113,34 @@ def main(argv=None) -> int:
                     print(f"FAILED {entry['task']}: {entry['error']}",
                           file=sys.stderr)
         write_timing_log(runner, Path(config("OUTPUT_DIR")) / "task_timings.json")
+        import os as _os
+
+        fleet_size = args.fleet_size
+        if fleet_size is None and _os.environ.get("FMRP_FLEET_SIZE"):
+            fleet_size = int(_os.environ["FMRP_FLEET_SIZE"])
+        if ok and fleet_size:
+            # guarded: a smoke failure must not fail an already-green DAG
+            try:
+                import json as _json
+
+                from fm_returnprediction_tpu.serving.fleet import fleet_smoke
+
+                state_path = (
+                    Path(config("PROCESSED_DATA_DIR")) / "serving_state.npz"
+                )
+                if state_path.exists():
+                    smoke = fleet_smoke(
+                        state_path, fleet_size,
+                        registry_dir=args.registry_dir,
+                    )
+                    print("serving fleet smoke: "
+                          + _json.dumps(smoke, sort_keys=True))
+                else:
+                    print(f"fleet smoke skipped: {state_path} not built "
+                          "(run the serve_state task)", file=sys.stderr)
+            except Exception as exc:  # noqa: BLE001 — disclosed, not fatal
+                print(f"fleet smoke failed (DAG result unaffected): "
+                      f"{exc!r}", file=sys.stderr)
         return 0 if ok else 1
 
 

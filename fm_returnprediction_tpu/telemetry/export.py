@@ -45,6 +45,7 @@ __all__ = [
     "write_chrome_trace",
     "export_all",
     "prometheus_text",
+    "serve_metrics_http",
     "JSONL_NAME",
     "CHROME_TRACE_NAME",
 ]
@@ -344,6 +345,40 @@ def export_all(trace_dir) -> tuple:
     jsonl = write_jsonl(trace_dir / JSONL_NAME)
     chrome = write_chrome_trace(trace_dir / CHROME_TRACE_NAME)
     return jsonl, chrome
+
+
+def serve_metrics_http(render, port: int = 0, host: str = "127.0.0.1",
+                       name: str = "fmrp-metrics"):
+    """Serve ``render()`` (Prometheus text) over HTTP ``GET /metrics`` on
+    a daemon thread — the ONE scrape-endpoint implementation behind
+    ``ERService.start_metrics_server`` and the fleet's twin (two copies
+    of an HTTP handler drift; content-type/path/shutdown fixes must land
+    once). Returns the ``ThreadingHTTPServer``: ``.server_address`` is
+    the bound ``(host, port)`` (``port=0`` picked a free one);
+    ``.shutdown()`` + ``.server_close()`` stop it."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib naming
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(
+        target=server.serve_forever, name=name, daemon=True
+    ).start()
+    return server
 
 
 def prometheus_text(extra: Optional[dict] = None,

@@ -1,0 +1,673 @@
+"""Serving-fleet semantics: replication, admission control, failover,
+rollover, and the exactly-once journal proof.
+
+The fleet's contract is layered on the single-service contracts the
+serving tests already pin, so these tests assert the NEW semantics only:
+
+- a fleet of 1 is differentially bit-identical to a bare ``ERService``
+  under a deterministic submission pattern (same batches → same bits);
+- a state swap under concurrent load and a mid-flight replica kill both
+  leave the journal replay CLEAN — zero dropped, zero duplicated — with
+  the kill path showing the requeues that made it survivable;
+- admission control sheds with a typed, retriable 429
+  (``ServiceOverloadError`` with retry-after evidence) and the
+  shed → wait → retry → success path works;
+- chaos-driven failover restores quoting with ZERO process-local
+  compiles via the registry warm pool (``WarmReport`` evidence, PR 9);
+- the supervisor state machine walks breach → drain → replace.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.resilience.errors import (
+    ServiceOverloadError,
+    StateRolloverError,
+)
+from fm_returnprediction_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    fleet_kill_routed,
+    fleet_stall_replica,
+    fleet_trigger_staged_rollover,
+    poison_serving_state_nan,
+)
+from fm_returnprediction_tpu.serving import (
+    AdmissionPolicy,
+    ERService,
+    HashRing,
+    MicroBatcher,
+    QueueFullError,
+    RequestJournal,
+    ServingFleet,
+    TokenBucket,
+    build_serving_state,
+    ingest_month,
+    replay_journal,
+)
+from fm_returnprediction_tpu.serving.supervisor import (
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    HealthPolicy,
+)
+
+pytestmark = pytest.mark.fleet
+
+T, N, P = 48, 40, 3
+WINDOW, MIN_PERIODS = 16, 8
+
+
+def _make_panel(seed=2015):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((T, N, P)).astype(np.float32)
+    beta = np.array([0.05, -0.02, 0.01], dtype=np.float32)
+    y = (x @ beta + 0.02 * rng.standard_normal((T, N))).astype(np.float32)
+    mask = rng.random((T, N)) > 0.1
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    x = np.where(mask[..., None], x, np.nan).astype(np.float32)
+    return y, x, mask
+
+
+@pytest.fixture(scope="module")
+def case():
+    y, x, mask = _make_panel()
+    state = build_serving_state(
+        y, x, mask, window=WINDOW, min_periods=MIN_PERIODS
+    )
+    rng = np.random.default_rng(7)
+    n_q = 120
+    months = rng.integers(T // 2, T, n_q)
+    firms = rng.integers(0, N, n_q)
+    qx = x[months, firms]
+    return y, x, mask, state, months, firms, qx
+
+
+def _oracle(state, months, qx):
+    """Reference answers from a bare, manually-pumped service."""
+    with ERService(state, max_batch=8, auto_flush=False) as ref:
+        futs = [ref.submit(int(m), q) for m, q in zip(months, qx)]
+        ref.batcher.drain()
+        return np.asarray([f.result(timeout=5) for f in futs])
+
+
+# -- fleet-of-1 differential -------------------------------------------------
+
+
+def test_fleet_of_one_bit_identical_to_bare_service(case):
+    """Same deterministic submission pattern → same batches → the fleet
+    adds routing/journal bookkeeping but must not move one bit of the
+    answer."""
+    _, _, _, state, months, firms, qx = case
+    want = _oracle(state, months, qx)
+    with ServingFleet(state, 1, max_batch=8, auto_flush=False) as fleet:
+        futs = [fleet.submit(int(m), q) for m, q in zip(months, qx)]
+        fleet.flush_all()
+        got = np.asarray([f.result(timeout=5) for f in futs])
+    assert np.array_equal(got, want, equal_nan=True)
+
+
+# -- exactly-once across a state swap under load -----------------------------
+
+
+def test_swap_under_load_journal_proves_exactly_once(case, tmp_path):
+    """Concurrent query threads; the ``fleet.swap_mid_flight`` chaos site
+    fires a STAGED two-phase rollover between two specific admits. Every
+    request resolves, the journal replay is clean, and the answers match
+    the oracle (old months are identical across versions)."""
+    y, x, mask, state, months, firms, qx = case
+    want = _oracle(state, months, qx)
+    new_state = ingest_month(
+        state, y[-1], x[-1], mask[-1], np.datetime64("2031-01-31", "ns")
+    )
+    journal = tmp_path / "swap.jsonl"
+    results = np.empty(len(months))
+    with ServingFleet(state, 2, max_batch=8, max_latency_ms=1.0,
+                      journal=journal) as fleet:
+        fleet.stage_rollover(new_state)
+        with FaultPlan({
+            "fleet.swap_mid_flight": FaultSpec(
+                skip=len(months) // 2, times=1,
+                mutate=fleet_trigger_staged_rollover,
+            ),
+        }) as plan:
+            def worker(lo, hi):
+                for k in range(lo, hi):
+                    results[k] = fleet.query(int(months[k]), qx[k])
+
+            threads = [
+                threading.Thread(target=worker, args=(k * 30, (k + 1) * 30))
+                for k in range(4)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        assert plan.fired["fleet.swap_mid_flight"] == 1
+        assert fleet.drain(timeout=10)
+        assert fleet.version == 1
+        for rep in fleet.stats()["replicas"].values():
+            assert rep["state"] == HEALTHY
+    # bucket composition differs under threading → ULP-level f32 wiggle,
+    # same tolerance the serving stream differential uses
+    np.testing.assert_allclose(
+        results, want, rtol=1e-6, atol=1e-9, equal_nan=True
+    )
+    replay = replay_journal(journal)
+    assert replay.clean, (replay.dropped, replay.duplicated, replay.invalid)
+    assert replay.n_admitted == len(months) == replay.n_done
+    marks = [m["label"] for m in replay.marks]
+    assert "rollover_begin" in marks and "rollover_commit" in marks
+
+
+# -- exactly-once across a mid-flight replica kill ---------------------------
+
+
+def test_kill_under_load_journal_proves_exactly_once(case, tmp_path):
+    """Deterministic mid-flight kill: requests queue unflushed, the
+    ``fleet.replica_kill`` site kills the replica the 21st routed request
+    is IN FLIGHT on — every request it stranded requeues onto the
+    survivor and completes. Zero dropped, zero duplicated, requeues > 0."""
+    _, _, _, state, months, firms, qx = case
+    want = _oracle(state, months, qx)
+    journal = tmp_path / "kill.jsonl"
+    with ServingFleet(state, 2, max_batch=8, auto_flush=False,
+                      journal=journal) as fleet:
+        with FaultPlan({
+            "fleet.replica_kill": FaultSpec(
+                skip=20, times=1, mutate=fleet_kill_routed(),
+            ),
+        }) as plan:
+            futs = [fleet.submit(int(m), q) for m, q in zip(months, qx)]
+        assert plan.fired["fleet.replica_kill"] == 1
+        # pump until every future resolves (requeued work lands in the
+        # survivor's queue after its first drain)
+        for _ in range(4):
+            fleet.flush_all()
+        got = np.asarray([f.result(timeout=5) for f in futs])
+        stats = fleet.stats()
+        assert stats["requeues_total"] > 0
+        assert len(stats["dead_replicas"]) == 1
+        # the dead replica's lifetime counters FOLD into the aggregate —
+        # agg_n_done is monotone across kills (a scraper's rate() over
+        # the exported gauge must never go negative)
+        assert stats["agg_n_done"] == len(months)
+        # supervision replaces the corpse and quoting is fully restored
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith("failover:") for a in actions)
+        assert fleet.stats()["healthy_replicas"] == 2
+        post_fut = fleet.submit(int(months[0]), qx[0])
+        fleet.flush_all()
+        post = post_fut.result(timeout=5)
+    np.testing.assert_allclose(
+        got, want, rtol=1e-6, atol=1e-9, equal_nan=True
+    )
+    np.testing.assert_allclose(post, want[0], rtol=1e-6, atol=1e-9)
+    replay = replay_journal(journal)
+    assert replay.clean, (replay.dropped, replay.duplicated, replay.invalid)
+    assert replay.n_requeues > 0
+    assert replay.n_admitted == replay.n_done  # nothing lost, nothing twice
+
+
+# -- admission control -------------------------------------------------------
+
+
+def test_admission_shed_retry_success(case, tmp_path):
+    """Token-bucket shed → typed retriable 429 with a retry-after hint →
+    advancing the (injected) clock by exactly that hint admits the
+    retry. The journal shows the shed as a terminal, not a drop."""
+    _, _, _, state, months, _, qx = case
+    clk = [0.0]
+    journal = tmp_path / "shed.jsonl"
+    with ServingFleet(
+        state, 1, max_batch=8, auto_flush=False, journal=journal,
+        admission=AdmissionPolicy(rate_per_s=10.0, burst=2.0),
+        admission_clock=lambda: clk[0],
+    ) as fleet:
+        f1 = fleet.submit(int(months[0]), qx[0])
+        f2 = fleet.submit(int(months[1]), qx[1])
+        with pytest.raises(ServiceOverloadError) as err:
+            fleet.submit(int(months[2]), qx[2])
+        assert err.value.reason == "token_bucket"
+        assert err.value.retry_after_s > 0
+        # the hint is honest: advancing the clock by it admits the retry
+        clk[0] += err.value.retry_after_s
+        f3 = fleet.submit(int(months[2]), qx[2])
+        fleet.flush_all()
+        for f in (f1, f2, f3):
+            f.result(timeout=5)
+        assert fleet.stats()["shed_total"] == 1
+    replay = replay_journal(journal)
+    assert replay.clean
+    assert replay.n_shed == 1 and replay.n_done == 3
+
+
+def test_admission_occupancy_shed_carries_queue_evidence(case):
+    """Queue-occupancy shedding fires BEFORE any replica queue is hit and
+    its error carries the depth/ceiling evidence (the same fields
+    ``QueueFullError`` now exposes, one layer earlier)."""
+    _, _, _, state, months, _, qx = case
+    with ServingFleet(
+        state, 2, max_batch=8, max_queue=4, auto_flush=False,
+        admission=AdmissionPolicy(max_occupancy=0.75),
+    ) as fleet:
+        futs = [fleet.submit(int(months[k]), qx[k]) for k in range(6)]
+        with pytest.raises(ServiceOverloadError) as err:
+            fleet.submit(int(months[6]), qx[6])
+        assert err.value.reason == "queue_occupancy"
+        assert err.value.queue_depth == 6
+        assert err.value.queue_ceiling == 8
+        assert err.value.occupancy == pytest.approx(0.75)
+        assert err.value.retry_after_s > 0
+        fleet.flush_all()
+        retry = fleet.submit(int(months[6]), qx[6])
+        fleet.flush_all()
+        for f in [*futs, retry]:
+            assert isinstance(f.result(timeout=5), float)
+
+
+def test_queue_full_error_carries_occupancy_and_ceiling():
+    """Satellite: ``MicroBatcher.submit`` backpressure now discloses the
+    queue evidence in the exception itself."""
+    mb = MicroBatcher(lambda m, x, v: np.zeros(len(m)), max_queue=2,
+                      auto_flush=False)
+    mb.submit(0, np.zeros(3))
+    mb.submit(0, np.zeros(3))
+    with pytest.raises(QueueFullError) as err:
+        mb.submit(0, np.zeros(3))
+    assert err.value.queue_depth == 2
+    assert err.value.max_queue == 2
+    assert err.value.occupancy == 1.0
+    assert "2 pending of 2" in str(err.value)
+    mb.close()
+
+
+def test_token_bucket_deterministic_refill():
+    clk = [0.0]
+    tb = TokenBucket(rate_per_s=4.0, burst=2.0, clock=lambda: clk[0])
+    assert tb.try_acquire() is None
+    assert tb.try_acquire() is None
+    wait = tb.try_acquire()
+    assert wait == pytest.approx(0.25)
+    clk[0] += 0.25
+    assert tb.try_acquire() is None
+    clk[0] += 10.0  # refill caps at burst
+    assert tb.try_acquire() is None
+    assert tb.try_acquire() is None
+    assert tb.try_acquire() is not None
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_hash_ring_consistency_and_exclusion():
+    ring = HashRing(vnodes=32)
+    for rid in ("r0", "r1", "r2"):
+        ring.add(rid)
+    keys = [f"k{i}" for i in range(200)]
+    before = {k: ring.route(k) for k in keys}
+    # deterministic: a rebuilt ring with the same members agrees exactly
+    ring2 = HashRing(vnodes=32)
+    for rid in ("r2", "r0", "r1"):  # insertion order must not matter
+        ring2.add(rid)
+    assert {k: ring2.route(k) for k in keys} == before
+    # removing one member only remaps ITS keys (consistent hashing)
+    ring.remove("r2")
+    for k in keys:
+        if before[k] != "r2":
+            assert ring.route(k) == before[k]
+        else:
+            assert ring.route(k) in ("r0", "r1")
+    # exclusion == removal for routing purposes, without membership churn
+    assert all(
+        ring2.route(k, exclude={"r2"}) == ring.route(k) for k in keys
+    )
+    assert ring.route("k0", exclude={"r0", "r1"}) is None
+
+
+# -- rollover protocol -------------------------------------------------------
+
+
+def test_rollover_poison_state_aborts_with_zero_flips(case, tmp_path):
+    """The ``fleet.poison_state`` site corrupts the SECOND replica's
+    rollover candidate: the two-phase protocol must abort with zero
+    commits — including the first replica, whose prepare already
+    succeeded — so the fleet can never split across versions."""
+    y, x, mask, state, months, firms, qx = case
+    want = _oracle(state, months, qx)
+    new_state = ingest_month(
+        state, y[-1], x[-1], mask[-1], np.datetime64("2031-01-31", "ns")
+    )
+    journal = tmp_path / "poison.jsonl"
+    with ServingFleet(state, 2, max_batch=8, auto_flush=False,
+                      journal=journal) as fleet:
+        with FaultPlan({
+            "fleet.poison_state": FaultSpec(
+                skip=1, times=1, mutate=poison_serving_state_nan,
+            ),
+        }) as plan:
+            with pytest.raises(StateRolloverError) as err:
+                fleet.rollover(new_state)
+        assert plan.fired["fleet.poison_state"] == 1
+        assert "no replica flipped" in str(err.value)
+        assert fleet.version == 0
+        # every replica still serves the OLD version, bit-identically
+        for rep in fleet.stats()["replicas"].values():
+            assert rep["state"] == HEALTHY
+        futs = [fleet.submit(int(m), q) for m, q in zip(months, qx)]
+        fleet.flush_all()
+        got = np.asarray([f.result(timeout=5) for f in futs])
+        assert np.array_equal(got, want, equal_nan=True)
+        # a later clean rollover still lands
+        assert fleet.rollover(new_state) == 1
+    marks = [m["label"] for m in replay_journal(journal).marks]
+    assert "rollover_abort" in marks
+    assert marks.count("rollover_commit") == 1
+
+
+def test_rollover_rejects_non_append_candidate(case):
+    _, _, _, state, *_ = case
+    import dataclasses
+
+    with ServingFleet(state, 1, max_batch=8, auto_flush=False) as fleet:
+        shrunk = dataclasses.replace(
+            state,
+            months=state.months[:-1], coef=state.coef[:-1],
+            month_valid=state.month_valid[:-1],
+            slopes_bar=state.slopes_bar[:-1],
+            intercept_bar=state.intercept_bar[:-1],
+            x_lo=state.x_lo[:-1], x_hi=state.x_hi[:-1],
+            gram=state.gram[:-1], moment=state.moment[:-1],
+            n_obs=state.n_obs[:-1], ysum=state.ysum[:-1], yy=state.yy[:-1],
+        )
+        with pytest.raises(StateRolloverError, match="backwards"):
+            fleet.rollover(shrunk)
+        assert fleet.version == 0
+
+
+# -- supervision -------------------------------------------------------------
+
+
+def test_supervisor_drains_and_replaces_poisoned_replica(case):
+    """Quarantine breach walks the machine: HEALTHY → (probe breach) →
+    DRAINING (router excludes it) → idle → replaced, failover counted."""
+    _, _, _, state, months, _, qx = case
+    with ServingFleet(
+        state, 2, max_batch=8, auto_flush=False,
+        health=HealthPolicy(max_quarantined_months=0,
+                            consecutive_breaches=1),
+    ) as fleet:
+        victim = sorted(fleet.replica_states())[0]
+        rep = fleet.replica(victim)
+        bad = np.full((N, P), np.nan, dtype=np.float32)
+        assert not rep.service.ingest_month(
+            np.full(N, np.nan), bad, np.ones(N, bool),
+            np.datetime64("2070-01-31", "ns"),
+        )
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith(f"drain:{victim}") for a in actions)
+        assert fleet.replica_states()[victim] == DRAINING
+        # draining replicas take no new traffic
+        futs = [fleet.submit(int(months[k]), qx[k]) for k in range(10)]
+        assert fleet.replica(victim).service.batcher.queue_depth == 0
+        fleet.flush_all()
+        for f in futs:
+            f.result(timeout=5)
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith(f"replace:{victim}") for a in actions)
+        assert victim not in fleet.replica_states()
+        stats = fleet.stats()
+        assert stats["healthy_replicas"] == 2
+        assert stats["failovers_total"] == 1
+        assert victim in stats["replaced"]
+
+
+def test_supervisor_stall_breach_via_dispatch_timeout(case):
+    """A stalled replica (``fleet.replica_stall``) trips the PR-2
+    dispatch watchdog; its requests requeue to the survivor and the
+    supervisor's timeout-rate probe drains the staller."""
+    _, _, _, state, months, _, qx = case
+    with ServingFleet(
+        state, 2, max_batch=8, auto_flush=False, dispatch_timeout_s=0.15,
+        health=HealthPolicy(max_dispatch_timeout_rate=0.0,
+                            consecutive_breaches=1),
+    ) as fleet:
+        victim = sorted(fleet.replica_states())[0]
+        with FaultPlan({
+            "fleet.replica_stall": FaultSpec(
+                times=-1, mutate=fleet_stall_replica(victim, 0.5),
+            ),
+        }):
+            futs = [fleet.submit(int(months[k]), qx[k]) for k in range(12)]
+            for _ in range(3):
+                fleet.flush_all()
+        got = [f.result(timeout=5) for f in futs]
+        assert len(got) == 12
+        assert fleet.stats()["requeues_total"] > 0
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith(f"drain:{victim}") for a in actions)
+
+
+def test_supervisor_heartbeat_kill_on_dead_flusher(case):
+    """A replica whose flusher thread died fails the heartbeat probe and
+    is killed + failed over (no polite drain for a corpse)."""
+    _, _, _, state, *_ = case
+    with ServingFleet(state, 2, max_batch=8) as fleet:  # auto_flush on
+        victim = sorted(fleet.replica_states())[0]
+        rep = fleet.replica(victim)
+        # simulate a crashed flusher: close the thread without the fleet
+        rep.service.batcher.close()
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith(f"kill:{victim}") for a in actions)
+        assert fleet.replica_states()[victim] == DEAD
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith(f"failover:{victim}") for a in actions)
+        assert fleet.stats()["healthy_replicas"] == 2
+
+
+# -- warm-pool failover (the acceptance criterion) ---------------------------
+
+
+def test_chaos_failover_restores_quoting_with_zero_compiles(case, tmp_path):
+    """With a populated registry, EVERY replica start — including the
+    chaos-driven failover replacement — is compile-free: the WarmReport
+    shows all bucket programs deserialized, zero fresh compiles, zero
+    serving-bucket traces (PR-9 evidence)."""
+    from fm_returnprediction_tpu.registry.store import using_registry
+
+    _, _, _, state, months, _, qx = case
+    reg_dir = tmp_path / "registry"
+    # one populating warm-up stores every bucket executable
+    with using_registry(reg_dir):
+        ERService(state, max_batch=8, auto_flush=False).close()
+    with ServingFleet(state, 2, max_batch=8, auto_flush=False,
+                      registry_dir=reg_dir) as fleet:
+        for rid, report in fleet.warm_reports.items():
+            assert report.zero_compile, (rid, report)
+        victim = sorted(fleet.replica_states())[0]
+        with FaultPlan({
+            "fleet.replica_kill": FaultSpec(
+                times=1, mutate=fleet_kill_routed(victim),
+            ),
+        }):
+            futs = [fleet.submit(int(months[k]), qx[k]) for k in range(20)]
+        for _ in range(3):
+            fleet.flush_all()
+        for f in futs:
+            f.result(timeout=5)
+        actions = fleet.supervisor.tick()
+        assert any(a.startswith("failover:") for a in actions)
+        (replacement,) = [
+            rid for rid in fleet.replica_states() if rid != victim
+            and rid not in ("r0", "r1")
+        ]
+        report = fleet.warm_reports[replacement]
+        assert report.zero_compile, report
+        assert report.fresh_compiles == 0
+        assert report.deserialized == len(
+            fleet.replica(replacement).service.executor.buckets()
+        )
+        # quoting restored through the replacement
+        want = _oracle(state, months[:20], qx[:20])
+        futs = [fleet.submit(int(months[k]), qx[k]) for k in range(20)]
+        fleet.flush_all()
+        got = np.asarray([f.result(timeout=5) for f in futs])
+        assert np.array_equal(got, want, equal_nan=True)
+
+
+# -- journal FSM -------------------------------------------------------------
+
+
+def test_journal_replay_flags_drops_duplicates_and_violations(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    lines = [
+        {"seq": 1, "ev": "admit", "req": 1},
+        {"seq": 2, "ev": "route", "req": 1, "replica": "r0"},
+        # req 1 never terminates → dropped
+        {"seq": 3, "ev": "admit", "req": 2},
+        {"seq": 4, "ev": "route", "req": 2, "replica": "r0"},
+        {"seq": 5, "ev": "done", "req": 2},
+        {"seq": 6, "ev": "done", "req": 2},       # duplicated terminal
+        {"seq": 7, "ev": "route", "req": 3},      # route without admit
+        {"seq": 8, "ev": "shed", "req": 4},       # clean front-door shed
+    ]
+    with open(path, "w") as fh:
+        for rec in lines:
+            fh.write(json.dumps(rec) + "\n")
+        fh.write('{"seq": 9, "ev": "admit", "req":')  # torn tail
+    replay = replay_journal(path)
+    assert replay.dropped == (1, 3)
+    assert replay.duplicated == (2,)
+    assert not replay.clean
+    assert any("route from state" in v for v in replay.invalid)
+    assert any("torn" in v for v in replay.invalid)
+    assert replay.n_shed == 1
+
+
+def test_raising_chaos_site_cannot_strand_accounting(case, tmp_path):
+    """A RAISING spec at ``fleet.swap_mid_flight`` (not the documented
+    mutate) escapes submit — but the admitted request must still reach a
+    terminal journal event and release ``drain()``; nothing strands."""
+    from fm_returnprediction_tpu.resilience.errors import InjectedFault
+
+    _, _, _, state, months, _, qx = case
+    journal = tmp_path / "raise.jsonl"
+    with ServingFleet(state, 1, max_batch=8, auto_flush=False,
+                      journal=journal) as fleet:
+        with FaultPlan({"fleet.swap_mid_flight": FaultSpec(times=1)}):
+            with pytest.raises(InjectedFault):
+                fleet.submit(int(months[0]), qx[0])
+        assert fleet.drain(timeout=1), "outstanding leaked"
+        ok = fleet.submit(int(months[1]), qx[1])
+        fleet.flush_all()
+        assert isinstance(ok.result(timeout=5), float)
+    replay = replay_journal(journal)
+    assert replay.clean, (replay.dropped, replay.invalid)
+    assert replay.n_error == 1 and replay.n_done == 1
+
+
+def test_journal_rotates_reused_path(tmp_path):
+    """Request ids restart with every fleet, so a reused journal path
+    (FMRP_FLEET_JOURNAL) must ROTATE the previous session's file instead
+    of appending — otherwise a healthy second run replays as a wall of
+    false duplicates. Each file replays standalone and clean."""
+    path = tmp_path / "j.jsonl"
+    with RequestJournal(path) as j1:
+        assert j1.rotated_to is None
+        j1.append("admit", 1)
+        j1.append("route", 1, replica="r0")
+        j1.append("done", 1)
+    with RequestJournal(path) as j2:
+        rotated = j2.rotated_to
+        assert rotated is not None and rotated.exists()
+        j2.append("admit", 1)          # same req id as session 1
+        j2.append("shed", 1)
+    for p in (path, rotated):
+        replay = replay_journal(p)
+        assert replay.clean, (p, replay.duplicated, replay.invalid)
+    assert replay_journal(path).n_shed == 1
+    assert replay_journal(rotated).n_done == 1
+
+
+def test_journal_clean_sequences(tmp_path):
+    path = tmp_path / "good.jsonl"
+    lines = [
+        {"seq": 1, "ev": "admit", "req": 1},
+        {"seq": 2, "ev": "route", "req": 1, "replica": "r0"},
+        {"seq": 3, "ev": "requeue", "req": 1, "replica": "r0"},
+        {"seq": 4, "ev": "route", "req": 1, "replica": "r1"},
+        {"seq": 5, "ev": "mark", "label": "rollover_begin"},
+        {"seq": 6, "ev": "done", "req": 1},
+        {"seq": 7, "ev": "shed", "req": 2},
+    ]
+    with open(path, "w") as fh:
+        for rec in lines:
+            fh.write(json.dumps(rec) + "\n")
+    replay = replay_journal(path)
+    assert replay.clean
+    assert replay.n_requeues == 1
+    assert [m["label"] for m in replay.marks] == ["rollover_begin"]
+
+
+# -- instrumentation / knobs -------------------------------------------------
+
+
+def test_prometheus_per_replica_labels_and_fleet_gauges(case):
+    _, _, _, state, months, _, qx = case
+    with ServingFleet(state, 2, max_batch=8, auto_flush=False) as fleet:
+        f = fleet.submit(int(months[0]), qx[0])
+        fleet.flush_all()
+        f.result(timeout=5)
+        text = fleet.prometheus_metrics()
+    for family in (
+        "fmrp_serving_requests_done_total",
+        "fmrp_serving_executable_cache_hits_total",
+    ):
+        assert f'{family}{{replica="r0"}}' in text
+        assert f'{family}{{replica="r1"}}' in text
+    for gauge in (
+        "fmrp_fleet_healthy_replicas 2",
+        "fmrp_fleet_size 2",
+        "fmrp_fleet_service_version 0",
+    ):
+        assert gauge in text
+    # exposition-format discipline (the PR-6 hardening): HELP before
+    # series, and every series line parses as name{labels} value
+    assert "# HELP fmrp_fleet_healthy_replicas" in text
+
+
+def test_fleet_env_knobs(case, monkeypatch):
+    _, _, _, state, *_ = case
+    monkeypatch.setenv("FMRP_FLEET_SIZE", "3")
+    monkeypatch.setenv("FMRP_FLEET_RATE", "50")
+    monkeypatch.setenv("FMRP_FLEET_BURST", "7")
+    monkeypatch.setenv("FMRP_FLEET_SHED_OCCUPANCY", "0.5")
+    policy = AdmissionPolicy.from_env()
+    assert policy.rate_per_s == 50.0
+    assert policy.burst == 7.0
+    assert policy.max_occupancy == 0.5
+    with ServingFleet(state, max_batch=8, auto_flush=False) as fleet:
+        assert fleet.stats()["fleet_size"] == 3
+        assert fleet._bucket is not None
+
+
+def test_single_service_swap_state_publishes_behind_warm_executor(case):
+    """The generalized PR-1 discipline on a bare service: ``swap_state``
+    flips to an externally built version with the executor already warm
+    (no misses after the swap) and old-month answers unchanged."""
+    y, x, mask, state, months, firms, qx = case
+    want = _oracle(state, months, qx)
+    new_state = ingest_month(
+        state, y[-1], x[-1], mask[-1], np.datetime64("2031-01-31", "ns")
+    )
+    with ERService(state, max_batch=8, auto_flush=False) as svc:
+        svc.swap_state(new_state)
+        assert svc.state is new_state
+        futs = [svc.submit(int(m), q) for m, q in zip(months, qx)]
+        svc.batcher.drain()
+        got = np.asarray([f.result(timeout=5) for f in futs])
+        assert svc.stats()["executable_cache_misses"] == 0
+    assert np.array_equal(got, want, equal_nan=True)
