@@ -23,7 +23,14 @@ over-claim without (round-1 VERDICT "What's weak" #1-2):
   CRSP scale on one chip" demonstration.
 - ``rolling_std_pallas_ms`` / ``rolling_std_xla_ms`` — the fused pallas
   kernel vs the XLA cumsum path on a (12608, 4096) strip, recording the
-  speedup claimed at ``ops/rolling.py`` (TPU only; null on CPU).
+  speedup claimed at ``ops/rolling.py`` (TPU only; route-disclosing
+  structured skip on CPU).
+- ``kernels_*``              — the raw-kernel ladder (ISSUE 11): the
+  MXU-tiled pallas Gram contraction vs the XLA oracle, the bf16
+  contraction route with its promotion disclosure, the fused rolling
+  sum/mean/std family, cold-ingest overlap (serial vs prefetched chunked
+  read), per-kernel roofline-utilization gauges from the cost ledger, and
+  a warm repeat under ``recompile_watch``.
 - ``specgrid_*``             — the spec-grid subsystem: the Table-2-shaped
   3×3 grid from Gram sufficient statistics (one fused program) vs the
   per-cell batched-QR route, with compiled-program/referee counts and the
@@ -51,6 +58,7 @@ full-scale daily stage).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -582,11 +590,17 @@ def _bench_pallas(fast: bool):
     if jax.devices()[0].platform != "tpu":
         # a structured skip reason, not a silent null: a null in the
         # artifact reads as "measured nothing for unknown reasons", and
-        # the regression sentinel can't tell it from a parse bug
-        skip = {
-            "skipped": "pallas rolling kernel is TPU-only; "
-                       f"device is {jax.devices()[0].platform}"
-        }
+        # the regression sentinel can't tell it from a parse bug. The skip
+        # also records WHICH route-knob resolution produced it — a TPU
+        # round that silently fell back to XLA (FMRP_ROLLING_ROUTE=xla /
+        # FMRP_PALLAS=0 left over in the environment) must be
+        # distinguishable from a genuine CPU skip
+        from fm_returnprediction_tpu.ops.rolling import resolve_rolling_route
+
+        skip = _kernels_skip(
+            jax.devices()[0].platform, resolve_rolling_route(),
+            "FMRP_ROLLING_ROUTE", "FMRP_PALLAS",
+        )
         return {"rolling_std_pallas_ms": skip, "rolling_std_xla_ms": skip}
 
     from fm_returnprediction_tpu.ops.rolling import rolling_std
@@ -625,6 +639,248 @@ def _bench_pallas(fast: bool):
             f"rolling_std_xla_ms{suffix}": round(xla_ms, 3),
             f"rolling_std_pallas_speedup{suffix}": round(xla_ms / pallas_ms, 2),
         })
+    return out
+
+
+def _kernels_skip(platform: str, resolved: str, *knob_envs: str) -> dict:
+    """Structured TPU-only skip carrying the route-knob resolution that
+    produced it — the ONE home for the disclosure contract (`_bench_pallas`
+    and the kernels ladder share it): a TPU round that silently fell back
+    to XLA via a leftover knob must be distinguishable from a genuine CPU
+    skip."""
+    route = {"resolved": resolved}
+    for env in knob_envs:
+        route[env] = os.environ.get(env)
+    route["platform"] = platform
+    return {
+        "skipped": f"pallas kernel is TPU-only; device is {platform}",
+        "route": route,
+    }
+
+
+def _bench_kernels(fast: bool):
+    """The raw-kernel ladder (ISSUE 11): pallas vs XLA for the Gram
+    contraction and the fused rolling family, the bf16 contraction route,
+    and the overlapped cold ingest.
+
+    - ``kernels_gram_*_ms`` / ``kernels_gram*_rows_per_s`` — the masked
+      per-month Gram contraction at a small and a near-real shape: the
+      XLA oracle, the pallas route (TPU; structured route-disclosing skip
+      on CPU), and the bf16 route with its conditioning-referee promotion
+      count (``kernels_gram_bf16_promoted_months``).
+    - ``kernels_rolling_{std,sum,mean}_*`` — the fused rolling family at
+      the production strip shape, both routes, ``*_melems_per_s``
+      throughputs.
+    - ``kernels_ingest_{serial,overlap}_s`` — the SAME chunked filtered
+      parquet read with the prefetch queue off vs on: the measured
+      cold-ingest overlap fact.
+    - roofline-utilization gauges from the cost ledger for every AOT-timed
+      kernel program (``*_roofline_utilization``), and one warm repeat of
+      the whole ladder under ``recompile_watch`` so a re-trace in any
+      kernel program is flagged (``kernels_warm_recompiles``).
+
+    All ``*_ms``/``*_s`` keys are lower-is-better and ``*_per_s``/
+    ``*speedup*`` higher-is-better under the regress sentinel's naming
+    rules. FMRP_BENCH_KERNELS=0 skips the section.
+    """
+    if os.environ.get("FMRP_BENCH_KERNELS", "1") == "0":
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.ops.rolling import (
+        resolve_rolling_route,
+        rolling_mean,
+        rolling_std,
+        rolling_sum,
+    )
+    from fm_returnprediction_tpu.specgrid.grams import (
+        contract_spec_grams,
+        resolve_gram_route,
+    )
+    from fm_returnprediction_tpu.telemetry import perf as _perf
+
+    platform = jax.devices()[0].platform
+    out = {}
+    reps = 2 if fast else 3
+    warm_runners = []  # (label, thunk) — re-run under the recompile watch
+
+    def _timed_ms(thunk, warm=True):
+        if warm:
+            thunk()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            thunk()
+        return (time.perf_counter() - t0) / reps * 1000
+
+    # -- Gram contraction ladder -------------------------------------------
+    rng = np.random.default_rng(7)
+    gram_shapes = ([("", 40, 512, 6, 4)] if fast
+                   else [("", 60, 1024, 6, 4), ("_real", 240, 8192, 14, 9)])
+    gram_route = resolve_gram_route()
+    out["kernels_gram_route"] = gram_route
+    # shape disclosures: the regress sentinel qualifies every series by its
+    # section's ``*_shape`` sibling, so a fast-mode round never gates a
+    # full-shape round (each family gets its own key; the gram value joins
+    # both ladder rungs — any rung resizing separates the whole family)
+    out["kernels_gram_shape"] = "+".join(
+        f"T{t}_N{n}_P{p}_S{s}" for _, t, n, p, s in gram_shapes
+    )
+    for sfx, t, n, p, s in gram_shapes:
+        x = rng.standard_normal((t, n, p)).astype(np.float32)
+        x[rng.random(x.shape) < 0.1] = np.nan
+        y = np.where(rng.random((t, n)) > 0.15,
+                     rng.standard_normal((t, n)), np.nan).astype(np.float32)
+        universes = rng.random((2, t, n)) > 0.3
+        args = tuple(jnp.asarray(a) for a in (
+            y, x, universes, np.arange(s) % 2,
+            rng.random((s, p)) > 0.3, np.ones((s, t), bool),
+        ))
+
+        def _runner(program, **static):
+            exe = _perf.timed_aot_compile(
+                contract_spec_grams, *args, program=program, **static
+            )
+            def run(exe=exe, args=args):
+                np.asarray(exe(*args).n)  # host pull = execution barrier
+            return run
+
+        variants = [(f"kernels_gram_xla{sfx}", dict(route="xla")),
+                    (f"kernels_gram_bf16{sfx}",
+                     dict(route=gram_route, precision="bf16"))]
+        if platform == "tpu":
+            variants.insert(1, (f"kernels_gram_pallas{sfx}",
+                                dict(route="pallas")))
+        else:
+            out[f"kernels_gram_pallas{sfx}_ms"] = _kernels_skip(
+                platform, gram_route, "FMRP_GRAM_ROUTE"
+            )
+        ms_of = {}
+        for program, static in variants:
+            run = _runner(program, **static)
+            ms = _timed_ms(run)
+            ms_of[program] = ms
+            out[f"{program}_ms"] = round(ms, 3)
+            roof = _perf.record_runtime(program, ms / 1000)
+            if roof:
+                out[f"{program}_roofline_utilization"] = round(
+                    roof["roofline_utilization"], 6
+                )
+            warm_runners.append((program, run))
+        if platform == "tpu":
+            out[f"kernels_gram_pallas{sfx}_speedup"] = round(
+                ms_of[f"kernels_gram_xla{sfx}"]
+                / ms_of[f"kernels_gram_pallas{sfx}"], 2)
+        out[f"kernels_gram_bf16{sfx}_speedup"] = round(
+            ms_of[f"kernels_gram_xla{sfx}"]
+            / ms_of[f"kernels_gram_bf16{sfx}"], 2)
+        # throughput of the route a production sweep would take here
+        prod = (f"kernels_gram_pallas{sfx}" if platform == "tpu"
+                else f"kernels_gram_xla{sfx}")
+        out[f"kernels_gram{sfx}_rows_per_s"] = round(
+            t * n * s / (ms_of[prod] / 1000), 1
+        )
+        if sfx == "":
+            # bf16 promotion disclosure on the small shape: how many
+            # (spec, month) systems the conditioning referee flags for
+            # promotion back to full precision
+            from fm_returnprediction_tpu.specgrid.solve import (
+                solve_spec_stats,
+            )
+
+            stats = contract_spec_grams(
+                *args, route="xla", precision="bf16"
+            )
+            sel_aug = jnp.concatenate(
+                [jnp.ones((s, 1), bool), args[4]], axis=1
+            )
+            sol = solve_spec_stats(
+                stats, sel_aug,
+                contracted_eps=float(jnp.finfo(jnp.bfloat16).eps),
+            )
+            out["kernels_gram_bf16_promoted_months"] = int(
+                np.asarray(sol.suspect).sum()
+            )
+
+    # -- fused rolling family at the production strip shape ----------------
+    d_days, n_cols = (1024, 512) if fast else (12608, 2560)
+    strip = (rng.standard_normal((d_days, n_cols)) * 0.02).astype(np.float32)
+    strip[rng.random(strip.shape) < 0.05] = np.nan
+    xs = jnp.asarray(strip)
+    rolling_route = resolve_rolling_route()
+    out["kernels_rolling_route"] = rolling_route
+    out["kernels_rolling_shape"] = f"D{d_days}_N{n_cols}"
+    for kind, fn, window, mp in (
+        ("std", rolling_std, 252, 100),
+        ("sum", rolling_sum, 24, 12),
+        ("mean", rolling_mean, 12, 1),
+    ):
+        ms_of = {}
+        routes = [("xla", False)] + ([("pallas", True)]
+                                     if platform == "tpu" else [])
+        for label, use_pallas in routes:
+            f = jax.jit(functools.partial(
+                lambda v, _fn, _up: jnp.nansum(_fn(v, window, mp,
+                                                   use_pallas=_up)),
+                _fn=fn, _up=use_pallas,
+            ))
+            run = (lambda f=f: float(f(xs)))  # scalar pull = barrier
+            ms = _timed_ms(run)
+            ms_of[label] = ms
+            out[f"kernels_rolling_{kind}_{label}_ms"] = round(ms, 3)
+            warm_runners.append((f"kernels_rolling_{kind}_{label}", run))
+        if platform == "tpu":
+            out[f"kernels_rolling_{kind}_pallas_speedup"] = round(
+                ms_of["xla"] / ms_of["pallas"], 2)
+        else:
+            out[f"kernels_rolling_{kind}_pallas_ms"] = _kernels_skip(
+                platform, rolling_route, "FMRP_ROLLING_ROUTE", "FMRP_PALLAS"
+            )
+        best = min(ms_of.values())
+        out[f"kernels_rolling_{kind}_melems_per_s"] = round(
+            d_days * n_cols / (best / 1000) / 1e6, 1
+        )
+
+    # -- overlapped cold ingest: serial vs prefetched chunked read ---------
+    from fm_returnprediction_tpu.data.benchscale import write_benchscale_cache
+    from fm_returnprediction_tpu.data.columnar import read_filtered_columns
+    from fm_returnprediction_tpu.data.synthetic import FILE_NAMES
+    from fm_returnprediction_tpu.data.wrds_pull import UNIVERSE_FLAGS
+
+    t_m, n_f = (24, 300) if fast else (120, 4000)
+    out["kernels_ingest_shape"] = f"T{t_m}_N{n_f}"
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    raw_dir = os.path.join(repo_root, "_cache", f"benchscale_T{t_m}_N{n_f}")
+    write_benchscale_cache(raw_dir, n_permnos=n_f, n_months=t_m)
+    daily = os.path.join(raw_dir, FILE_NAMES["crsp_d"])
+    batch_rows = 1 << (14 if fast else 20)  # ≥ ~8 batches through the queue
+    read_kw = dict(
+        value_columns=["permno", "dlycaldt", "retx"],
+        flag_spec=UNIVERSE_FLAGS, batch_rows=batch_rows,
+    )
+    rows = None
+    for label, depth in (("serial", 0), ("overlap", None)):
+        def run(depth=depth):
+            return read_filtered_columns(daily, prefetch=depth, **read_kw)
+        rows = len(run()["retx"])  # touch the file once untimed (page cache)
+        sec = _timed_ms(run, warm=False) / 1000
+        out[f"kernels_ingest_{label}_s"] = round(sec, 4)
+        out[f"kernels_ingest_{label}_rows_per_s"] = round(rows / sec, 1)
+    out["kernels_ingest_rows"] = rows
+    out["kernels_ingest_overlap_speedup"] = round(
+        out["kernels_ingest_serial_s"] / out["kernels_ingest_overlap_s"], 2
+    )
+
+    # -- warm repeat of the whole ladder under the recompile sentinel ------
+    with telemetry.recompile_watch("bench.kernels_warm", warm=True) as delta:
+        for _, run in warm_runners:
+            run()
+    if delta.grew:
+        out["kernels_warm_recompiles"] = {
+            "cache_entries_grew": delta.grew,
+            "culprits": list(delta.culprits) or ["unattributed-jit"],
+        }
     return out
 
 
@@ -2059,6 +2315,7 @@ def main() -> None:
         sections.append(_bench_daily_fullscale)
     if os.environ.get("FMRP_BENCH_PALLAS", "1") == "1":
         sections.append(_bench_pallas)
+    sections.append(_bench_kernels)  # _KERNELS=0 handled in-section
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
     sections.append(_bench_fleet)  # _FLEET=0 handled in-section

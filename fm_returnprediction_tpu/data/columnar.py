@@ -22,12 +22,28 @@ set (nulls never match, as with ``Series.isin``). Anything structurally
 unservable (pyarrow missing, non-parquet cache, absent columns) raises the
 typed :class:`ColumnarIngestError` so the caller can fall back to the
 legacy pandas route instead of crashing the pipeline.
+
+Overlapped cold ingest (PR 11): the chunked read is a strict
+read→filter→decode serial loop by default construction, but its two
+halves live on different sides of the GIL — ``ParquetFile.iter_batches``
+decompresses/decodes in arrow's C++ thread (GIL released), while the flag
+filter, gather and downstream consumption (the dense scatter, the device
+transfer of finished strips) run in Python/XLA. ``_prefetched`` overlaps
+them: a reader thread pulls batches ahead into a BOUNDED queue (depth =
+``FMRP_INGEST_PREFETCH``, default 2 — a double buffer plus the batch in
+flight; 0 restores the serial loop) while the consumer drains it, so the
+cold wall pays max(read, consume) per batch instead of their sum. Batch
+ORDER is preserved (the filter/scatter contract is order-sensitive) and a
+reader-side exception re-raises at the consumer's next pull.
 """
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
 from pathlib import Path
-from typing import Dict, List, Mapping, Optional, Sequence
+from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -35,12 +51,85 @@ __all__ = [
     "ColumnarIngestError",
     "read_filtered_columns",
     "read_table_columns",
+    "resolve_prefetch_depth",
 ]
 
 # Streaming batch size (rows) for the chunked reader: ~4M rows keeps a
 # batch's flag codes + values in tens of MB while amortizing per-batch
 # decode overhead over the 77M-row daily file.
 _BATCH_ROWS = 1 << 22
+
+#: default read-ahead depth of the cold-ingest overlap queue
+_PREFETCH_DEPTH = 2
+
+
+def resolve_prefetch_depth(prefetch: Optional[int] = None) -> int:
+    """Read-ahead depth for the chunked reader: explicit argument >
+    ``FMRP_INGEST_PREFETCH`` env > 2. ``0`` (or anything unparseable,
+    conservatively) disables the reader thread entirely — the serial loop
+    is the differential oracle for the overlap."""
+    if prefetch is not None:
+        return max(int(prefetch), 0)
+    raw = os.environ.get("FMRP_INGEST_PREFETCH", "").strip()
+    if not raw:
+        return _PREFETCH_DEPTH
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def _prefetched(batches: Iterable, depth: int) -> Iterator:
+    """Yield from ``batches`` through a bounded read-ahead queue.
+
+    A daemon reader thread advances the source iterator up to ``depth``
+    items ahead; items come out in source order. If the reader raises, the
+    exception surfaces at the consumer's next pull. If the CONSUMER stops
+    early (exception upstream, generator close), the reader is told to
+    stop and its pending ``put`` is drained so the thread never deadlocks
+    on a full queue."""
+    if depth <= 0:
+        yield from batches
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def _reader():
+        try:
+            for item in batches:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_END)
+        except BaseException as exc:  # noqa: BLE001 - re-raised consumer-side
+            if not stop.is_set():
+                q.put(exc)
+
+    t = threading.Thread(target=_reader, name="fmrp-ingest-prefetch",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # unblock a reader waiting on a full queue, then let it exit
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)
 
 
 class ColumnarIngestError(RuntimeError):
@@ -121,6 +210,7 @@ def read_filtered_columns(
     flag_spec: Mapping[str, Sequence[str]],
     bool_columns: Optional[Mapping[str, Sequence[str]]] = None,
     batch_rows: int = _BATCH_ROWS,
+    prefetch: Optional[int] = None,
 ) -> Dict[str, np.ndarray]:
     """Stream a parquet file and return the ``value_columns`` (plus derived
     ``bool_columns``) of the rows passing the flag filter, as numpy arrays.
@@ -129,6 +219,9 @@ def read_filtered_columns(
     column → values, yielding a derived boolean output named after the
     column (evaluated on dictionary codes like the filter — used for
     ``is_nyse`` without materializing 13M exchange strings).
+    ``prefetch``: read-ahead depth of the overlap queue (None resolves
+    ``FMRP_INGEST_PREFETCH``; 0 = the serial oracle loop) — batch k+1
+    decodes in arrow's C++ thread while batch k filters/gathers here.
     """
     pa_, pq_ = _pyarrow()
     path = Path(path)
@@ -151,7 +244,11 @@ def read_filtered_columns(
     }
     import pyarrow as pa
 
-    for batch in pf.iter_batches(batch_size=batch_rows, columns=read_cols):
+    batches = _prefetched(
+        pf.iter_batches(batch_size=batch_rows, columns=read_cols),
+        resolve_prefetch_depth(prefetch),
+    )
+    for batch in batches:
         cols = {n: batch.column(i) for i, n in enumerate(batch.schema.names)}
         keep = _flag_keep_mask(cols, flag_spec)
         idx = np.flatnonzero(keep)

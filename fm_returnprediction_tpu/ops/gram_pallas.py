@@ -1,0 +1,185 @@
+"""MXU-tiled pallas kernel for the masked per-month Gram contraction.
+
+The spec-grid's hottest device loop is ``specgrid.grams.contract_spec_grams``:
+for every spec s and month t it contracts the (T, N, P) union panel into the
+augmented normal-equation statistics
+
+    G_s[t] = Σ_n  w_s[t,n] · x̃[t,n,:] x̃[t,n,:]ᵀ ,   x̃ = [1 | X − c_t]
+
+The XLA route (retained as the differential oracle, ``specgrid.grams``)
+re-reads the panel once per spec: each spec's weighted design is a separate
+einsum over the same (T, chunk, Q) tile. This kernel restructures the
+contraction around the memory hierarchy instead:
+
+- the grid is (T months, N-firm blocks) with the firm axis innermost and
+  sequential; each step DMAs ONE (P, BN) panel tile into VMEM and serves
+  ALL S specs from it — the panel is read once total, not once per spec;
+- the output tile is the whole augmented (QE, QE) Gram per (spec, month)
+  (QE = P + 2: intercept column first, the regressand appended last — one
+  symmetric product yields gram, moment, n, Σy and Σy² in a single MXU
+  contraction, see ``_split_stats``), held in VMEM across the firm blocks
+  and accumulated in f32 (f64 for f64 panels) — the "blocked over firms ×
+  the Q×Q output tile" shape of the blocked normal-equation update
+  algorithms in "Large-scale linear regression" (PAPERS.md);
+- the row-validity mask is FUSED into the tile load: finiteness of y and of
+  each spec's selected columns, the universe ∧ window mask (one int8
+  tensor), and the optional coreset row weights are applied in VMEM —
+  no (S, T, N) float weight tensor ever materializes in HBM.
+
+The panel arrives TRANSPOSED to (T, P, N) — firms on lanes — so every
+in-kernel broadcast is a (1, BN)-row against a (K, BN) tile and the kernel
+needs no transposes or lane/sublane reshapes; the one-time host transpose
+is a single XLA copy amortized over the whole spec batch.
+
+The kernel is TPU-only by construction; ``interpret=True`` runs it on CPU
+for the differential suite (``tests/test_gram_kernels.py`` pins it against
+the XLA oracle at 1e-6 relative for f32 and at the few-ulp level — 1e-13
+relative, exact counts — for f64; the two routes block their reductions
+differently, so exact bitwise equality is not promised). Route selection
+(``FMRP_GRAM_ROUTE``) lives in ``specgrid.grams``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fm_returnprediction_tpu.ops.pallas_kernels import _CompilerParams, _fit_block
+
+__all__ = ["gram_contract_pallas"]
+
+
+def _gram_kernel(s_specs, has_rw, acc_dtype, *refs):
+    """One (month t, firm block j) step: load the (P, BN) panel tile once,
+    build the augmented design ``xa = [1 | X − c_t | y]`` in VMEM, and
+    accumulate every spec's masked symmetric product into its (QE, QE)
+    output tile. The firm-block axis is sequential, so ``out_ref`` persists
+    in VMEM across j and is written back once per month."""
+    if has_rw:
+        xt_ref, y_ref, m8_ref, selt_ref, centert_ref, rw_ref, out_ref = refs
+    else:
+        xt_ref, y_ref, m8_ref, selt_ref, centert_ref, out_ref = refs
+        rw_ref = None
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    x = xt_ref[0]                                   # (P, BN)
+    dtype = x.dtype
+    finx = jnp.isfinite(x)
+    xz = jnp.where(finx, x - centert_ref[...], 0.0)  # centerT tile is (P, 1)
+    y = y_ref[...]                                   # (1, BN)
+    finy = jnp.isfinite(y)
+    yz = jnp.where(finy, y, 0.0)
+    notfin = (~finx).astype(dtype)                   # (P, BN)
+    xa = jnp.concatenate([jnp.ones_like(yz), xz, yz], axis=0)   # (QE, BN)
+    base = m8_ref[:, 0, :]                           # (S, BN) int8 uni∧window
+    finyf = finy.astype(dtype)
+    rw = rw_ref[...] if has_rw else None             # (1, BN)
+
+    for s in range(s_specs):                         # static: S is a shape
+        # rows invalid for spec s: any SELECTED column non-finite — a tiny
+        # (P,1)·(P,BN) contraction, exact for integer counts ≤ P
+        bad = jax.lax.dot_general(
+            selt_ref[:, s : s + 1], notfin,
+            (((0,), (0,)), ((), ())),
+        )                                            # (1, BN)
+        w = ((base[s : s + 1, :] != 0) & (bad == 0)).astype(dtype) * finyf
+        if has_rw:
+            w = w * rw
+        bw = xa * w                                  # lane-wise row weights
+        out_ref[s, 0] += jax.lax.dot_general(
+            bw, xa, (((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )
+
+
+def _split_stats(out: jnp.ndarray, p: int):
+    """The augmented (S, T, QE, QE) product → the five SpecGramStats
+    moments. Column layout of x̃⁺ = [1 | X − c | y]: gram is the leading
+    (Q, Q) block, the y column holds moment / Σwy / Σwy², and the
+    intercept-intercept entry is Σw (the valid-row count)."""
+    q = p + 1
+    gram = out[:, :, :q, :q]
+    moment = out[:, :, :q, q]
+    n = out[:, :, 0, 0]
+    ysum = out[:, :, 0, q]
+    yy = out[:, :, q, q]
+    return gram, moment, n, ysum, yy
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "interpret")
+)
+def gram_contract_pallas(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    valid: jnp.ndarray,
+    col_sel: jnp.ndarray,
+    center: jnp.ndarray,
+    row_weights=None,
+    block_n: int = 512,
+    interpret: bool = False,
+):
+    """Masked per-month Gram contraction, one panel read for all specs.
+
+    Parameters mirror the XLA oracle's internals (``specgrid.grams``):
+    ``y`` (T, N); ``x`` (T, N, P) in the contraction dtype (bf16 inputs
+    accumulate in f32); ``valid`` (S, T, N) bool — universe ∧ window (y/x
+    finiteness is fused in-kernel); ``col_sel`` (S, P) bool; ``center``
+    (T, P); ``row_weights`` optional (T, N). Returns the five stats arrays
+    in the accumulation dtype (f64 panels accumulate in f64, everything
+    else in f32): ``(gram, moment, n, ysum, yy)``.
+    """
+    t, n_firms, p = x.shape
+    s_specs = col_sel.shape[0]
+    qe = p + 2
+    dtype = x.dtype
+    acc_dtype = jnp.float64 if dtype == jnp.float64 else jnp.float32
+
+    bn = _fit_block(n_firms, block_n, 128)
+    pad = (-n_firms) % bn
+    xt = jnp.swapaxes(x, 1, 2)                       # (T, P, N): firms on lanes
+    centert = center.astype(dtype).T                 # (P, T)
+    selt = col_sel.astype(dtype).T                   # (P, S)
+    m8 = valid.astype(jnp.int8)
+    if pad:
+        xt = jnp.pad(xt, ((0, 0), (0, 0), (0, pad)))
+        y = jnp.pad(y, ((0, 0), (0, pad)), constant_values=jnp.nan)
+        m8 = jnp.pad(m8, ((0, 0), (0, 0), (0, pad)))
+        if row_weights is not None:
+            row_weights = jnp.pad(row_weights, ((0, 0), (0, pad)))
+    has_rw = row_weights is not None
+
+    in_specs = [
+        pl.BlockSpec((1, p, bn), lambda it, j: (it, 0, j)),        # xt
+        pl.BlockSpec((1, bn), lambda it, j: (it, j)),              # y
+        pl.BlockSpec((s_specs, 1, bn), lambda it, j: (0, it, j)),  # mask
+        pl.BlockSpec((p, s_specs), lambda it, j: (0, 0)),          # selT
+        pl.BlockSpec((p, 1), lambda it, j: (0, it)),               # centerT
+    ]
+    args = [xt, y.astype(dtype), m8, selt, centert]
+    if has_rw:
+        in_specs.append(pl.BlockSpec((1, bn), lambda it, j: (it, j)))
+        args.append(jnp.asarray(row_weights, dtype))
+
+    out = pl.pallas_call(
+        functools.partial(_gram_kernel, s_specs, has_rw, acc_dtype),
+        grid=(t, (n_firms + pad) // bn),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (s_specs, 1, qe, qe), lambda it, j: (0, it, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((s_specs, t, qe, qe), acc_dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return _split_stats(out, p)

@@ -50,7 +50,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["masked_cumulative_moments", "rolling_std_fused"]
+__all__ = [
+    "masked_cumulative_moments",
+    "rolling_std_fused",
+    "rolling_sum_fused",
+    "rolling_mean_fused",
+]
 
 # version-compat shim (the parallel.mesh shard_map pattern): pallas renamed
 # ``TPUCompilerParams`` → ``CompilerParams``; accept whichever this jax
@@ -154,14 +159,23 @@ def masked_cumulative_moments(
     return csum[:t, :n], csumsq[:t, :n], ccnt[:t, :n]
 
 
-def _windowed_std_kernel(window, min_periods, x_ref, out_ref, carry_ref, hist_ref):
-    """One (BT, BN) tile: mask → block cumsums → windowed diff → std.
+def _windowed_reduce_kernel(window, min_periods, kind,
+                            x_ref, out_ref, carry_ref, hist_ref):
+    """One (BT, BN) tile: mask → block cumsums → windowed diff → finalize.
 
     ``hist_ref`` holds the last ``window`` rows of the (carried) cumulative
     moments from preceding T blocks, so the ``t-window`` lag is a static
     VMEM slice for ANY window/block_t combination; it starts at zero, which
     is exactly the "cumsum before the series start" value trailing truncated
     windows need.
+
+    ``kind`` (trace-time static) selects the finalization — ``"sum"`` /
+    ``"mean"`` / ``"std"`` — transcribing ``ops.rolling``'s
+    ``finalize_sum``/``finalize_mean``/``finalize_std`` semantics exactly.
+    Sum and mean ride the same three-column (Σx, Σx², count) cumsum as std:
+    the extra column is VMEM-local MXU work on a kernel whose cost is the
+    HBM read of ``x`` and write of the result, and one kernel body keeps
+    one set of carry semantics to verify.
     """
     it = pl.program_id(1)
 
@@ -181,16 +195,55 @@ def _windowed_std_kernel(window, min_periods, x_ref, out_ref, carry_ref, hist_re
     w = cs - full[0:bt, :]
 
     s, s2, cnt = w[:, 0:bn], w[:, bn : 2 * bn], w[:, 2 * bn : 3 * bn]
-    cnt_safe = jnp.maximum(cnt, 2.0)
-    mean = s / jnp.maximum(cnt, 1.0)
-    var = (s2 - cnt * mean * mean) / (cnt_safe - 1.0)
-    std = jnp.sqrt(jnp.maximum(var, 0.0))
-    out_ref[...] = jnp.where(cnt >= max(min_periods, 2), std, jnp.nan)
+    if kind == "sum":
+        out_ref[...] = jnp.where(cnt >= min_periods, s, jnp.nan)
+    elif kind == "mean":
+        mean = s / jnp.maximum(cnt, 1.0)
+        out_ref[...] = jnp.where(cnt >= min_periods, mean, jnp.nan)
+    else:  # std (ddof=1, count>=2 rule)
+        cnt_safe = jnp.maximum(cnt, 2.0)
+        mean = s / jnp.maximum(cnt, 1.0)
+        var = (s2 - cnt * mean * mean) / (cnt_safe - 1.0)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        out_ref[...] = jnp.where(cnt >= max(min_periods, 2), std, jnp.nan)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("window", "min_periods", "block_t", "block_n", "interpret")
+    jax.jit,
+    static_argnames=("window", "min_periods", "kind", "block_t", "block_n",
+                     "interpret"),
 )
+def _rolling_reduce_fused(
+    x: jnp.ndarray,
+    window: int,
+    min_periods: int,
+    kind: str,
+    block_t: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Shared launch for the fused trailing-window family (one HBM read of
+    ``x``, one write of the finished reduction)."""
+    t, n = x.shape
+    xp, grid, spec, block_t, block_n = _tiles(x, block_t, block_n)
+    out = pl.pallas_call(
+        functools.partial(_windowed_reduce_kernel, window, min_periods, kind),
+        grid=grid,
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 3 * block_n), x.dtype),
+            pltpu.VMEM((window, 3 * block_n), x.dtype),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(xp)
+    return out[:t, :n]
+
+
 def rolling_std_fused(
     x: jnp.ndarray,
     window: int,
@@ -206,21 +259,37 @@ def rolling_std_fused(
     entries in the window; NaN entries occupy window rows but are excluded
     from the reduction — ``src/calc_Lewellen_2014.py:448-453``).
     """
-    t, n = x.shape
-    xp, grid, spec, block_t, block_n = _tiles(x, block_t, block_n)
-    out = pl.pallas_call(
-        functools.partial(_windowed_std_kernel, window, min_periods),
-        grid=grid,
-        in_specs=[spec],
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct(xp.shape, x.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((1, 3 * block_n), x.dtype),
-            pltpu.VMEM((window, 3 * block_n), x.dtype),
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(xp)
-    return out[:t, :n]
+    return _rolling_reduce_fused(x, window, min_periods, "std",
+                                 block_t=block_t, block_n=block_n,
+                                 interpret=interpret)
+
+
+def rolling_sum_fused(
+    x: jnp.ndarray,
+    window: int,
+    min_periods: int,
+    block_t: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Trailing-window masked sum, fully fused (``ops.rolling.rolling_sum``
+    semantics: NaN entries occupy rows but are excluded; NaN until
+    ``min_periods`` finite entries)."""
+    return _rolling_reduce_fused(x, window, min_periods, "sum",
+                                 block_t=block_t, block_n=block_n,
+                                 interpret=interpret)
+
+
+def rolling_mean_fused(
+    x: jnp.ndarray,
+    window: int,
+    min_periods: int,
+    block_t: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Trailing-window masked mean, fully fused
+    (``ops.rolling.rolling_mean`` semantics)."""
+    return _rolling_reduce_fused(x, window, min_periods, "mean",
+                                 block_t=block_t, block_n=block_n,
+                                 interpret=interpret)

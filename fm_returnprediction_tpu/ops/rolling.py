@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "ROLLING_ROUTES",
+    "resolve_rolling_route",
     "windowed_sum",
     "windowed_count",
     "finalize_sum",
@@ -59,34 +61,64 @@ def _gate(value: jnp.ndarray, count: jnp.ndarray, min_periods: int) -> jnp.ndarr
     return jnp.where(count >= min_periods, value, jnp.nan)
 
 
-def rolling_sum(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
-    """pandas ``.rolling(window, min_periods).sum()`` on axis 0."""
+def rolling_sum(
+    x: jnp.ndarray, window: int, min_periods: int,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).sum()`` on axis 0.
+
+    On TPU 2-D inputs dispatch to the fused pallas kernel by default
+    (``ops.pallas_kernels.rolling_sum_fused`` — same one-read/one-write
+    structure as the std kernel); ``use_pallas``/``FMRP_ROLLING_ROUTE``
+    override, other platforms stay on the XLA cumsum path."""
+    if use_pallas is None:
+        use_pallas = x.ndim == 2 and _pallas_default(x)
+    if use_pallas:
+        from fm_returnprediction_tpu.ops.pallas_kernels import rolling_sum_fused
+
+        return rolling_sum_fused(x, window, min_periods)
     finite = jnp.isfinite(x)
     total = windowed_sum(jnp.where(finite, x, 0.0), window)
     return finalize_sum(total, windowed_count(finite, window), min_periods)
 
 
-def rolling_mean(x: jnp.ndarray, window: int, min_periods: int) -> jnp.ndarray:
-    """pandas ``.rolling(window, min_periods).mean()`` on axis 0."""
+def rolling_mean(
+    x: jnp.ndarray, window: int, min_periods: int,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """pandas ``.rolling(window, min_periods).mean()`` on axis 0.
+
+    Route dispatch as in ``rolling_sum``."""
+    if use_pallas is None:
+        use_pallas = x.ndim == 2 and _pallas_default(x)
+    if use_pallas:
+        from fm_returnprediction_tpu.ops.pallas_kernels import (
+            rolling_mean_fused,
+        )
+
+        return rolling_mean_fused(x, window, min_periods)
     finite = jnp.isfinite(x)
     total = windowed_sum(jnp.where(finite, x, 0.0), window)
     return finalize_mean(total, windowed_count(finite, window), min_periods)
 
 
-def _pallas_default(x=None) -> bool:
-    """Whether ``rolling_std`` dispatches to the fused pallas kernel.
+ROLLING_ROUTES = ("xla", "pallas")
 
-    Default ON on TPU: the rebuilt fully fused kernel (one HBM read, one
+
+def resolve_rolling_route(x=None, route: str | None = None) -> str:
+    """Which route the rolling family dispatches: ``"xla"`` or ``"pallas"``.
+
+    Precedence: explicit ``route`` argument > ``FMRP_ROLLING_ROUTE`` env
+    (``auto``/``xla``/``pallas``) > the legacy ``FMRP_PALLAS`` boolean
+    (kept as a back-compat alias) > platform default. The platform default
+    is pallas on TPU — the rebuilt fully fused kernel (one HBM read, one
     write — ``ops.pallas_kernels``) measured **2.81×** over the XLA cumsum
     path on hardware (``BENCH_r04_self.json``: ``rolling_std_pallas_ms``
     8.337 vs ``rolling_std_xla_ms`` 23.389 on a (12608, 4096) f32 strip,
-    TPU v5e).
-    The round-2 three-output version measured 0.95× and was rebuilt; the
-    default stayed off until this recorded artifact existed. Off
-    elsewhere — the kernel is TPU-only by construction and interpret mode
-    is a correctness harness, not a fast path. ``FMRP_PALLAS=1/0``
-    overrides either way; ``bench.py`` keeps measuring both paths every
-    TPU round so a regression shows up in the artifact.
+    TPU v5e) — and xla elsewhere: the kernels are TPU-only by construction
+    and interpret mode is a correctness harness, not a fast path.
+    ``bench.py`` keeps measuring both paths every TPU round so a
+    regression shows up in the artifact.
 
     The platform is read from ``x``'s committed placement when it has one
     — a process with a TPU backend can still run host-side parity checks
@@ -96,9 +128,20 @@ def _pallas_default(x=None) -> bool:
     will land."""
     import os
 
+    if route is None:
+        env = os.environ.get("FMRP_ROLLING_ROUTE", "").strip().lower()
+        route = env or "auto"
+    if route in ROLLING_ROUTES:
+        return route
+    if route != "auto":
+        raise ValueError(
+            f"rolling route must be one of {('auto',) + ROLLING_ROUTES}, "
+            f"got {route!r}"
+        )
     flag = os.environ.get("FMRP_PALLAS")
     if flag is not None:
-        return flag.strip().lower() in ("1", "true", "yes", "on")
+        on = flag.strip().lower() in ("1", "true", "yes", "on")
+        return "pallas" if on else "xla"
     import jax
 
     devices = None
@@ -107,8 +150,16 @@ def _pallas_default(x=None) -> bool:
         if sharding is not None:
             devices = getattr(sharding, "_device_assignment", None)
     if devices:
-        return devices[0].platform == "tpu"
-    return jax.devices()[0].platform == "tpu"
+        platform = devices[0].platform
+    else:
+        platform = jax.devices()[0].platform
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def _pallas_default(x=None) -> bool:
+    """Back-compat boolean view of ``resolve_rolling_route`` (the original
+    ``rolling_std``-only dispatch predicate; callers and tests keep it)."""
+    return resolve_rolling_route(x) == "pallas"
 
 
 def finalize_sum(s1, count, min_periods: int) -> jnp.ndarray:

@@ -507,16 +507,23 @@ def get_factors(
             i for i, n in enumerate(panel.var_names) if n in win_names
         )
         extra_win = tuple(n in win_names for n in new_names)
-        program_args = (
-            values_dev, mask_dev,
-            [jnp.asarray(vol_m), jnp.asarray(beta_m)],
-        )
+        extras_dev = [jnp.asarray(vol_m), jnp.asarray(beta_m)]
         static_kwargs = dict(
             var_index=var_index, base_win_idx=base_win_idx,
             extra_win=extra_win,
         )
-        exe = _compiled_characteristics_program(program_args, static_kwargs)
-        values_dev = exe(*program_args)
+        exe = _compiled_characteristics_program(
+            (values_dev, mask_dev, extras_dev), static_kwargs
+        )
+        # the (T, N, K) base panel must not outlive its last use: rebinding
+        # ``values_dev`` to the program's output (instead of holding both
+        # in a lingering args tuple, as earlier rounds did) lets the
+        # runtime release the pre-enrichment generation as soon as the
+        # program consumes it — the donation-map note in
+        # ``docs/architecture.md`` explains why the concat-shaped output
+        # cannot alias it outright
+        values_dev = exe(values_dev, mask_dev, extras_dev)
+        del extras_dev
         final = DensePanel(
             values=values_dev,
             mask=panel.mask,

@@ -180,11 +180,20 @@ class _Engine:
     def __init__(self, y, x, universe_masks, space: CellSpace, *,
                  mask, route: str, mesh, referee: bool,
                  firm_chunk, label_of, seed: int,
-                 coreset_m, coreset_budget_mb, tile_cells):
+                 coreset_m, coreset_budget_mb, tile_cells,
+                 gram_route=None, precision=None):
+        from fm_returnprediction_tpu.specgrid.grams import (
+            resolve_gram_precision,
+            resolve_gram_route,
+        )
         from fm_returnprediction_tpu.specgrid.sharded import (
             resolve_specgrid_mesh,
         )
 
+        # resolved ONCE per sweep (knob flips mid-sweep would splice two
+        # numerics regimes into one result frame)
+        self.gram_route = resolve_gram_route(gram_route)
+        self.precision = resolve_gram_precision(precision)
         self.space = space
         self.union = space.union_predictors
         self.y = jnp.asarray(y)
@@ -258,7 +267,15 @@ class _Engine:
 
     def x_at_level(self, level: float):
         """The union tensor re-winsorized at ``level`` — single-slot cache
-        (winsor is the outermost dimension; levels arrive contiguously)."""
+        (winsor is the outermost dimension; levels arrive contiguously).
+
+        Generational buffer discipline: the PREVIOUS level's variant is a
+        dead (T, N, P) buffer the moment a new level arrives, so it is
+        handed to ``winsor_variant`` as the DONATED scratch the new
+        variant is written into (and the cache slot is cleared first so no
+        stray reference pins a third generation). Peak live union tensors
+        during a re-clip: two (base + the aliased in-place variant),
+        instead of three."""
         if self._winsor_cache is not None and self._winsor_cache[0] == level:
             return self._winsor_cache[1]
         if level == 1.0:
@@ -273,8 +290,13 @@ class _Engine:
                     "winsor levels beyond the stored base clip need the "
                     "panel validity mask (mask=...)"
                 )
+            scratch = None
+            if (self._winsor_cache is not None
+                    and self._winsor_cache[1] is not self.x_base):
+                scratch = self._winsor_cache[1]
+            self._winsor_cache = None  # the old generation must not outlive
             x_level = winsor_variant(self.x_base, jnp.asarray(self.mask),
-                                     float(level))
+                                     float(level), scratch=scratch)
         self._winsor_cache = (level, x_level)
         return x_level
 
@@ -288,6 +310,7 @@ class _Engine:
             grid=grid, weights=self.space.weights, referee=self.referee,
             firm_chunk=self.firm_chunk, mesh=self.mesh,
             row_weights=self.row_weights,
+            gram_route=self.gram_route, precision=self.precision,
         )
 
     def resample(self, draw: int) -> np.ndarray:
@@ -364,6 +387,15 @@ class _Engine:
             }
             if space.bootstrap > 1:
                 r["draw"] = cell.draw
+            if self.precision == "bf16":
+                # the disclosed-degradation pattern the coreset route set:
+                # every bf16 cell names its precision and how many of its
+                # months the conditioning referee promoted back to the
+                # full-precision QR route (``refereed`` says whether the
+                # promotion actually ran — it is False when the referee is
+                # off, e.g. under the coreset route)
+                r["precision"] = "bf16"
+                r["bf16_promoted_months"] = int(res.suspect_months[row])
             if self.route == "coreset":
                 r["route"] = "coreset"
                 r["coreset_m"] = self.plan.m_per_month
@@ -390,6 +422,8 @@ def run_cellspace(
     coreset_m: Optional[int] = None,
     coreset_budget_mb: Optional[float] = None,
     output_dir=None,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
 ):
     """Stream a ``CellSpace`` sweep through a sink.
 
@@ -408,7 +442,7 @@ def run_cellspace(
         mask=mask, route=route, mesh=mesh, referee=referee,
         firm_chunk=firm_chunk, label_of=label_of, seed=seed,
         coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
-        tile_cells=tile_cells,
+        tile_cells=tile_cells, gram_route=gram_route, precision=precision,
     )
     cells_counter = telemetry.registry().counter(
         "fmrp_specgrid_cells_total",
@@ -440,6 +474,8 @@ def run_cellspace(
         "seconds": sweep_t.s,
         "cells_per_s": (len(space) / sweep_t.s) if sweep_t.s > 0 else None,
         "route": route,
+        "gram_route": engine.gram_route,
+        "precision": engine.precision,
     }
     if engine.plan is not None:
         stats["coreset_m"] = engine.plan.m_per_month

@@ -25,19 +25,80 @@ padding, no transposed copy of the panel): peak temporary is one
 ``(T, chunk, Q)`` weighted design per spec instead of any full-panel
 design. Additivity over firms is what makes the chunked accumulation exact
 — ``tests/test_specgrid.py`` pins it as a sharding property test.
+
+Routes and precision (the kernel-speed vertical, PR 11):
+
+- ``route`` — ``"xla"`` (this module's chunked einsum loop, the
+  differential oracle and the CPU default) or ``"pallas"`` (the MXU-tiled
+  kernel, ``ops.gram_pallas``: one panel read serves all specs, validity
+  fused into the tile load, f32 scratch accumulation; the TPU default).
+  ``FMRP_GRAM_ROUTE`` ∈ {auto, xla, pallas} sets the default;
+  resolution happens OUTSIDE jit (``resolve_gram_route``) so the knob is
+  a static program choice, and the mesh-sharded path always contracts via
+  XLA (GSPMD cannot partition the pallas custom call).
+- ``precision`` — ``"highest"`` (the historical jaxpr, byte-identical
+  with the knobs at defaults) or ``"bf16"``: inputs cast to bf16,
+  products accumulated in f32 (``preferred_element_type``), on either
+  route. The bf16 stats carry bf16's eps downstream — ``specgrid.solve``
+  prices each month's conditioning against 1/√eps(bf16) and the two-tier
+  referee promotes flagged specs back to the full-precision QR route,
+  disclosed per cell (``bf16_promoted_months``). ``FMRP_GRAM_PRECISION``
+  sets the default.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SpecGramStats", "contract_spec_grams", "auto_firm_chunk"]
+__all__ = [
+    "SpecGramStats",
+    "contract_spec_grams",
+    "auto_firm_chunk",
+    "resolve_gram_route",
+    "resolve_gram_precision",
+]
 
 _PRECISION = jax.lax.Precision.HIGHEST
+
+GRAM_ROUTES = ("xla", "pallas")
+GRAM_PRECISIONS = ("highest", "bf16")
+
+
+def resolve_gram_route(route: Optional[str] = None) -> str:
+    """The contraction route: explicit argument > ``FMRP_GRAM_ROUTE`` env >
+    platform default (pallas on TPU, xla elsewhere — the pallas kernel is
+    TPU-only by construction and interpret mode is a correctness harness,
+    not a fast path). Called OUTSIDE jit so the knob is a static program
+    choice and flipping the env var mid-process takes effect."""
+    if route is None:
+        route = os.environ.get("FMRP_GRAM_ROUTE", "auto").strip().lower() or "auto"
+    if route == "auto":
+        route = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+    if route not in GRAM_ROUTES:
+        raise ValueError(
+            f"gram route must be one of {('auto',) + GRAM_ROUTES}, got {route!r}"
+        )
+    return route
+
+
+def resolve_gram_precision(precision: Optional[str] = None) -> str:
+    """The contraction precision: explicit argument > ``FMRP_GRAM_PRECISION``
+    env > ``"highest"`` (the historical exact route)."""
+    if precision is None:
+        precision = (
+            os.environ.get("FMRP_GRAM_PRECISION", "highest").strip().lower()
+            or "highest"
+        )
+    if precision not in GRAM_PRECISIONS:
+        raise ValueError(
+            f"gram precision must be one of {GRAM_PRECISIONS}, got {precision!r}"
+        )
+    return precision
 
 
 class SpecGramStats(NamedTuple):
@@ -70,7 +131,10 @@ def auto_firm_chunk(t: int, n: int, q: int, itemsize: int,
     return max(chunk, min(n, 128))
 
 
-@functools.partial(jax.jit, static_argnames=("firm_chunk",))
+@functools.partial(
+    jax.jit,
+    static_argnames=("firm_chunk", "route", "precision", "block_n", "interpret"),
+)
 def contract_spec_grams(
     y: jnp.ndarray,
     x: jnp.ndarray,
@@ -81,6 +145,10 @@ def contract_spec_grams(
     firm_chunk: Optional[int] = None,
     center: Optional[jnp.ndarray] = None,
     row_weights: Optional[jnp.ndarray] = None,
+    route: str = "xla",
+    precision: str = "highest",
+    block_n: int = 512,
+    interpret: bool = False,
 ) -> SpecGramStats:
     """Contract the (T, N, P) union panel into (S, T, Q, Q) Gram stats.
 
@@ -105,12 +173,28 @@ def contract_spec_grams(
         estimate of the full-sample row count), and every moment is the
         correspondingly weighted sum. ``None`` (the default) traces the
         exact historical unweighted jaxpr.
+    route : ``"xla"`` (default — this chunk loop, the differential oracle)
+        or ``"pallas"`` (``ops.gram_pallas``). Static; callers resolve the
+        ``FMRP_GRAM_ROUTE`` knob OUTSIDE jit via ``resolve_gram_route``.
+    precision : ``"highest"`` (default — with route="xla" the historical
+        byte-identical jaxpr) or ``"bf16"`` (inputs cast to bf16,
+        accumulation in f32; stats come back f32 and carry bf16's eps to
+        the solve's conditioning referee). Coreset ``row_weights`` under
+        bf16 are themselves bf16-rounded — a disclosed approximation on
+        top of an approximation route.
+    block_n : pallas route only — the firm-block width (lane multiple).
 
     Validity per spec = universe ∧ finite(y) ∧ finite(selected x) ∧ window
     — exactly ``ops.ols.row_validity`` restricted to the spec's columns,
     which is what keeps each cell's complete-case sample identical to the
     per-cell QR route it replaces.
     """
+    if route not in GRAM_ROUTES:
+        raise ValueError(f"route must be one of {GRAM_ROUTES}, got {route!r}")
+    if precision not in GRAM_PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {GRAM_PRECISIONS}, got {precision!r}"
+        )
     t, n_firms, p = x.shape
     q = p + 1
     dtype = x.dtype
@@ -126,14 +210,46 @@ def contract_spec_grams(
     else:
         center = jnp.asarray(center, dtype)
 
-    uni = universes[uidx]                    # (S, T, N) bool
-    sel_f = col_sel.astype(dtype)            # (S, P)
+    if precision == "bf16":
+        # inputs rounded to bf16 ONCE, products accumulated in f32; the
+        # center returned is the bf16 value actually subtracted (upcast
+        # exactly), so the solve's intercept recovery uses the shift the
+        # contraction really applied
+        cdtype = jnp.bfloat16
+        acc_dtype = jnp.float32
+        x = x.astype(cdtype)
+        y = y.astype(cdtype)
+        center = center.astype(cdtype)
+        out_center = center.astype(acc_dtype)
+        if row_weights is not None:
+            row_weights = jnp.asarray(row_weights, cdtype)
+        ein = functools.partial(
+            jnp.einsum, precision=_PRECISION, preferred_element_type=acc_dtype
+        )
+    else:
+        cdtype = dtype
+        acc_dtype = dtype
+        out_center = center
+        ein = functools.partial(jnp.einsum, precision=_PRECISION)
 
-    gram = jnp.zeros((s_specs, t, q, q), dtype)
-    moment = jnp.zeros((s_specs, t, q), dtype)
-    n_acc = jnp.zeros((s_specs, t), dtype)
-    ysum = jnp.zeros((s_specs, t), dtype)
-    yy = jnp.zeros((s_specs, t), dtype)
+    if route == "pallas":
+        from fm_returnprediction_tpu.ops.gram_pallas import gram_contract_pallas
+
+        valid_base = universes[uidx] & window[:, :, None]   # (S, T, N)
+        gram, moment, n_acc, ysum, yy = gram_contract_pallas(
+            y, x, valid_base, col_sel, center,
+            row_weights=row_weights, block_n=block_n, interpret=interpret,
+        )
+        return SpecGramStats(gram, moment, n_acc, ysum, yy, out_center)
+
+    uni = universes[uidx]                    # (S, T, N) bool
+    sel_f = col_sel.astype(cdtype)           # (S, P)
+
+    gram = jnp.zeros((s_specs, t, q, q), acc_dtype)
+    moment = jnp.zeros((s_specs, t, q), acc_dtype)
+    n_acc = jnp.zeros((s_specs, t), acc_dtype)
+    ysum = jnp.zeros((s_specs, t), acc_dtype)
+    yy = jnp.zeros((s_specs, t), acc_dtype)
 
     for start in range(0, n_firms, chunk):
         sl = slice(start, min(start + chunk, n_firms))
@@ -143,8 +259,7 @@ def contract_spec_grams(
         xz = jnp.where(finx, xc - center[:, None, :], 0.0)
         yz = jnp.where(finy, yc, 0.0)
         # rows invalid for spec s: any selected column non-finite
-        bad = jnp.einsum("tnp,sp->stn", (~finx).astype(dtype), sel_f,
-                         precision=_PRECISION)
+        bad = ein("tnp,sp->stn", (~finx).astype(cdtype), sel_f)
         valid = (
             uni[:, :, sl]
             & finy[None]
@@ -155,26 +270,34 @@ def contract_spec_grams(
 
         rw = None
         if row_weights is not None:
-            rw = jnp.asarray(row_weights, dtype)[:, sl]   # (T, c)
+            rw = jnp.asarray(row_weights, cdtype)[:, sl]   # (T, c)
 
         g_parts, m_parts, n_parts, ys_parts, yy_parts = [], [], [], [], []
         for s in range(s_specs):              # static: S is a shape
-            w = valid[s].astype(dtype)        # (T, c)
+            w = valid[s].astype(cdtype)       # (T, c)
             if rw is not None:
                 w = w * rw
             b = xa * w[..., None]             # the ONE large temporary
-            g_parts.append(jnp.einsum("tnp,tnq->tpq", b, xa,
-                                      precision=_PRECISION))
-            m_parts.append(jnp.einsum("tnp,tn->tp", b, yz,
-                                      precision=_PRECISION))
-            wy = w * yz
-            n_parts.append(w.sum(-1))
-            ys_parts.append(wy.sum(-1))
-            yy_parts.append((wy * yz).sum(-1))
+            g_parts.append(ein("tnp,tnq->tpq", b, xa))
+            m_parts.append(ein("tnp,tn->tp", b, yz))
+            if precision == "bf16":
+                # the tiny per-month reductions upcast per element: each
+                # product is a bf16-exact value, the SUM must not be —
+                # bf16 loses integer counts beyond 256
+                w32 = w.astype(acc_dtype)
+                wy32 = w32 * yz.astype(acc_dtype)
+                n_parts.append(w32.sum(-1))
+                ys_parts.append(wy32.sum(-1))
+                yy_parts.append((wy32 * yz.astype(acc_dtype)).sum(-1))
+            else:
+                wy = w * yz
+                n_parts.append(w.sum(-1))
+                ys_parts.append(wy.sum(-1))
+                yy_parts.append((wy * yz).sum(-1))
         gram = gram + jnp.stack(g_parts)
         moment = moment + jnp.stack(m_parts)
         n_acc = n_acc + jnp.stack(n_parts)
         ysum = ysum + jnp.stack(ys_parts)
         yy = yy + jnp.stack(yy_parts)
 
-    return SpecGramStats(gram, moment, n_acc, ysum, yy, center)
+    return SpecGramStats(gram, moment, n_acc, ysum, yy, out_center)

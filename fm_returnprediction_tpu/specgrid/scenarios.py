@@ -66,13 +66,42 @@ def _rewinsorize(x, mask, lower: float, upper: float):
     return jnp.moveaxis(win, 0, -1)
 
 
-def winsor_variant(x, mask, level: float, base_level: float = 1.0):
+@functools.partial(
+    jax.jit, static_argnames=("lower", "upper"),
+    donate_argnums=(0,), keep_unused=True,
+)
+def _rewinsorize_into(scratch, x, mask, lower: float, upper: float):
+    """``_rewinsorize`` writing its (T, N, P) output into the DONATED
+    ``scratch`` buffer — the previous winsor level's dead variant. The
+    sweep's re-clip chain then double-buffers: without donation the engine
+    transiently holds THREE full union tensors (base + old variant + new
+    variant, ~2.2 GB at real Table-2 shape); with it, XLA aliases the new
+    variant onto the old one's allocation (the ``tf.aliasing_output``
+    contract ``tests/test_donation.py`` asserts at the lowering level).
+    ``scratch`` is donated for its memory, not its values — ``keep_unused``
+    stops jit from pruning the otherwise-unread argument, which would
+    silently drop the alias."""
+    from fm_returnprediction_tpu.ops.quantiles import winsorize_cs_batched
+
+    cols = jnp.moveaxis(x, -1, 0)                 # (V, T, N)
+    win = winsorize_cs_batched(cols, mask, lower, upper)
+    return jnp.moveaxis(win, 0, -1)
+
+
+def winsor_variant(x, mask, level: float, base_level: float = 1.0,
+                   scratch=None):
     """Re-clip the union tensor at ``[level, 100-level]`` percent.
 
     ``x`` (T, N, P) already winsorized at ``base_level``; tighter levels
     equal the raw-data variant on months with enough valid names (see
     module docstring for the rank condition), looser ones are
-    unrecoverable and rejected."""
+    unrecoverable and rejected.
+
+    ``scratch`` — an optional DEAD device buffer of the output's exact
+    shape/dtype (the previous level's variant): it is donated and the new
+    variant is written into its allocation (``_rewinsorize_into``). The
+    caller must hold no further references; the array is invalid after
+    this call."""
     if level < base_level:
         raise ValueError(
             f"winsor level {level}% is looser than the panel's base "
@@ -80,7 +109,12 @@ def winsor_variant(x, mask, level: float, base_level: float = 1.0):
         )
     if level == base_level:
         return jnp.asarray(x)
-    return _rewinsorize(jnp.asarray(x), jnp.asarray(mask),
+    x = jnp.asarray(x)
+    if (scratch is not None and getattr(scratch, "shape", None) == x.shape
+            and getattr(scratch, "dtype", None) == x.dtype):
+        return _rewinsorize_into(scratch, x, jnp.asarray(mask),
+                                 float(level), float(100.0 - level))
+    return _rewinsorize(x, jnp.asarray(mask),
                         float(level), float(100.0 - level))
 
 
@@ -161,6 +195,8 @@ def run_scenarios(
     coreset_budget_mb: Optional[float] = None,
     output_dir=None,
     return_stats: bool = False,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
 ):
     """The scenario sweep: one tidy row per (cell, predictor).
 
@@ -214,7 +250,7 @@ def run_scenarios(
         sink=sink, tile_cells=tile_cells, route=route, mesh=mesh,
         referee=referee, mask=jnp.asarray(panel.mask), label_of=label_of,
         seed=seed, coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
-        output_dir=output_dir,
+        output_dir=output_dir, gram_route=gram_route, precision=precision,
     )
     if return_stats:
         return frame, stats

@@ -59,7 +59,11 @@ from fm_returnprediction_tpu.ops.fama_macbeth import (
     fama_macbeth_summary,
 )
 from fm_returnprediction_tpu.ops.ols import CSRegressionResult
-from fm_returnprediction_tpu.specgrid.grams import contract_spec_grams
+from fm_returnprediction_tpu.specgrid.grams import (
+    contract_spec_grams,
+    resolve_gram_precision,
+    resolve_gram_route,
+)
 from fm_returnprediction_tpu.specgrid.specs import SpecGrid
 
 __all__ = [
@@ -200,7 +204,8 @@ class SpecGridResult(NamedTuple):
         )
 
 
-def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
+def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False,
+                     contracted_eps: Optional[float] = None):
     """Solve every (spec, month) padded Gram system.
 
     ``sel_aug`` (S, Q) bool selects augmented columns (intercept always
@@ -214,6 +219,17 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
     (reported for every dtype; only the f64 tier referees) — as
     ``(SpecSolve, counters)``; ``guard=False`` keeps the historical
     single-value return and the unguarded jaxpr.
+
+    ``contracted_eps`` (trace-time static) declares that the stats were
+    contracted at a LOWER precision than their storage dtype — the bf16
+    route hands f32 arrays whose information floor is bf16's eps. The
+    pinv/rank cutoff then uses that eps (the precision-policy rule: decide
+    at the precision the stats were contracted in), and the CONDITIONING
+    referee tier turns ON at ``1/√contracted_eps`` regardless of panel
+    dtype: a month the bf16 Gram algebra cannot defend is flagged suspect
+    and the spec is PROMOTED back to the full-precision f32/f64 QR route
+    by the existing referee (``run_spec_grid_weights``), with the count
+    disclosed per cell. ``None`` keeps the historical storage-dtype rule.
     """
     gram, moment, n, ysum, yy, center = stats
     # Precision policy (measured on the real-shape benchscale panel,
@@ -227,8 +243,13 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
     # equilibrated centered Gram solve (t-stat drift 12-24 vs ≤3e-5 on
     # the well-posed cells), so conditioning-refereeing there would
     # swap a better answer for a worse one.
-    data_eps = float(jnp.finfo(gram.dtype).eps)
+    data_eps = (float(jnp.finfo(gram.dtype).eps) if contracted_eps is None
+                else float(contracted_eps))
+    # the conditioning tier referees where the incumbent QR route outranks
+    # the Gram solve in precision: f64 panels (historical rule), or ANY
+    # panel whose stats were contracted below storage precision (bf16)
     data_is_f64 = gram.dtype == jnp.float64
+    cond_tier = data_is_f64 or contracted_eps is not None
     if jax.config.jax_enable_x64 and not data_is_f64:
         gram, moment = gram.astype(jnp.float64), moment.astype(jnp.float64)
         n, ysum, yy = (a.astype(jnp.float64) for a in (n, ysum, yy))
@@ -270,8 +291,9 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
     rank_sel = (w > cutoff[..., None]).sum(-1) - (q - q_s[:, None])
     rank_deficient = rank_sel < q_s[:, None]
     # conditioning component only where the referee outranks the Gram
-    # solve in precision (f64 panels; see the policy note above)
-    ill = (w[..., 0] * cond_limit < wmax) if data_is_f64 else False
+    # solve in precision (f64 panels, or bf16-contracted stats whose
+    # promotion target is the f32/f64 QR route; see the policy note above)
+    ill = (w[..., 0] * cond_limit < wmax) if cond_tier else False
     suspect = month_valid & (rank_deficient | ill | (n <= q_s[:, None]))
 
     # R² as in ops.ols.solve_from_stats — computed in the shifted basis,
@@ -314,12 +336,14 @@ def solve_spec_stats(stats, sel_aug: jnp.ndarray, guard: bool = False):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk", "guard"),
+    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk", "guard",
+                     "gram_route", "precision"),
 )
 def _spec_grid_program(
     y, x, universes, uidx, col_sel, window, row_weights=None, *,
     nw_lags: int, min_months: int, weights: Tuple[str, ...],
     firm_chunk: Optional[int], guard: bool = False,
+    gram_route: str = "xla", precision: str = "highest",
 ):
     """Contraction + padded solve + FM aggregation for the whole grid —
     ONE compiled program, no stacked designs, no per-cell dispatch.
@@ -336,29 +360,36 @@ def _spec_grid_program(
     record_trace("specgrid_program")  # compile-event hook (registry + span)
     stats = contract_spec_grams(y, x, universes, uidx, col_sel, window,
                                 firm_chunk=firm_chunk,
-                                row_weights=row_weights)
+                                row_weights=row_weights,
+                                route=gram_route, precision=precision)
     return _solve_and_aggregate(
         stats, col_sel, y.dtype,
         nw_lags=nw_lags, min_months=min_months, weights=weights, guard=guard,
+        precision=precision,
     )
 
 
 def _solve_and_aggregate(
     stats, col_sel, out_dtype, *,
     nw_lags: int, min_months: int, weights: Tuple[str, ...], guard: bool,
+    precision: str = "highest",
 ):
     """Padded Gram solve + per-weight FM aggregation — the program tail the
     fused single-device program and the spec-sharded mesh path share
     (``specgrid.sharded`` jits this alone over spec-sharded stats)."""
+    contracted_eps = (float(jnp.finfo(jnp.bfloat16).eps)
+                      if precision == "bf16" else None)
     s_specs = col_sel.shape[0]
     sel_aug = jnp.concatenate(
         [jnp.ones((s_specs, 1), bool), col_sel], axis=1
     )
     counters = None
     if guard:
-        sol, counters = solve_spec_stats(stats, sel_aug, guard=True)
+        sol, counters = solve_spec_stats(stats, sel_aug, guard=True,
+                                         contracted_eps=contracted_eps)
     else:
-        sol = solve_spec_stats(stats, sel_aug)
+        sol = solve_spec_stats(stats, sel_aug,
+                               contracted_eps=contracted_eps)
     # unselected predictor columns carry NaN: the FM summary's per-column
     # dropna then reports NaN coef/tstat there, and consumers slicing a
     # spec's own columns never see them
@@ -392,6 +423,8 @@ def run_spec_grid(
     firm_chunk: Optional[int] = None,
     mesh=None,
     row_weights=None,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
 ) -> SpecGridResult:
     """Solve a whole spec grid from raw panel tensors.
 
@@ -409,7 +442,7 @@ def run_spec_grid(
     return run_spec_grid_weights(
         y, x, universe_masks, grid, (grid.weight,),
         referee=referee, firm_chunk=firm_chunk, mesh=mesh,
-        row_weights=row_weights,
+        row_weights=row_weights, gram_route=gram_route, precision=precision,
     )[grid.weight]
 
 
@@ -423,6 +456,8 @@ def run_spec_grid_weights(
     firm_chunk: Optional[int] = None,
     mesh=None,
     row_weights=None,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
 ) -> Dict[str, SpecGridResult]:
     """``run_spec_grid`` for several NW weight schemes at once: the panel
     contraction and Gram solve run ONCE inside one program; each scheme
@@ -435,7 +470,25 @@ def run_spec_grid_weights(
     stats — the property the PR-3 tests pin) followed by a spec-sharded
     solve, with every placement drawn from the declarative rule tables in
     ``parallel.partition`` rather than hand-threaded specs.
+
+    ``gram_route`` / ``precision`` select the contraction kernel and the
+    bf16 mixed-precision route (``specgrid.grams`` docstring; ``None``
+    resolves the ``FMRP_GRAM_ROUTE`` / ``FMRP_GRAM_PRECISION`` knobs).
+    The mesh path always contracts via the XLA route — GSPMD cannot
+    partition the pallas custom call — and rejects bf16 (the sharded
+    psum-merge of bf16-floored stats has no referee precedent yet).
+    Under bf16 the conditioning referee tier is ON at bf16's eps: specs
+    containing a month the bf16 Gram cannot defend are re-solved by the
+    full-precision QR referee (promotion back to f32/f64), and
+    ``suspect_months`` discloses the per-spec flagged-month count.
     """
+    gram_route = resolve_gram_route(gram_route)
+    precision = resolve_gram_precision(precision)
+    if mesh is not None and precision == "bf16":
+        raise ValueError(
+            "precision='bf16' is a single-device route; the mesh path's "
+            "psum merge of bf16-floored stats is not refereed yet"
+        )
     names = list(universe_masks)
     y = jnp.asarray(y)
     x = jnp.asarray(x)
@@ -457,6 +510,13 @@ def run_spec_grid_weights(
         nw_lags=grid.nw_lags, min_months=grid.min_months,
         weights=tuple(weights), firm_chunk=firm_chunk, guard=guard,
     )
+    if mesh is None:
+        # the sharded path's lru-cached programs predate the knobs and
+        # always contract via the XLA route at full precision; only the
+        # single-device program carries them (keeps the sharded cache keys
+        # and jaxprs untouched)
+        static_kwargs["gram_route"] = gram_route
+        static_kwargs["precision"] = precision
     if mesh is not None:
         from fm_returnprediction_tpu.specgrid.sharded import (
             sharded_grid_parts,

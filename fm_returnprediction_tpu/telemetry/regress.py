@@ -9,12 +9,17 @@ gateable report:
 
 - every numeric leaf of each round's ``{metric, value, extra}`` payload
   becomes a **series** (nested dicts flatten to dotted keys:
-  ``real_pipeline_stage_s.table_2``);
+  ``real_pipeline_stage_s.table_2``), qualified by the section's
+  ``*_shape`` disclosure and the round's ``device``
+  (``kernel_fm_boot_warm_s@T720_N6000_B10000@cpu``) — a resized section
+  or a different platform is a DIFFERENT series, never gated against the
+  old one (``_series_key``);
 - series are classified by direction from their naming convention
   (``*_s``/``*_ms``/``*_mb``/``*_pct`` lower-is-better; ``*_qps``/
   ``*speedup*``/``*_per_s`` throughputs (rows_per_s, cells_per_s)/
-  ``vs_baseline`` higher-is-better — the throughput check precedes the
-  ``*_s`` seconds check; anything else is reported but never gated);
+  ``*_utilization`` roofline gauges/``vs_baseline`` higher-is-better —
+  the throughput check precedes the ``*_s`` seconds check; anything else
+  is reported but never gated);
 - per series, the **noise band** is fitted from the history itself: the
   robust scale of the *worsening* consecutive steps (improvements are
   the expected trajectory, not noise), floored at ``floor_rel`` (25%).
@@ -70,16 +75,24 @@ class BenchRound:
     metric: str
     value: float
     values: Dict[str, float]  # flattened numeric leaves incl. the headline
+    shapes: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # ``*_shape`` string leaves ("kernel_shape": "T720_N6000_B10000"):
+    # the section-size disclosures every bench section publishes
+    device: Optional[str] = None  # the round's ``extra.device`` platform
 
 
-def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
+def _flatten(prefix: str, obj, out: Dict[str, float],
+             shapes: Optional[Dict[str, str]] = None) -> None:
     if isinstance(obj, dict):
         for k, v in obj.items():
-            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out, shapes)
     elif isinstance(obj, bool):
         return  # bools are flags, not measurements
     elif isinstance(obj, (int, float)) and math.isfinite(obj):
         out[prefix] = float(obj)
+    elif (shapes is not None and isinstance(obj, str)
+          and prefix.endswith("_shape")):
+        shapes[prefix] = obj
 
 
 def load_round(path) -> Optional[BenchRound]:
@@ -100,17 +113,21 @@ def load_round(path) -> Optional[BenchRound]:
         m = _ROUND_RE.search(path.stem)
         n = int(m.group(1)) if m else 10**9
     values: Dict[str, float] = {}
-    _flatten("", payload.get("extra") or {}, values)
+    shapes: Dict[str, str] = {}
+    _flatten("", payload.get("extra") or {}, values, shapes)
     value = payload.get("value")
     metric = str(payload["metric"])
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         values[metric] = float(value)
+    device = (payload.get("extra") or {}).get("device")
     return BenchRound(
         label=path.stem,
         order=(int(n), path.name),
         metric=metric,
         value=float(value) if isinstance(value, (int, float)) else float("nan"),
         values=values,
+        shapes=shapes,
+        device=str(device) if isinstance(device, str) else None,
     )
 
 
@@ -124,11 +141,13 @@ def load_rounds(paths: Sequence) -> List[BenchRound]:
 
 def direction(key: str) -> Optional[str]:
     """"lower" / "higher" is-better, or None for untracked series."""
+    key = key.split("@", 1)[0]  # drop the shape qualifier (_series_key)
     leaf = key.rsplit(".", 1)[-1]
     if (
         leaf.endswith("_qps")
         or "speedup" in leaf
         or leaf.endswith("_per_s")  # rows_per_s, cells_per_s, ... throughput
+        or leaf.endswith("_utilization")  # roofline gauges (kernels ladder)
         or leaf == "vs_baseline"
     ):
         return "higher"
@@ -148,12 +167,45 @@ def direction(key: str) -> Optional[str]:
     return None
 
 
+def _series_key(key: str, shapes: Dict[str, str],
+                device: Optional[str]) -> str:
+    """Qualify a metric by its section's ``*_shape`` disclosure and the
+    round's device platform.
+
+    A series is only a series when it measures the same thing: a section
+    that resizes (env overrides, new defaults) or a round on different
+    hardware produces numbers that are NOT comparable with the history —
+    r02/r04_self measured the FM kernel on TPU at T720_N6000_B10000,
+    r03-r05 on CPU at T240_N2000_B500, and gating a CPU round against the
+    TPU best manufactures a "regression" out of a platform change (the
+    compile-key exclusion already acknowledges exactly this
+    machine-dependence). Every bench section discloses its size as
+    ``<section>_shape`` and every round its ``device``; the series key
+    appends both (``kernel_fm_boot_warm_s@T720_N6000_B10000@cpu``), so
+    same-shape/same-device history gates and everything else separates.
+    Metrics without a shape sibling and rounds predating the disclosures
+    keep the bare pieces."""
+    best = ""
+    for sk in shapes:
+        prefix = sk[: -len("shape")]
+        if key.startswith(prefix) and len(prefix) > len(best):
+            best = sk
+    if best:
+        key = f"{key}@{shapes[best]}"
+    if device:
+        key = f"{key}@{device}"
+    return key
+
+
 def build_series(rounds: Sequence[BenchRound]) -> Dict[str, List[Tuple[str, float]]]:
-    """series key → [(round label, value)] in round order."""
+    """series key → [(round label, value)] in round order. Keys are
+    shape/device-qualified via :func:`_series_key`."""
     out: Dict[str, List[Tuple[str, float]]] = {}
     for r in rounds:
         for key, v in r.values.items():
-            out.setdefault(key, []).append((r.label, v))
+            out.setdefault(_series_key(key, r.shapes, r.device), []).append(
+                (r.label, v)
+            )
     return out
 
 

@@ -1,0 +1,104 @@
+"""The cold-ingest overlap queue (``data.columnar._prefetched``): order
+preservation, exact parity with the serial loop, exception propagation,
+and clean shutdown when the consumer bails early."""
+
+import time
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.data.columnar import (
+    _prefetched,
+    read_filtered_columns,
+    resolve_prefetch_depth,
+)
+
+pytestmark = pytest.mark.kernels
+
+
+def test_depth_resolution(monkeypatch):
+    monkeypatch.delenv("FMRP_INGEST_PREFETCH", raising=False)
+    assert resolve_prefetch_depth() == 2
+    monkeypatch.setenv("FMRP_INGEST_PREFETCH", "0")
+    assert resolve_prefetch_depth() == 0
+    monkeypatch.setenv("FMRP_INGEST_PREFETCH", "5")
+    assert resolve_prefetch_depth() == 5
+    monkeypatch.setenv("FMRP_INGEST_PREFETCH", "nope")
+    assert resolve_prefetch_depth() == 0      # unparseable → serial, safely
+    assert resolve_prefetch_depth(3) == 3     # arg beats env
+    assert resolve_prefetch_depth(-1) == 0
+
+
+def test_order_preserved_and_depth_zero_serial():
+    items = list(range(57))
+    assert list(_prefetched(iter(items), 3)) == items
+    assert list(_prefetched(iter(items), 0)) == items
+    assert list(_prefetched(iter([]), 2)) == []
+
+
+def test_reader_exception_propagates():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("disk gone")
+
+    out = []
+    with pytest.raises(RuntimeError, match="disk gone"):
+        for v in _prefetched(gen(), 2):
+            out.append(v)
+    assert out == [1, 2]
+
+
+def test_early_consumer_exit_stops_reader():
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    it = _prefetched(gen(), 2)
+    got = [next(it), next(it)]
+    it.close()                                 # consumer bails early
+    time.sleep(0.2)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n                  # reader actually stopped
+    assert got == [0, 1]
+    # bounded read-ahead: the reader never ran far past the queue depth
+    assert n <= 2 + 2 + 2
+
+
+def test_filtered_read_parity_serial_vs_prefetched(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    n = 10_000
+    flags = rng.choice(["10", "11", "20"], n)
+    exch = rng.choice(["N", "A", "Q"], n)
+    table = pa.table({
+        "shrcd": pa.array(flags).dictionary_encode(),
+        "exchcd": pa.array(exch).dictionary_encode(),
+        "ret": rng.standard_normal(n),
+        "permno": rng.integers(1, 500, n),
+    })
+    path = tmp_path / "strip.parquet"
+    pq.write_table(table, path)
+
+    kw = dict(
+        value_columns=["ret", "permno"],
+        flag_spec={"shrcd": ["10", "11"], "exchcd": ["N", "A", "Q"]},
+        bool_columns={"exchcd": ["N"]},
+        batch_rows=700,                        # many batches through the queue
+    )
+    serial = read_filtered_columns(path, prefetch=0, **kw)
+    overlapped = read_filtered_columns(path, prefetch=3, **kw)
+    assert serial.keys() == overlapped.keys()
+    for k in serial:
+        np.testing.assert_array_equal(serial[k], overlapped[k], err_msg=k)
+    keep = np.isin(flags, ["10", "11"])
+    np.testing.assert_allclose(
+        serial["ret"], np.asarray(table["ret"])[keep]
+    )
+    np.testing.assert_array_equal(serial["exchcd"], exch[keep] == "N")

@@ -1,0 +1,107 @@
+"""Buffer donation through the panel→specgrid chain, asserted at the
+LOWERING level: a donated buffer must actually alias an output
+(``tf.aliasing_output`` in the stablehlo), not merely be marked donated —
+an unusable donation silently keeps both generations live, which is
+exactly the failure mode this PR removes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.kernels
+
+
+def _aliased_params(lowered_text: str):
+    """Zero-based positions of parameters carrying an aliasing attribute."""
+    import re
+
+    # main signature: %argN: tensor<...> {..tf.aliasing_output = M..}
+    return [
+        int(m.group(1))
+        for m in re.finditer(
+            r"%arg(\d+): tensor<[^>]+> \{[^}]*tf\.aliasing_output",
+            lowered_text,
+        )
+    ]
+
+
+def test_rewinsorize_into_aliases_scratch():
+    from fm_returnprediction_tpu.specgrid.scenarios import _rewinsorize_into
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((6, 40, 3)),
+                    jnp.float32)
+    mask = jnp.ones((6, 40), bool)
+    scratch = jnp.zeros_like(x)
+    txt = _rewinsorize_into.lower(scratch, x, mask, 5.0, 95.0).as_text()
+    assert 0 in _aliased_params(txt), (
+        "the donated scratch must alias the re-clipped output"
+    )
+
+
+def test_scatter_winsorized_aliases_panel():
+    from fm_returnprediction_tpu.panel.characteristics import (
+        _scatter_winsorized,
+    )
+
+    values = jnp.zeros((4, 16, 5), jnp.float32)
+    win = jnp.ones((4, 16, 2), jnp.float32)
+    txt = _scatter_winsorized.lower(values, win, jnp.asarray([1, 3])).as_text()
+    assert 0 in _aliased_params(txt)
+
+
+def test_rewinsorize_into_matches_undonated_and_consumes_scratch():
+    from fm_returnprediction_tpu.specgrid.scenarios import winsor_variant
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 60, 4)), jnp.float32)
+    mask = jnp.asarray(rng.random((8, 60)) > 0.2)
+    plain = winsor_variant(x, mask, 5.0)
+    scratch = jnp.zeros_like(x) + 1.0
+    donated = winsor_variant(x, mask, 5.0, scratch=scratch)
+    # the donated variant is a separately-compiled program: values agree to
+    # FMA-level fusion drift (the documented behavior of every
+    # reorganization of a winsorize program — see `_enrich_winsorized`)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(donated),
+                               rtol=1e-6, atol=1e-7)
+    # the scratch buffer is CONSUMED: a donated array is deleted after use
+    assert scratch.is_deleted()
+    # shape/dtype-mismatched scratch falls back to the undonated program
+    bad = jnp.zeros((8, 60, 3), jnp.float32)
+    again = winsor_variant(x, mask, 5.0, scratch=bad)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(again))
+    assert not bad.is_deleted()
+
+
+def test_engine_reclip_double_buffers_across_winsor_groups():
+    """The tile engine's winsor ladder re-clips into the previous level's
+    buffer: values identical to fresh re-clips, old generation consumed."""
+    from fm_returnprediction_tpu.specgrid.engine import _Engine
+    from fm_returnprediction_tpu.specgrid.cellspace import scenario_space
+    from fm_returnprediction_tpu.specgrid.scenarios import winsor_variant
+
+    rng = np.random.default_rng(3)
+    t, n = 6, 50
+    y = rng.standard_normal((t, n)).astype(np.float32)
+    x = rng.standard_normal((t, n, 2)).astype(np.float32)
+    mask = np.ones((t, n), bool)
+    masks = {"all": mask}
+
+    class _M:
+        name = "m1"
+        predictors = ("A", "B")               # display labels, per MODELS
+
+    space = scenario_space({"A": "c0", "B": "c1"}, ["all"], t, models=[_M()],
+                           subperiods=1, winsor_levels=(1.0, 5.0, 10.0))
+    engine = _Engine(y, x, masks, space, mask=mask, route="gram", mesh=None,
+                     referee=True, firm_chunk=None, label_of=None, seed=0,
+                     coreset_m=None, coreset_budget_mb=None, tile_cells=64)
+    x5 = engine.x_at_level(5.0)
+    want10 = np.asarray(winsor_variant(engine.x_base, jnp.asarray(mask), 10.0))
+    x10 = engine.x_at_level(10.0)           # re-clips INTO x5's buffer
+    np.testing.assert_allclose(np.asarray(x10), want10, rtol=1e-6, atol=1e-7)
+    assert x5.is_deleted()                  # the old generation was donated
+    assert not engine.x_base.is_deleted()   # the base is never donated
+    # returning to the base level must not donate anything either
+    assert engine.x_at_level(1.0) is engine.x_base

@@ -1,0 +1,230 @@
+"""The MXU-tiled pallas Gram kernel vs the retained XLA oracle, and the
+bf16 mixed-precision contraction route.
+
+The pallas kernel runs in interpreter mode on the CPU test backend (the
+TPU compile path is exercised by bench.py on real hardware); the XLA chunk
+loop in ``specgrid.grams`` is the differential oracle and stays the
+default route off-TPU. Pins:
+
+- f32 parity at 1e-6 RELATIVE across thin months, all-NaN columns and
+  mask edges (absolute diffs scale with the Gram entries);
+- f64 parity at the few-ulp level (1e-13 relative — the two routes block
+  their reductions differently, so exact bitwise equality is not promised;
+  counts ARE exactly equal);
+- bf16: f32-storage outputs, EXACT integer counts, agreement between the
+  bf16-XLA and bf16-pallas contractions, and the conditioning referee's
+  per-month promotion (suspect months) disclosed and re-solved by the
+  full-precision QR route through ``run_spec_grid``;
+- route/precision knob resolution and the byte-identical default jaxpr.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.specgrid.grams import (
+    contract_spec_grams,
+    resolve_gram_precision,
+    resolve_gram_route,
+)
+
+pytestmark = pytest.mark.kernels
+
+
+def _panel(seed=0, t=13, n=301, p=5, s=4, u=2, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(dtype)
+    x[rng.random(x.shape) < 0.1] = np.nan
+    x[:, 7, 2] = np.nan                       # an all-NaN firm column
+    y = rng.standard_normal((t, n)).astype(dtype)
+    y[rng.random(y.shape) < 0.15] = np.nan
+    y[:, 11] = np.nan                         # a y-less firm
+    universes = rng.random((u, t, n)) > 0.3
+    universes[0, 3] = False                   # a month with an empty universe
+    uidx = np.arange(s) % u
+    col_sel = rng.random((s, p)) > 0.4
+    col_sel[0] = [True] + [False] * (p - 1)   # univariate spec
+    col_sel[-1] = True                        # full union spec
+    window = np.ones((s, t), bool)
+    window[s - 1, : min(6, t - 1)] = False    # subperiod window edge
+    window[1, 0] = False
+    return tuple(
+        jnp.asarray(a) for a in (y, x, universes, uidx, col_sel, window)
+    )
+
+
+def _stats_close(a, b, rtol, counts_exact=True):
+    for name in ("gram", "moment", "n", "ysum", "yy", "center"):
+        av, bv = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        scale = max(np.max(np.abs(av)), 1.0)
+        if counts_exact and name == "n":
+            np.testing.assert_array_equal(av, bv, err_msg=name)
+        else:
+            np.testing.assert_allclose(bv, av, rtol=0, atol=rtol * scale,
+                                       err_msg=name)
+
+
+def test_pallas_matches_xla_f32():
+    args = _panel()
+    ref = contract_spec_grams(*args)
+    pal = contract_spec_grams(*args, route="pallas", block_n=128,
+                              interpret=True)
+    _stats_close(ref, pal, rtol=1e-6)
+
+
+def test_pallas_matches_xla_thin_month_and_ragged_blocks():
+    # n NOT a multiple of any lane block → the NaN/zero pad path; a thin
+    # month (nearly-empty universe) exercises the n < Q regime the solve's
+    # structural referee gates on
+    args = list(_panel(seed=3, t=7, n=137, p=4, s=3))
+    uni = np.asarray(args[2]).copy()
+    uni[:, 5, 4:] = False                    # month 5: at most 4 valid rows
+    args[2] = jnp.asarray(uni)
+    ref = contract_spec_grams(*args)
+    pal = contract_spec_grams(*args, route="pallas", block_n=128,
+                              interpret=True)
+    _stats_close(ref, pal, rtol=1e-6)
+
+
+def test_pallas_matches_xla_row_weights():
+    args = _panel(seed=5)
+    rng = np.random.default_rng(11)
+    rw = jnp.asarray((rng.random((13, 301)) * 2).astype(np.float32))
+    ref = contract_spec_grams(*args, row_weights=rw)
+    pal = contract_spec_grams(*args, row_weights=rw, route="pallas",
+                              block_n=128, interpret=True)
+    _stats_close(ref, pal, rtol=1e-6, counts_exact=False)
+    # Σw counts still agree to f32 rounding
+    np.testing.assert_allclose(np.asarray(pal.n), np.asarray(ref.n),
+                               rtol=1e-6)
+
+
+def test_pallas_matches_xla_f64_ulp_level():
+    if not jax.config.jax_enable_x64:
+        pytest.skip("x64 parity configuration not enabled")
+    args = _panel(dtype=np.float64)
+    # matched blocking (firm_chunk == block_n) — the residual diffs are
+    # reduction-order ulps inside XLA's differently-blocked dots
+    ref = contract_spec_grams(*args, firm_chunk=128)
+    pal = contract_spec_grams(*args, route="pallas", block_n=128,
+                              interpret=True)
+    _stats_close(ref, pal, rtol=1e-13)
+
+
+def test_bf16_routes_agree_and_counts_exact():
+    args = _panel(seed=7)
+    ref = contract_spec_grams(*args)
+    b_xla = contract_spec_grams(*args, precision="bf16")
+    b_pal = contract_spec_grams(*args, precision="bf16", route="pallas",
+                                block_n=128, interpret=True)
+    # bf16 stats are stored f32 and counts are EXACT (f32 accumulation of
+    # bf16-exact 0/1 products)
+    assert np.asarray(b_xla.gram).dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(b_xla.n), np.asarray(ref.n))
+    np.testing.assert_array_equal(np.asarray(b_pal.n), np.asarray(ref.n))
+    _stats_close(b_xla, b_pal, rtol=1e-6)
+    # and the bf16 grams sit at bf16 distance from the exact route — close
+    # but not equal (the route really runs at reduced precision)
+    d = np.max(np.abs(np.asarray(b_xla.gram) - np.asarray(ref.gram)))
+    scale = np.max(np.abs(np.asarray(ref.gram)))
+    assert 1e-7 < d / scale < 3e-2
+
+
+def test_bf16_promotion_discloses_and_referees():
+    """An ill-conditioned spec under bf16 is flagged per month and promoted
+    (re-solved) by the full-precision QR referee."""
+    from fm_returnprediction_tpu.specgrid.solve import run_spec_grid
+    from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+    rng = np.random.default_rng(2)
+    t, n = 6, 160
+    base = rng.standard_normal((t, n)).astype(np.float32)
+    x = np.stack([base, base + 1e-3 * rng.standard_normal((t, n)).astype(np.float32)],
+                 axis=-1)                       # nearly collinear pair
+    y = (base + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    masks = {"all": np.ones((t, n), bool)}
+    grid = SpecGrid((Spec("m", ("c0", "c1"), "all"),), union=("c0", "c1"))
+
+    exact = run_spec_grid(y, x, masks, grid, precision="highest",
+                          gram_route="xla")
+    low = run_spec_grid(y, x, masks, grid, precision="bf16",
+                        gram_route="xla")
+    # the collinear pair's equilibrated condition blows past 1/√eps(bf16):
+    # every month is flagged, disclosed, and the spec re-solved by the QR
+    # referee — landing on the incumbent full-precision answer
+    assert int(low.suspect_months[0]) == t
+    assert low.referee_specs == (0,)
+    np.testing.assert_allclose(low.coef[0], exact.coef[0], rtol=5e-3)
+    # a well-conditioned panel promotes nothing
+    ok = run_spec_grid(y, np.stack([base, rng.standard_normal((t, n)).astype(np.float32)], -1),
+                       masks, grid, precision="bf16", gram_route="xla")
+    assert int(ok.suspect_months[0]) == 0
+    assert ok.referee_specs == ()
+
+
+def test_bf16_rejected_on_mesh():
+    from fm_returnprediction_tpu.specgrid.solve import run_spec_grid
+    from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+    grid = SpecGrid((Spec("m", ("c0",), "all"),), union=("c0",))
+    with pytest.raises(ValueError, match="bf16"):
+        run_spec_grid(np.zeros((3, 8), np.float32),
+                      np.zeros((3, 8, 1), np.float32),
+                      {"all": np.ones((3, 8), bool)}, grid,
+                      precision="bf16", mesh=object())
+
+
+def test_route_and_precision_resolution(monkeypatch):
+    monkeypatch.delenv("FMRP_GRAM_ROUTE", raising=False)
+    monkeypatch.delenv("FMRP_GRAM_PRECISION", raising=False)
+    platform = jax.devices()[0].platform
+    assert resolve_gram_route() == ("pallas" if platform == "tpu" else "xla")
+    monkeypatch.setenv("FMRP_GRAM_ROUTE", "pallas")
+    assert resolve_gram_route() == "pallas"
+    monkeypatch.setenv("FMRP_GRAM_ROUTE", "xla")
+    assert resolve_gram_route() == "xla"
+    assert resolve_gram_route("pallas") == "pallas"  # arg beats env
+    with pytest.raises(ValueError):
+        resolve_gram_route("mxu")
+    assert resolve_gram_precision() == "highest"
+    monkeypatch.setenv("FMRP_GRAM_PRECISION", "bf16")
+    assert resolve_gram_precision() == "bf16"
+    with pytest.raises(ValueError):
+        resolve_gram_precision("fp8")
+
+
+def test_default_jaxpr_byte_identical():
+    """The knobs at their defaults trace the exact historical program: an
+    explicit route='xla', precision='highest' call and a no-kwarg call
+    produce byte-identical jaxprs (no stray casts, no
+    preferred_element_type markers)."""
+    args = _panel(t=5, n=64, p=3, s=2)
+    legacy = str(jax.make_jaxpr(
+        lambda *a: contract_spec_grams(*a)
+    )(*args))
+    explicit = str(jax.make_jaxpr(
+        lambda *a: contract_spec_grams(*a, route="xla", precision="highest")
+    )(*args))
+    assert legacy == explicit
+    assert "bf16" not in legacy and "bfloat16" not in legacy
+
+
+def test_grid_program_jaxpr_stable_across_knob_spelling():
+    """The fused grid program's jaxpr is identical whether the knobs come
+    from the environment or explicit arguments (telemetry/guard off)."""
+    from fm_returnprediction_tpu.specgrid.solve import _spec_grid_program
+
+    y, x, universes, uidx, col_sel, window = _panel(t=5, n=64, p=3, s=2)
+    kw = dict(nw_lags=2, min_months=2, weights=("reference",),
+              firm_chunk=None, guard=False)
+    a = str(jax.make_jaxpr(
+        lambda *ar: _spec_grid_program(*ar, **kw)
+    )(y, x, universes, uidx, col_sel, window))
+    b = str(jax.make_jaxpr(
+        lambda *ar: _spec_grid_program(
+            *ar, **kw, gram_route="xla", precision="highest")
+    )(y, x, universes, uidx, col_sel, window))
+    assert a == b

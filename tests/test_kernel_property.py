@@ -10,6 +10,9 @@ degenerate months (n ≤ P) come from hypothesis rather than fixed seeds.
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # tier-1 must COLLECT cleanly without the optional dep
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

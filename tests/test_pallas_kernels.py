@@ -11,9 +11,18 @@ import pytest
 
 from fm_returnprediction_tpu.ops.pallas_kernels import (
     masked_cumulative_moments,
+    rolling_mean_fused,
     rolling_std_fused,
+    rolling_sum_fused,
 )
-from fm_returnprediction_tpu.ops.rolling import rolling_std
+from fm_returnprediction_tpu.ops.rolling import (
+    resolve_rolling_route,
+    rolling_mean,
+    rolling_std,
+    rolling_sum,
+)
+
+pytestmark = pytest.mark.kernels
 
 
 @pytest.fixture(scope="module")
@@ -100,9 +109,87 @@ def test_rolling_std_dispatch_override(noisy_panel, monkeypatch):
 def test_pallas_flag_disable_spellings(monkeypatch):
     from fm_returnprediction_tpu.ops.rolling import _pallas_default
 
+    monkeypatch.delenv("FMRP_ROLLING_ROUTE", raising=False)
     for off in ("0", "off", "no", "FALSE", ""):
         monkeypatch.setenv("FMRP_PALLAS", off)
         assert not _pallas_default(), off
     for on in ("1", "true", "YES", "on"):
         monkeypatch.setenv("FMRP_PALLAS", on)
         assert _pallas_default(), on
+
+
+# -- the fused sum/mean siblings (PR 11) ------------------------------------
+
+
+def test_rolling_sum_mean_fused_match_xla_and_pandas(noisy_panel):
+    """The fused kernels vs the XLA cumsum route (same algorithm, so tight)
+    and the pandas oracle across min_periods regimes, incl. mask edges."""
+    x = noisy_panel
+    xj = jnp.asarray(x)
+    for window, mp in ((24, 1), (24, 12), (12, 12)):
+        fused = np.asarray(rolling_sum_fused(
+            xj, window, mp, block_t=128, block_n=128, interpret=True))
+        xla = np.asarray(rolling_sum(xj, window, mp, use_pallas=False))
+        np.testing.assert_allclose(fused, xla, rtol=1e-5, atol=5e-7,
+                                   equal_nan=True)
+        want = pd.DataFrame(x).rolling(window, min_periods=mp).sum().to_numpy()
+        np.testing.assert_allclose(fused, want, rtol=1e-4, atol=5e-7,
+                                   equal_nan=True)
+
+        fusedm = np.asarray(rolling_mean_fused(
+            xj, window, mp, block_t=128, block_n=128, interpret=True))
+        xlam = np.asarray(rolling_mean(xj, window, mp, use_pallas=False))
+        np.testing.assert_allclose(fusedm, xlam, rtol=1e-5, atol=5e-7,
+                                   equal_nan=True)
+        wantm = pd.DataFrame(x).rolling(window, min_periods=mp).mean().to_numpy()
+        np.testing.assert_allclose(fusedm, wantm, rtol=1e-4, atol=5e-7,
+                                   equal_nan=True)
+
+
+def test_rolling_sum_fused_all_nan_column():
+    x = np.full((40, 3), np.nan)
+    x[:, 0] = 1.0
+    out = np.asarray(rolling_sum_fused(
+        jnp.asarray(x), 5, 2, block_t=8, block_n=128, interpret=True))
+    want = pd.DataFrame(x).rolling(5, min_periods=2).sum().to_numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-6, equal_nan=True)
+    assert np.isnan(out[:, 1]).all() and np.isnan(out[:, 2]).all()
+
+
+def test_rolling_route_resolution(monkeypatch):
+    import jax
+
+    monkeypatch.delenv("FMRP_PALLAS", raising=False)
+    monkeypatch.delenv("FMRP_ROLLING_ROUTE", raising=False)
+    platform = jax.devices()[0].platform
+    assert resolve_rolling_route() == (
+        "pallas" if platform == "tpu" else "xla"
+    )
+    monkeypatch.setenv("FMRP_ROLLING_ROUTE", "pallas")
+    assert resolve_rolling_route() == "pallas"
+    monkeypatch.setenv("FMRP_ROLLING_ROUTE", "xla")
+    assert resolve_rolling_route() == "xla"
+    # the route knob OUTRANKS the legacy boolean; the boolean still works
+    # when the knob is unset/auto
+    monkeypatch.setenv("FMRP_PALLAS", "1")
+    assert resolve_rolling_route() == "xla"
+    monkeypatch.setenv("FMRP_ROLLING_ROUTE", "auto")
+    assert resolve_rolling_route() == "pallas"
+    monkeypatch.setenv("FMRP_ROLLING_ROUTE", "vectorized")
+    with pytest.raises(ValueError):
+        resolve_rolling_route()
+    assert resolve_rolling_route(route="xla") == "xla"  # arg beats env
+
+
+def test_rolling_sum_mean_route_dispatch_agrees(noisy_panel, monkeypatch):
+    """FMRP_ROLLING_ROUTE=xla forces the oracle; the explicit override and
+    the default CPU resolution land on the same numbers."""
+    x = jnp.asarray(noisy_panel[:100, :10])
+    monkeypatch.setenv("FMRP_ROLLING_ROUTE", "xla")
+    a = rolling_sum(x, 12, 3)
+    monkeypatch.delenv("FMRP_ROLLING_ROUTE")
+    b = rolling_sum(x, 12, 3, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    am = rolling_mean(x, 12, 3)   # CPU default → XLA path
+    bm = rolling_mean(x, 12, 3, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(am), np.asarray(bm))

@@ -9,6 +9,9 @@ part (b)). Small example counts; hypothesis shrinks failures.
 
 import numpy as np
 import pandas as pd
+import pytest
+
+pytest.importorskip("hypothesis")  # tier-1 must COLLECT cleanly without the optional dep
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

@@ -10,6 +10,9 @@ fast; failures shrink to minimal cases.
 
 import numpy as np
 import pandas as pd
+import pytest
+
+pytest.importorskip("hypothesis")  # tier-1 must COLLECT cleanly without the optional dep
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

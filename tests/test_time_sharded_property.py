@@ -9,6 +9,9 @@ length is not a multiple of the mesh (the NaN-pad + trim path).
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # tier-1 must COLLECT cleanly without the optional dep
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp
