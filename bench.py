@@ -1469,6 +1469,217 @@ def _bench_fleet(fast: bool):
     return out
 
 
+def _bench_fleet_capacity(fast: bool):
+    """Overload-survival layer (``serving.loadgen``/``brownout``, ISSUE
+    12): the capacity curve and the bench-demonstrated overload episode.
+
+    - ``fleet_capacity_rR_bB_rows_per_s`` / ``_p99_ms`` — measured
+      replicas × max_batch capacity curve under closed-loop bursts from
+      the adversarial load harness (higher-is-better series the PR-6
+      regress sentinel gates, shape-qualified by
+      ``fleet_capacity_shape``).
+    - ``fleet_capacity_model_*`` — the predicted per-replica rows/s from
+      the PR-6 cost ledger (serving-bucket FLOPs/row) + a measured
+      full-bucket dispatch probe, and ``fleet_capacity_model_ratio`` =
+      measured / predicted at the top configuration (the validation the
+      capacity model owes; the dispatch ceiling binds on CPU).
+    - ``fleet_overload_*`` — one sustained-ramp overload episode against
+      a deliberately small-capacity fleet: the autoscaler scales out
+      (compile-free, WarmReport evidence), the brownout ladder steps to
+      disclosed degraded routes once scale-out is exhausted, p99 over the
+      episode stays bounded (degraded answers bypass the saturated
+      queues), and after the ramp the ladder recovers hysteretically to
+      full service. The journal replay verdict covers the whole episode.
+
+    FMRP_BENCH_FLEET_CAPACITY=0 skips; _FLEET_QUERIES scales the curve."""
+    if os.environ.get("FMRP_BENCH_FLEET_CAPACITY", "1") == "0":
+        return {}
+    import tempfile
+
+    from fm_returnprediction_tpu.registry import artifacts
+    from fm_returnprediction_tpu.registry.store import using_registry
+    from fm_returnprediction_tpu.serving import (
+        AdmissionPolicy,
+        AutoscalePolicy,
+        BrownoutPolicy,
+        ERService,
+        LoadGen,
+        LoadPhase,
+        ServingFleet,
+        build_serving_state,
+        capacity_model,
+        replay_journal,
+    )
+
+    t, n, p = (60, 200, 5) if fast else (240, 1000, 5)
+    per_config = int(os.environ.get(
+        "FMRP_BENCH_FLEET_QUERIES", 300 if fast else 2000
+    ))
+    rng = np.random.default_rng(2016)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(
+        y, x, mask, window=min(120, t // 2), min_periods=min(60, t // 4)
+    )
+    months = rng.integers(t // 2, t, 4096)
+    rows = x[months, rng.integers(0, n, 4096)]
+
+    out = {}
+    with tempfile.TemporaryDirectory() as root:
+        reg_dir = os.path.join(root, "registry")
+        with using_registry(reg_dir) as reg:
+            # one process compiles + publishes; every fleet below (and
+            # every autoscaler spawn inside the episode) fetches
+            for b in (32, 128):
+                ERService(state, max_batch=b, auto_flush=False).close()
+            artifacts.put_serving_state(state, "bench-capacity",
+                                        registry=reg)
+
+        # -- the capacity curve: replicas × batch → rows/s, p99 ----------
+        replica_ladder = (1, 2) if fast else (1, 2, 4)
+        model_ratio = None
+        for r in replica_ladder:
+            for b in (32, 128):
+                fleet = ServingFleet(
+                    state, r, max_batch=b, max_latency_ms=1.0,
+                    registry_dir=reg_dir,
+                )
+                try:
+                    gen = LoadGen(fleet, months, rows, seed=12)
+                    rep = gen.run([LoadPhase(
+                        f"burst_r{r}_b{b}", n_requests=per_config,
+                        workers=8,
+                    )])["phases"][0]
+                    out[f"fleet_capacity_r{r}_b{b}_rows_per_s"] = (
+                        rep["rows_per_s"]
+                    )
+                    out[f"fleet_capacity_r{r}_b{b}_p99_ms"] = rep["p99_ms"]
+                    out[f"fleet_capacity_r{r}_b{b}_shed_rate"] = (
+                        rep["shed_rate"]
+                    )
+                    if (r, b) == (replica_ladder[-1], 128):
+                        model = capacity_model(fleet)
+                        out["fleet_capacity_model"] = model
+                        if rep["rows_per_s"] and model[
+                                "predicted_rows_per_s"]:
+                            model_ratio = round(
+                                rep["rows_per_s"]
+                                / model["predicted_rows_per_s"], 4
+                            )
+                    fleet.drain(timeout=30)
+                finally:
+                    fleet.close()
+        out["fleet_capacity_model_ratio"] = model_ratio
+
+        # -- the overload episode: ramp → scale-out → brownout → recover -
+        # A modern CPU answers these tiny projections too fast to
+        # saturate honestly, so the ADVERSARIAL part is injected: the
+        # ``serving.dispatch`` chaos site stalls every device dispatch
+        # 10 ms (a slow/tunneled backend), pinning per-replica capacity
+        # near max_batch/stall ≈ 800 rows/s on ANY box — which the ramp
+        # then deliberately overruns. Disclosed as
+        # ``fleet_overload_stall_ms``; the brownout's host-side degraded
+        # routes bypass the stalled dispatch, which is exactly the
+        # mechanism under demonstration.
+        from fm_returnprediction_tpu.resilience.faults import (
+            FaultPlan,
+            FaultSpec,
+        )
+
+        stall_s = 0.010
+        journal = os.path.join(root, "overload.jsonl")
+        fleet = ServingFleet(
+            state, 1, max_batch=8, max_latency_ms=5.0, max_queue=32,
+            registry_dir=reg_dir, journal=journal,
+            admission=AdmissionPolicy(max_occupancy=1.01),
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=2, cooldown_s=0.15,
+                out_occupancy=0.4, in_occupancy=0.05, in_ticks=4,
+            ),
+            brownout=BrownoutPolicy(
+                ladder=("full", "coreset", "shed"),
+                enter_burn=1e9, exit_burn=1.0,
+                enter_occupancy=0.5, exit_occupancy=0.1,
+                dwell_ticks=1, recover_ticks=2,
+            ),
+        )
+        try:
+            gen = LoadGen(fleet, months, rows, seed=13, tick_s=0.05)
+            with FaultPlan({
+                "serving.dispatch": FaultSpec(times=-1, delay_s=stall_s),
+            }):
+                # 64 submitting workers: a blocking worker caps its own
+                # in-flight at 1, so concurrency IS the queue-depth
+                # adversary (8 workers can never fill a 64-slot queue)
+                report = gen.run([
+                    LoadPhase("ramp", n_requests=per_config, workers=64,
+                              rate_per_s=400.0, ramp=True),
+                    LoadPhase("sustain", n_requests=3 * per_config,
+                              workers=96, rate_per_s=2500.0),
+                ])
+            out["fleet_overload_stall_ms"] = stall_s * 1e3
+            stats = fleet.stats()
+            out["fleet_overload_scale_outs"] = stats["scale_out_total"]
+            out["fleet_overload_degraded"] = stats["degraded_total"]
+            out["fleet_overload_shed"] = stats["shed_total"]
+            sustain = report["phases"][1]
+            out["fleet_overload_p99_ms_sustain"] = sustain["p99_ms"]
+            out["fleet_overload_p99_ms_degraded_sustain"] = (
+                sustain["p99_ms_degraded"]
+            )
+            out["fleet_overload_degraded_frac_sustain"] = (
+                sustain["degraded_frac"]
+            )
+            out["fleet_overload_shed_rate_sustain"] = sustain["shed_rate"]
+            # scale-out evidence: every autoscaler spawn started through
+            # the warm pool with zero fresh compiles
+            scaled = [
+                rid for rid in fleet.warm_reports
+                if rid not in ("r0",)
+            ]
+            out["fleet_overload_scale_out_zero_compile"] = all(
+                fleet.warm_reports[rid].zero_compile for rid in scaled
+            ) if scaled else None
+            # hysteretic recovery: drain, then tick until the ladder is
+            # back at full service (bounded wait, disclosed on timeout)
+            fleet.drain(timeout=30)
+            recovered = False
+            for _ in range(80):
+                fleet.supervisor.tick()
+                if fleet.brownout is not None and not fleet.brownout.active:
+                    recovered = True
+                    break
+                time.sleep(0.02)
+            out["fleet_overload_recovered"] = recovered
+            out["fleet_overload_final_rung"] = (
+                fleet.stats()["brownout_rung"]
+            )
+        finally:
+            fleet.close()
+        replay = replay_journal(journal)
+        out["fleet_overload_journal"] = {
+            "admitted": replay.n_admitted,
+            "done": replay.n_done,
+            "shed": replay.n_shed,
+            "dropped": len(replay.dropped),
+            "duplicated": len(replay.duplicated),
+            "clean": bool(replay.clean),
+            "brownout_marks": sum(
+                1 for m in replay.marks if m.get("label") == "brownout"
+            ),
+            "scale_marks": sum(
+                1 for m in replay.marks
+                if m.get("label") in ("scale_out", "scale_in", "retire")
+            ),
+        }
+    out["fleet_capacity_shape"] = f"T{t}_P{p}_Q{per_config}"
+    out["fleet_overload_shape"] = f"T{t}_P{p}_Q{per_config}x2_R1to2_B8"
+    return out
+
+
 def _bench_resilience(fast: bool):
     """The fault-tolerance layer's numbers (``resilience`` subsystem):
 
@@ -2319,6 +2530,7 @@ def main() -> None:
     if os.environ.get("FMRP_BENCH_SERVING", "1") == "1":
         sections.append(_bench_serving)
     sections.append(_bench_fleet)  # _FLEET=0 handled in-section
+    sections.append(_bench_fleet_capacity)  # _FLEET_CAPACITY=0 in-section
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section

@@ -93,6 +93,31 @@ def task_perf_regress():
     }
 
 
+def task_robustness_smoke():
+    """The robustness suite as one named target: every ``fleet`` and
+    ``chaos`` marked test (supervision, autoscale, brownout, crash
+    recovery, fault-injection) in one fast pytest invocation — the
+    pre-merge smoke for anything touching the overload-survival layer.
+    Pairs with ``perf_regress`` (below), which gates the bench series
+    the same layer produces (``fleet_capacity_*`` / ``fleet_overload_*``
+    included since BENCH_r07)."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m 'fleet or chaos' -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "fleet+chaos marker smoke suite (overload survival, "
+               "failover, fault injection) — exit-1 on any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }
+
+
 if __name__ == "__main__":
     try:
         from doit.doit_cmd import DoitMain

@@ -19,10 +19,18 @@ differentially pinned bit-identical to the in-process warm-up path.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import List, Optional, Tuple
 
 __all__ = ["WarmReport", "warm_from_registry"]
+
+# serializes the evidence window: two warms racing in one process used to
+# cross-attribute each other's ledger records and trace growth (disclosed
+# as a caveat since PR 9). The overload-survival layer multiplied the
+# spawn sites — autoscaler scale-out, failover, crash recovery — so the
+# window is now locked: warm-ups queue, reports stay per-service honest.
+_WARM_LOCK = threading.Lock()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,18 +116,20 @@ def warm_from_registry(
 
         cls = service_cls if service_cls is not None else ERService
         ledger = cost_ledger()
-        seq0 = ledger.last_seq
-        traces0 = _trace_total()
-        t0 = time.perf_counter()
-        service = cls(state, warm=True, **service_kwargs)
-        wall = time.perf_counter() - t0
-        # evidence is scoped to the serving program: other subsystems
-        # compiling concurrently must not falsify this service's report
-        # (two warm_from_registry calls racing in one process still
-        # cross-attribute — warm one replica's service at a time)
-        window: List = [
-            r for r in ledger.since(seq0) if r.program == _PROGRAM
-        ]
+        # evidence is scoped to the serving program (other subsystems
+        # compiling concurrently must not falsify this service's report)
+        # and the window is serialized by _WARM_LOCK (two racing warms
+        # would otherwise attribute each other's bucket fetches)
+        with _WARM_LOCK:
+            seq0 = ledger.last_seq
+            traces0 = _trace_total()
+            t0 = time.perf_counter()
+            service = cls(state, warm=True, **service_kwargs)
+            wall = time.perf_counter() - t0
+            window: List = [
+                r for r in ledger.since(seq0) if r.program == _PROGRAM
+            ]
+            trace_growth = _trace_total() - traces0
         report = WarmReport(
             wall_s=wall,
             deserialized=sum(
@@ -128,7 +138,7 @@ def warm_from_registry(
             fresh_compiles=sum(
                 1 for r in window if r.provenance != "deserialized"
             ),
-            trace_growth=_trace_total() - traces0,
+            trace_growth=trace_growth,
             programs=tuple(f"{r.program}@{r.provenance}" for r in window),
             saved_s=sum(
                 r.saved_s for r in window

@@ -24,6 +24,7 @@ __all__ = [
     "DriftDetectedError",
     "ServiceOverloadError",
     "ReplicaDeadError",
+    "RecoveredInFlightError",
     "StateRolloverError",
     "InjectedFault",
 ]
@@ -115,6 +116,15 @@ class ReplicaDeadError(ResilienceError):
     probe) with this request still queued on it. The fleet front tier
     catches this and REQUEUES the request on a healthy replica; it only
     reaches a caller when every requeue attempt is exhausted."""
+
+
+class RecoveredInFlightError(ResilienceError):
+    """A request was admitted but still in flight when the fleet process
+    died; crash-restart recovery (``serving.recovery``) closed it out
+    with this outcome in the journal. RETRIABLE by contract: quoting is
+    read-only and the original future died with the process, so a
+    resubmit can never double-serve — the same stance as
+    :class:`ServiceOverloadError`, one failure mode harder."""
 
 
 class StateRolloverError(ResilienceError):

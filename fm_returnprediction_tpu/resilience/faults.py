@@ -50,7 +50,9 @@ __all__ = [
     "fleet_kill_routed",
     "fleet_stall_replica",
     "fleet_trigger_staged_rollover",
+    "fleet_hard_crash",
     "poison_serving_state_nan",
+    "tear_journal_tail",
 ]
 
 # The installed plan. Plain module global on purpose: the inactive-path
@@ -312,6 +314,15 @@ def corrupt_panel_scale_spike(panel, column: int = -1, scale: float = 1e20):
 #   fleet.poison_state    — visited per replica during rollover PREPARE;
 #                           ``poison_serving_state_nan`` corrupts the
 #                           candidate so validation must abort with 0 flips
+#   fleet.hard_crash      — visited inside the admitted-submit path;
+#                           ``fleet_hard_crash`` abandons the whole fleet
+#                           as a process death would (no drain, no journal
+#                           terminals) — the crash-restart recovery path
+#   fleet.journal_torn_tail — visited with the journal PATH as the file
+#                           handle drops during a hard crash;
+#                           ``tear_journal_tail`` (corrupt=) cuts the
+#                           final line mid-write, the torn-WAL shape
+#                           recovery must repair
 
 
 def fleet_kill_routed(rid: Optional[str] = None):
@@ -348,6 +359,31 @@ def fleet_trigger_staged_rollover(payload):
     lands deterministically between two known requests."""
     payload.trigger_staged_rollover()
     return payload
+
+
+def fleet_hard_crash(payload):
+    """Mutator for ``fleet.hard_crash``: abandon the fleet mid-load the
+    way a process death would (payload is the fleet) — no drain, no
+    journal terminals; the spec's skip/times counters pick exactly which
+    admitted request the crash lands between. ``ServingFleet.recover``
+    is the path under test."""
+    payload.hard_crash()
+    return payload
+
+
+def tear_journal_tail(path: Union[str, Path]) -> None:
+    """Corruptor for ``fleet.journal_torn_tail``: cut the journal's FINAL
+    line in half — the torn-write shape a crash mid-``append`` leaves in
+    a WAL (contrast :func:`truncate_file`, which halves the whole file).
+    Recovery must truncate exactly this line and nothing else."""
+    path = Path(path)
+    data = path.read_bytes().rstrip(b"\n")
+    if not data:
+        return
+    nl = data.rfind(b"\n")
+    last = data[nl + 1:]
+    keep = data[: nl + 1] + last[: max(len(last) // 2, 1)]
+    path.write_bytes(keep)
 
 
 def poison_serving_state_nan(state):

@@ -20,9 +20,26 @@ supervision with drain-and-replace failover through the registry warm
 pool, two-phase zero-downtime state rollover, and a write-ahead request
 journal whose deterministic replay proves zero dropped / zero duplicated
 in-flight requests across swaps and replica deaths.
+
+The OVERLOAD-SURVIVAL layer rides the same tier: an SLO-burn autoscaler
+leg on the supervisor (`supervisor.AutoscalePolicy` — warm-pool scale-out
+under pressure, drain-and-retire scale-in on sustained relief), a
+brownout degradation ladder (`brownout` — disclosed cheaper routes
+full → coreset-m → shed with hysteretic recovery, every degraded response
+a ``DegradedQuote`` carrying its route/precision), crash-restart recovery
+(`recovery` + ``ServingFleet.recover`` — torn-tail journal repair,
+in-flight requests closed out to typed retriable outcomes, the fleet
+rebuilt compile-free from the registry), and the adversarial load harness
+(`loadgen` — bursts, ramps, hot-key skew, poison payloads, the
+retry-after-consuming client helper, and the cost-ledger capacity model).
 """
 
 from fm_returnprediction_tpu.serving.batcher import MicroBatcher, QueueFullError
+from fm_returnprediction_tpu.serving.brownout import (
+    BrownoutController,
+    BrownoutPolicy,
+    DegradedQuote,
+)
 from fm_returnprediction_tpu.serving.executor import (
     BucketedExecutor,
     bucket_for,
@@ -41,6 +58,17 @@ from fm_returnprediction_tpu.serving.journal import (
     RequestJournal,
     replay_journal,
 )
+from fm_returnprediction_tpu.serving.loadgen import (
+    LoadGen,
+    LoadPhase,
+    capacity_model,
+    query_with_retry,
+)
+from fm_returnprediction_tpu.serving.recovery import (
+    RecoveryReport,
+    recover_journal,
+    repair_journal,
+)
 from fm_returnprediction_tpu.serving.service import ERService
 from fm_returnprediction_tpu.serving.state import (
     ServingState,
@@ -48,6 +76,7 @@ from fm_returnprediction_tpu.serving.state import (
     build_serving_state_from_panel,
 )
 from fm_returnprediction_tpu.serving.supervisor import (
+    AutoscalePolicy,
     HealthPolicy,
     Supervisor,
 )
@@ -73,4 +102,15 @@ __all__ = [
     "replay_journal",
     "Supervisor",
     "HealthPolicy",
+    "AutoscalePolicy",
+    "BrownoutPolicy",
+    "BrownoutController",
+    "DegradedQuote",
+    "LoadGen",
+    "LoadPhase",
+    "capacity_model",
+    "query_with_retry",
+    "RecoveryReport",
+    "recover_journal",
+    "repair_journal",
 ]

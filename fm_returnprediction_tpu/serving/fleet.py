@@ -35,17 +35,37 @@ earlier layers only detect:
   across swaps and replica deaths (asserted in ``tests/test_fleet.py``,
   demonstrated in the bench's ``fleet_*`` section).
 
+- **Overload survival (ISSUE 12).** The supervisor's AUTOSCALER leg
+  (``supervisor.AutoscalePolicy``) grows the replica set from SLO burn +
+  shed pressure + queue occupancy (``scale_out`` spawns compile-free via
+  the warm pool) and shrinks it on sustained relief (``scale_in`` drains
+  through the DRAINING machinery, then ``retire`` — no replacement). When
+  scale-out is exhausted, the BROWNOUT ladder (``serving.brownout``)
+  flips the fleet to disclosed cheaper routes — full → coreset-m → shed —
+  every degraded response a ``DegradedQuote`` stamped with its
+  route/precision, recovering hysteretically. After a hard crash,
+  :meth:`ServingFleet.recover` rebuilds the fleet from the journal + the
+  registry with in-flight requests closed out to typed retriable
+  outcomes (``serving.recovery``). The adversarial load harness
+  (``serving.loadgen``) and the bench's ``fleet_capacity_*`` /
+  ``fleet_overload_*`` sections exercise all of it.
+
 Chaos sites (deterministic, ``resilience.faults``): ``fleet.replica_kill``
 (kill the replica a request was just routed to), ``fleet.replica_stall``
 (stall one replica's dispatches), ``fleet.poison_state`` (corrupt a
 rollover candidate), ``fleet.swap_mid_flight`` (trigger a staged rollover
-from inside the submit path).
+from inside the submit path), ``fleet.hard_crash`` (abandon the fleet the
+way a process death would), ``fleet.journal_torn_tail`` (tear the
+journal's final line as the handle drops).
 
 Knobs: ``FMRP_FLEET_SIZE`` (default replica count),
 ``FMRP_FLEET_RATE``/``FMRP_FLEET_BURST`` (admission token bucket),
 ``FMRP_FLEET_SHED_OCCUPANCY`` (queue-occupancy shed threshold),
-``FMRP_FLEET_JOURNAL`` (journal path), ``FMRP_FLEET_PROBE_S``
-(background supervisor cadence); ``--fleet-size`` on both CLIs.
+``FMRP_FLEET_JOURNAL`` (journal path), ``FMRP_FLEET_JOURNAL_KEEP``
+(rotated-session retention), ``FMRP_FLEET_PROBE_S`` (background
+supervisor cadence), ``FMRP_FLEET_{MIN,MAX,COOLDOWN_S}`` (autoscaler),
+``FMRP_FLEET_BROWNOUT`` / ``FMRP_FLEET_BROWNOUT_*`` (degradation
+ladder); ``--fleet-size`` on both CLIs.
 """
 
 from __future__ import annotations
@@ -72,7 +92,12 @@ from fm_returnprediction_tpu.resilience.errors import (
     StateRolloverError,
 )
 from fm_returnprediction_tpu.resilience.faults import fault_site
+from fm_returnprediction_tpu.serving import brownout as _brownout
 from fm_returnprediction_tpu.serving.batcher import QueueFullError
+from fm_returnprediction_tpu.serving.brownout import (
+    BrownoutController,
+    BrownoutPolicy,
+)
 from fm_returnprediction_tpu.serving.journal import (
     RequestJournal,
     replay_journal,
@@ -82,6 +107,7 @@ from fm_returnprediction_tpu.serving.supervisor import (
     DEAD,
     DRAINING,
     HEALTHY,
+    AutoscalePolicy,
     HealthPolicy,
     Supervisor,
 )
@@ -237,7 +263,7 @@ class _Replica:
     lock; ``inflight`` counts requests routed but not yet resolved)."""
 
     __slots__ = ("rid", "service", "state", "inflight", "generation",
-                 "reasons", "folded")
+                 "reasons", "folded", "retire_on_drain")
 
     def __init__(self, rid: str, service: ERService, generation: int):
         self.rid = rid
@@ -247,6 +273,7 @@ class _Replica:
         self.generation = generation
         self.reasons: List[str] = []
         self.folded = False  # final counters folded into the fleet prior
+        self.retire_on_drain = False  # scale-in: drain then LEAVE, no spawn
 
 
 # -- the fleet ---------------------------------------------------------------
@@ -267,6 +294,8 @@ class ServingFleet:
         *,
         admission: Optional[AdmissionPolicy] = None,
         health: Optional[HealthPolicy] = None,
+        autoscale: Optional[AutoscalePolicy] = None,
+        brownout=None,
         registry_dir=None,
         journal=None,
         max_requeues: int = 2,
@@ -340,11 +369,46 @@ class ServingFleet:
             "fmrp_fleet_rollovers_total",
             help="fleet-wide state version rollovers committed",
         )
+        self._m_scale_out = reg.private_counter(
+            "fmrp_fleet_scale_out_total",
+            help="replicas added by the autoscaler (warm-pool spawns)",
+        )
+        self._m_scale_in = reg.private_counter(
+            "fmrp_fleet_scale_in_total",
+            help="replicas retired by the autoscaler (drained, not replaced)",
+        )
+        self._m_degraded = reg.private_counter(
+            "fmrp_fleet_degraded_total",
+            help="responses served by a disclosed brownout route",
+        )
+        # brownout ladder: a policy/controller arms it explicitly;
+        # FMRP_FLEET_BROWNOUT=1 arms it with the env policy; default off
+        # (the submit path then never consults the ladder)
+        if brownout is None:
+            brownout = os.environ.get("FMRP_FLEET_BROWNOUT", "0") == "1"
+        if isinstance(brownout, BrownoutController):
+            self.brownout: Optional[BrownoutController] = brownout
+        elif isinstance(brownout, BrownoutPolicy):
+            self.brownout = BrownoutController(brownout)
+        elif brownout:
+            self.brownout = BrownoutController()
+        else:
+            self.brownout = None
+        self._crashed = False
         for _ in range(n_replicas):
             self._add_replica()
         self._update_gauges()
-        # optional background supervision (tests tick manually)
-        self.supervisor = Supervisor(self, policy=health)
+        # the journal doubles as the fleet's topology record: size-carrying
+        # marks (here, scale_out/scale_in/retire) are what crash-restart
+        # recovery reads to respawn the right replica count
+        self._jrnl_mark("fleet_start", size=n_replicas,
+                        version=self.version)
+        # optional background supervision (tests tick manually); the
+        # autoscaler leg rides the same tick, its cooldown on the same
+        # injectable clock as admission
+        self.supervisor = Supervisor(self, policy=health,
+                                     autoscale=autoscale,
+                                     clock=admission_clock)
         if probe_interval_s is None:
             env = os.environ.get("FMRP_FLEET_PROBE_S")
             probe_interval_s = float(env) if env else None
@@ -496,6 +560,99 @@ class ServingFleet:
         self._update_gauges()
         return new_rid
 
+    # -- elasticity (the autoscaler's verbs) --------------------------------
+
+    def scale_out(self, n: int = 1, reason: str = "pressure") -> List[str]:
+        """Add ``n`` replicas at the CURRENT state version — compile-free
+        when a registry is armed (the same warm pool failover uses).
+        Serialized against :meth:`rollover` for the same reason
+        :meth:`replace` is: a replica spawned mid-PREPARE would miss the
+        commit flip and split the fleet across versions."""
+        rids: List[str] = []
+        with self._rollover_lock:
+            for _ in range(max(int(n), 0)):
+                rids.append(self._add_replica())
+        if not rids:
+            return rids
+        self._m_scale_out.inc(len(rids))
+        with self._lock:
+            size = len(self._replicas)
+        self._jrnl_mark("scale_out", replicas=",".join(rids), size=size,
+                        reason=reason)
+        telemetry.event("fleet.scale_out", cat="fleet",
+                        replicas=",".join(rids), reason=reason)
+        self._update_gauges()
+        return rids
+
+    def scale_in(self, reason: str = "relief") -> Optional[str]:
+        """Retire ONE replica: the youngest healthy replica drains through
+        the existing DRAINING machinery (router excludes it immediately,
+        queued work completes) and the supervisor RETIRES it once idle —
+        no replacement spawned. Returns the draining rid, or None when
+        the fleet is already minimal."""
+        with self._rollover_lock:
+            with self._lock:
+                healthy = [
+                    rep for rep in self._replicas.values()
+                    if rep.state == HEALTHY
+                ]
+                if len(healthy) <= 1:
+                    return None
+                rep = max(healthy, key=lambda r: r.generation)
+                rep.retire_on_drain = True
+            self.decommission(rep.rid, reasons=[f"scale-in: {reason}"])
+        self._m_scale_in.inc()
+        with self._lock:
+            # the post-retire size this drain is headed for (the live
+            # count still includes the draining replica)
+            size = len(self._replicas) - 1
+        self._jrnl_mark("scale_in", replica=rep.rid, size=size,
+                        reason=reason)
+        telemetry.event("fleet.scale_in", cat="fleet", replica=rep.rid,
+                        reason=reason)
+        return rep.rid
+
+    def retire(self, rid: str, reason: str = "scaled in") -> None:
+        """Remove a replica WITHOUT spawning a replacement (the scale-in
+        terminal; contrast :meth:`replace`). Serialized on the rollover
+        lock like every topology mutation."""
+        with self._rollover_lock:
+            with self._lock:
+                rep = self._replicas.pop(rid, None)
+                if rep is not None:
+                    self._ring.remove(rid)
+                    self._graveyard[rid] = reason
+            if rep is None:
+                return
+            if rep.state != DEAD:
+                rep.service.close()
+            self._fold_final(rep)
+        with self._lock:
+            size = len(self._replicas)
+        self._jrnl_mark("retire", replica=rid, size=size, reason=reason)
+        telemetry.event("fleet.retire", cat="fleet", replica=rid,
+                        reason=reason)
+        self._update_gauges()
+
+    @property
+    def shed_total(self) -> int:
+        """Lifetime admission sheds (the supervisor's delta signal)."""
+        return self._m_shed.value
+
+    def _note_brownout(self, step: Optional[str], ctl) -> None:
+        """Journal/export a brownout ladder transition (supervisor tick
+        callback); the level gauge refreshes every tick either way."""
+        telemetry.registry().gauge(
+            "fmrp_fleet_brownout_level",
+            help="degradation ladder position: 0 full service",
+        ).set(ctl.level)
+        if step is None:
+            return
+        rung = ctl.active_rung() or "full"
+        self._jrnl_mark("brownout", step=step, rung=rung, level=ctl.level)
+        telemetry.event("fleet.brownout", cat="fleet", step=step,
+                        rung=rung, level=ctl.level)
+
     # -- admission ---------------------------------------------------------
 
     def _queue_snapshot(self) -> Tuple[int, int, int]:
@@ -534,9 +691,18 @@ class ServingFleet:
         batches = math.ceil(excess_rows / max(1, healthy * max_batch))
         return batches * max_latency_s
 
-    def _admit(self, req: int) -> None:
+    def _admit(self, req: int, degraded: bool = False) -> None:
         """The front door: token bucket, then queue occupancy. Raises
-        :class:`ServiceOverloadError` (journaled ``shed``) on refusal."""
+        :class:`ServiceOverloadError` (journaled ``shed``) on refusal.
+
+        ``degraded`` (a brownout rung below full is active): the
+        queue-derived checks — occupancy and healthy-replica count — are
+        SKIPPED, because a degraded request never touches a queue or a
+        replica (host-side answer). Occupancy shedding at the default
+        0.9 would otherwise preempt the ladder exactly when the queues
+        are pinned at ceiling — the scenario the ladder exists for. The
+        token bucket still applies: it is a rate POLICY, not congestion
+        protection."""
         if self._bucket is not None:
             wait = self._bucket.try_acquire()
             if wait is not None:
@@ -544,6 +710,8 @@ class ServingFleet:
                     req, f"admission rate limit; retry in {wait:.3f}s",
                     reason="token_bucket", retry_after_s=wait,
                 )
+        if degraded:
+            return
         depth, ceiling, healthy = self._queue_snapshot()
         if healthy == 0:
             self._shed(
@@ -584,7 +752,17 @@ class ServingFleet:
         with self._lock:
             self._req_counter += 1
             req = self._req_counter
-        self._admit(req)                       # may raise (journals shed)
+        # ONE rung read for the whole request: admission and the serve
+        # path below must agree on whether this request is degraded
+        ctl = self.brownout
+        rung = ctl.active_rung() if ctl is not None else None
+        # ANY active rung bypasses the queue-derived admission checks:
+        # degraded rungs never touch a queue, and on the shed rung the
+        # refusal must be the ladder's own typed brownout_shed (reason +
+        # shed_retry_after_s) — not an occupancy shed that happens to
+        # fire first and mislabels the episode
+        self._admit(req, degraded=rung is not None)  # may raise
+        #                                             (journals shed)
         self._jrnl("admit", req)
         with self._outstanding_cv:
             self._outstanding += 1
@@ -592,10 +770,26 @@ class ServingFleet:
         try:
             # chaos: a staged rollover can be triggered HERE,
             # deterministically mid-load (fleet.swap_mid_flight +
-            # stage_rollover); inside the try — the admit above must
-            # reach a terminal even when the site (or the rollover it
+            # stage_rollover), and fleet.hard_crash can abandon the whole
+            # fleet between two specific admits (the crash-restart
+            # recovery path under test); inside the try — the admit above
+            # must reach a terminal even when the site (or what it
             # triggers) raises
             fault_site("fleet.swap_mid_flight", payload=self)
+            fault_site("fleet.hard_crash", payload=self)
+            if rung is not None:
+                if rung == _brownout.RUNG_SHED:
+                    # the ladder's last rung IS the old behavior: a typed
+                    # retriable 429 (journaled as a shed terminal by the
+                    # except below)
+                    raise ServiceOverloadError(
+                        "brownout ladder at shed (degraded routes "
+                        "exhausted); retry after recovery",
+                        retry_after_s=ctl.policy.shed_retry_after_s,
+                        reason="brownout_shed",
+                    )
+                self._serve_degraded(req, month, x, rung, outer)
+                return outer
             self._route_and_submit(req, month, x, key or str(req), outer,
                                    tried=frozenset(), attempt=0)
         except Exception as exc:
@@ -678,6 +872,30 @@ class ServingFleet:
             fault_site("fleet.replica_kill", payload=(self, rid))
         except Exception:  # noqa: BLE001 — see above
             pass
+
+    def _serve_degraded(self, req: int, month, x, rung: str,
+                        outer: Future) -> None:
+        """One brownout response: answered HOST-SIDE from the frozen state
+        (``serving.brownout``), bypassing the saturated batcher/executor
+        path — the congested resource gets zero new work, which is what
+        lets the burn recover. Journaled as route→done against the
+        synthetic replica ``brownout:<rung>`` so replay stays clean and a
+        reader can see which requests the ladder answered. Exceptions
+        (unknown month, a poisoned row) propagate to submit's accounting
+        except-clause exactly like the full path's synchronous failures."""
+        self._jrnl("route", req, replica=f"brownout:{rung}")
+        # ONE state read: a rollover committing between "resolve the
+        # index" and "read the arrays" would pair the new vocabulary
+        # with the old coefficients (wrong-month quote, or IndexError
+        # on a freshly appended month)
+        st = self.state
+        quote = self.brownout.answer(st, st.month_index(month), x, rung)
+        self._jrnl("done", req, route=rung)
+        self._m_degraded.inc()
+        telemetry.event("fleet.degraded", cat="fleet", route=rung)
+        self._finish()
+        if not outer.cancelled():
+            outer.set_result(quote)
 
     def _on_inner_done(self, req: int, month, x, key: str, outer: Future,
                        rid: str, tried: set, attempt: int, inner: Future
@@ -911,6 +1129,16 @@ class ServingFleet:
         return {
             "fleet_size": len(reps),
             "slo_state": worst_slo,
+            "brownout_level": (
+                self.brownout.level if self.brownout is not None else None
+            ),
+            "brownout_rung": (
+                self.brownout.active_rung() or "full"
+                if self.brownout is not None else None
+            ),
+            "degraded_total": self._m_degraded.value,
+            "scale_out_total": self._m_scale_out.value,
+            "scale_in_total": self._m_scale_in.value,
             "healthy_replicas": sum(
                 1 for s in states.values() if s == HEALTHY
             ),
@@ -966,11 +1194,132 @@ class ServingFleet:
         )
         return self._metrics_server.server_address
 
+    # -- crash-restart recovery --------------------------------------------
+
+    def hard_crash(self) -> None:
+        """Simulate abrupt PROCESS DEATH (the chaos verb behind the
+        ``fleet.hard_crash`` site): supervision stops, the journal's file
+        handle drops with NO terminal events and NO rotation — optionally
+        torn mid-line by the ``fleet.journal_torn_tail`` site — and every
+        replica is killed with journaling already dead (a real corpse
+        writes nothing). The object is garbage afterwards; the journal
+        file on disk is exactly what a crashed process leaves behind, and
+        :meth:`recover` is how the next process picks it up."""
+        self._crashed = True
+        self.supervisor.stop()
+        telemetry.event("fleet.hard_crash", cat="fleet")
+        j = self.journal
+        if j is not None:
+            j.abandon()   # abrupt: no close-out, no rotation
+            # chaos: tear the final line the way a crash mid-append does
+            fault_site("fleet.journal_torn_tail", path=j.path)
+        server = getattr(self, "_metrics_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            self._metrics_server = None
+        with self._lock:
+            reps = list(self._replicas.values())
+        # kill AFTER the journal is dead: the done-callbacks these fire
+        # (requeue attempts, terminal accounting) write nothing — the
+        # in-flight requests stay journal-dangling, exactly like a crash
+        for rep in reps:
+            if rep.state != DEAD:
+                rep.state = DEAD
+                try:
+                    rep.service.kill("hard crash")
+                except Exception:  # noqa: BLE001 — a corpse is a corpse
+                    pass
+
+    @classmethod
+    def recover(cls, journal, registry_dir=None, state=None,
+                n_replicas: Optional[int] = None, **fleet_kwargs):
+        """Rebuild a fleet after a process death: repair + close out the
+        crashed session's journal (``serving.recovery`` — every in-flight
+        request resolves to a typed retriable outcome and the session
+        replays CLEAN), resolve the state from the registry's artifact
+        plane (or ``state``), size the fleet from the journal's own
+        topology marks (``n_replicas`` overrides), and start every
+        replica through the warm pool — zero fresh compiles with a
+        populated registry. Returns ``(fleet, RecoveryReport)``.
+
+        The new fleet journals onto the SAME path; the recovered session
+        rotates like any other, so the exactly-once evidence chain stays
+        one directory of standalone-replayable files."""
+        from pathlib import Path
+
+        from fm_returnprediction_tpu.serving.recovery import (
+            RecoveryReport,
+            recover_journal,
+        )
+
+        path = Path(journal)
+        jrec = recover_journal(path)
+        if n_replicas is None:
+            n_replicas = jrec.last_size
+        state_source = "explicit"
+        if state is None:
+            from fm_returnprediction_tpu.registry import artifacts
+            from fm_returnprediction_tpu.registry.store import (
+                registry_dir as _env_registry_dir,
+                using_registry,
+            )
+
+            reg_dir = registry_dir
+            if reg_dir is None:
+                reg_dir = _env_registry_dir()
+            if reg_dir is None:
+                raise ValueError(
+                    "ServingFleet.recover needs a state, a registry_dir, "
+                    "or FMRP_REGISTRY_DIR set — a crashed process's state "
+                    "lives in the artifact plane"
+                )
+            with using_registry(reg_dir) as reg:
+                state = artifacts.load_serving_state(None, registry=reg)
+            if state is None:
+                raise FileNotFoundError(
+                    f"no serving_state artifact in registry {reg_dir}"
+                )
+            state_source = f"registry:{reg_dir}"
+        fleet = cls(state, n_replicas, registry_dir=registry_dir,
+                    journal=path, **fleet_kwargs)
+        fleet._jrnl_mark("recovered_from", session=str(
+            fleet.journal.rotated_to.name if fleet.journal is not None
+            and fleet.journal.rotated_to is not None else ""
+        ), closed_out=len(jrec.recovered))
+        # names only — re-replaying every retained historical session
+        # here would put O(retained history) of JSON parsing on the
+        # restart critical path for a cosmetic verdict; the recovered
+        # session's own verdict is jrec.replay_clean (rotation is a
+        # rename, the bytes are identical), and older sessions were
+        # verified when they were live
+        sessions = []
+        if fleet.journal is not None:
+            sessions = [p.name for _, p in fleet.journal.sessions()]
+        report = RecoveryReport(
+            journal=jrec,
+            state_source=state_source,
+            n_replicas=len(fleet.replica_states()),
+            zero_compile_starts=sum(
+                1 for r in fleet.warm_reports.values()
+                if getattr(r, "zero_compile", False)
+            ),
+            rotated_to=(fleet.journal.rotated_to
+                        if fleet.journal is not None else None),
+            prior_sessions=tuple(sessions),
+        )
+        telemetry.event("fleet.recovered", cat="fleet",
+                        closed_out=len(jrec.recovered),
+                        replicas=report.n_replicas)
+        return fleet, report
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain outstanding requests, stop supervision, close every
         replica, release the journal (when fleet-owned)."""
+        if self._crashed:
+            return  # a hard-crashed fleet is a corpse; nothing to drain
         self.drain(timeout)
         self.supervisor.stop()
         server = getattr(self, "_metrics_server", None)

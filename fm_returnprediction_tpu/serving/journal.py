@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple, Union
@@ -65,7 +66,7 @@ class RequestJournal:
     the authoritative interleaving for replay.
     """
 
-    def __init__(self, path: Union[str, Path]):
+    def __init__(self, path: Union[str, Path], keep: Optional[int] = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         # one file = one journal session. Request ids and ``seq`` restart
@@ -75,17 +76,53 @@ class RequestJournal:
         # non-empty file (a reused FMRP_FLEET_JOURNAL path) therefore
         # ROTATES to ``<path>.1`` / ``.2`` / … first: history is kept,
         # every file replays standalone. ``rotated_to`` discloses it.
+        # The suffix is max(existing)+1 — monotone even after retention
+        # deletes low-numbered sessions, so numeric order stays age order.
         self.rotated_to: Optional[Path] = None
+        # retention: keep the newest ``keep`` rotated sessions (the live
+        # file is never touched); 0 = keep all. Default from
+        # FMRP_FLEET_JOURNAL_KEEP (8). Applied AT ROTATION TIME so an idle
+        # journal never loses history, and the dropped files are disclosed
+        # (``dropped_sessions`` + a ``journal_retention`` mark in the new
+        # session — replay tolerates marks).
+        if keep is None:
+            keep = int(os.environ.get("FMRP_FLEET_JOURNAL_KEEP", "8"))
+        self.keep = int(keep)
+        self.dropped_sessions: Tuple[Path, ...] = ()
+        sessions = self.sessions()
         if self.path.exists() and self.path.stat().st_size > 0:
-            k = 1
-            while self.path.with_name(f"{self.path.name}.{k}").exists():
-                k += 1
+            k = (sessions[-1][0] + 1) if sessions else 1
             self.rotated_to = self.path.with_name(f"{self.path.name}.{k}")
             self.path.rename(self.rotated_to)
+            sessions.append((k, self.rotated_to))
+        if self.keep > 0 and len(sessions) > self.keep:
+            doomed = [p for _, p in sessions[: len(sessions) - self.keep]]
+            for p in doomed:
+                try:
+                    p.unlink()
+                except OSError:
+                    continue
+            self.dropped_sessions = tuple(doomed)
         self._fh = open(self.path, "a", encoding="utf-8")
         self._lock = threading.Lock()
         self._seq = 0
         self._closed = False
+        if self.dropped_sessions:
+            self.mark(
+                "journal_retention",
+                keep=self.keep,
+                dropped=";".join(p.name for p in self.dropped_sessions),
+            )
+
+    def sessions(self) -> List[Tuple[int, Path]]:
+        """Existing rotated session files as sorted (suffix, path)."""
+        out: List[Tuple[int, Path]] = []
+        for p in self.path.parent.glob(f"{self.path.name}.*"):
+            suffix = p.name[len(self.path.name) + 1:]
+            if suffix.isdigit():
+                out.append((int(suffix), p))
+        out.sort()
+        return out
 
     def append(self, ev: str, req: Optional[int] = None, **fields) -> int:
         """Write one event line; returns its ``seq``. No-op (returns -1)
@@ -115,6 +152,15 @@ class RequestJournal:
             if not self._closed:
                 self._closed = True
                 self._fh.close()
+
+    def abandon(self) -> None:
+        """Crash-simulating close (the ``fleet.hard_crash`` path): drop
+        the file handle with no close-out and no rotation — later
+        :meth:`append` calls no-op, exactly what a dead process's journal
+        looks like to the next one. Mechanically :meth:`close`; the
+        separate verb keeps the journal's lifecycle its own concern
+        instead of callers poking ``_fh``/``_closed``."""
+        self.close()
 
     def __enter__(self) -> "RequestJournal":
         return self

@@ -201,6 +201,15 @@ class SloMonitor:
         out["state_code"] = STATE_CODES[worst]
         return out
 
+    def worst_burn(self, now: Optional[float] = None) -> float:
+        """The hottest objective's burn rate (0.0 with no objectives) —
+        the scalar pressure signal the fleet autoscaler and brownout
+        controller key off (``serving.supervisor``). Same snapshot, one
+        number."""
+        snap = self.snapshot(now=now)
+        burns = [o["burn_rate"] for o in snap["objectives"].values()]
+        return max(burns) if burns else 0.0
+
 
 def slos_from_env(environ=None) -> Tuple[SLO, ...]:
     """Objectives from the ``FMRP_SLO_*`` knobs (empty tuple when none

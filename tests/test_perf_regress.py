@@ -218,9 +218,14 @@ def test_repo_bench_history_has_no_false_regressions():
     report = regress.analyze(regress.load_rounds(files))
     assert report.ok, report.format_text()
     # the known r03→r05 improvement trajectory reads as improvement
-    by_key = {v.key: v.status for v in report.verdicts}
+    # (keys carry the @shape@device qualifiers since PR 11 — group by
+    # the bare metric; rounds predating the disclosures leave a legacy
+    # unqualified series whose latest value is legitimately "missing")
+    by_key: dict = {}
+    for v in report.verdicts:
+        by_key.setdefault(v.key.split("@", 1)[0], set()).add(v.status)
     if "real_pipeline_warm_s" in by_key:
-        assert by_key["real_pipeline_warm_s"] in ("improved", "ok")
+        assert by_key["real_pipeline_warm_s"] & {"improved", "ok"}
 
 
 @pytest.mark.slow
@@ -240,6 +245,10 @@ def test_repo_history_catches_injected_regression(tmp_path):
     inject.write_text(json.dumps(latest))
     report = regress.analyze(regress.load_rounds([*files, inject]))
     assert not report.ok
+    # series keys are @shape@device-qualified since PR 11; the injected
+    # headline must be caught under its bare metric name (exact-key
+    # equality silently never matched once the qualifiers landed)
     assert any(
-        v.key == payload["metric"] for v in report.regressions
+        v.key.split("@", 1)[0] == payload["metric"]
+        for v in report.regressions
     ), report.format_text()
