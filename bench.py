@@ -1107,6 +1107,195 @@ def _bench_specgrid(fast: bool):
     }
 
 
+def _bench_multiproc(fast: bool):
+    """Cross-process execution (ISSUE 13): process count as a measured
+    deployment knob.
+
+    - ``multiproc_specgrid_cells_per_s_p{1,2,4}`` — the Table-2-shaped
+      3×3 grid through the spec-grid route at 1/2/4 processes. p1 is the
+      in-process fused program (the incumbent, whole box); p2/p4 spawn
+      that many firm-shard contraction workers, each PINNED to
+      ``multiproc_cpus_per_proc`` cores (the pod's fixed-compute-per-
+      process model on one box: a process = a "host" of K cores), merged
+      over the host exchange and solved by the existing vmapped tail.
+      ``multiproc_specgrid_speedup_p4`` (p4/p1 cells/s, higher-better)
+      is the regress-tracked series — the acceptance floor is ≥1.5×.
+    - ``multiproc_transport_*`` — host-merge bytes and wall per grid at
+      p4 (the gather fan-in the broker carries), plus the differential
+      guard ``multiproc_max_abs_coef_diff`` (p4 vs p1 coef; the tier-1
+      pin is ≤1e-6 f32 rtol in tests/test_multiprocess.py).
+    - ``multiproc_fleet_rows_per_s_{thread,process}`` — the same fleet
+      drive with replicas as in-process threads vs REAL child processes
+      behind the socket transport; the ratio discloses the per-query
+      IPC bill the process boundary adds on one box (on a pod the
+      boundary buys isolation + real parallelism; here it is priced).
+
+    FMRP_BENCH_MULTIPROC=0 skips; _MULTIPROC_QUERIES resizes the fleet
+    phase; FMRP_SPECGRID_CPUS_PER_PROC re-pins the worker core budget."""
+    if os.environ.get("FMRP_BENCH_MULTIPROC", "1") == "0":
+        return {}
+    import tempfile
+    import threading as _threading
+
+    from fm_returnprediction_tpu import specgrid
+    from fm_returnprediction_tpu.specgrid import multiproc
+
+    t = 120 if fast else 240
+    n = 1500 if fast else 4000
+    p = 14
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    grid = specgrid.SpecGrid(tuple(
+        specgrid.Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in (3, 7, 14) for u in masks
+    ))
+    s_cells = len(grid)
+    cpw = int(os.environ.get("FMRP_SPECGRID_CPUS_PER_PROC", "6"))
+    out = {
+        "multiproc_shape": f"T{t}_N{n}_S{s_cells}",
+        "multiproc_cpus_per_proc": cpw,
+    }
+    reps = 2 if fast else 4
+    coef_by_procs = {}
+    for procs in (1, 2, 4):
+        try:
+            with _timed(f"bench.multiproc_p{procs}_cold") as cold_t:
+                res = specgrid.run_spec_grid(
+                    y, x, masks, grid, procs=procs,
+                ) if procs == 1 else _mp_grid_run(
+                    specgrid, y, x, masks, grid, procs, cpw
+                )
+            with _timed(f"bench.multiproc_p{procs}_warm") as warm_t:
+                for _ in range(reps):
+                    res = specgrid.run_spec_grid(
+                        y, x, masks, grid, procs=procs,
+                    ) if procs == 1 else _mp_grid_run(
+                        specgrid, y, x, masks, grid, procs, cpw
+                    )
+            warm = warm_t.s / reps
+            coef_by_procs[procs] = np.asarray(res.coef, float)
+            out[f"multiproc_specgrid_cold_s_p{procs}"] = round(cold_t.s, 4)
+            out[f"multiproc_specgrid_warm_s_p{procs}"] = round(warm, 4)
+            out[f"multiproc_specgrid_cells_per_s_p{procs}"] = round(
+                s_cells / warm, 2
+            )
+            if procs > 1 and multiproc._POOL_CACHE is not None:
+                pool = multiproc._POOL_CACHE[2]
+                out[f"multiproc_transport_bytes_per_grid_p{procs}"] = int(
+                    pool.last_merge_bytes
+                )
+                out[f"multiproc_merge_s_p{procs}"] = round(
+                    pool.last_merge_s, 4
+                )
+        finally:
+            multiproc._close_cached_pool()
+    if 1 in coef_by_procs and 4 in coef_by_procs:
+        a, b = coef_by_procs[1], coef_by_procs[4]
+        both_nan = np.isnan(a) & np.isnan(b)
+        out["multiproc_max_abs_coef_diff"] = float(np.max(np.abs(
+            np.where(both_nan, 0.0, a) - np.where(both_nan, 0.0, b)
+        )))
+        p1 = out.get("multiproc_specgrid_cells_per_s_p1")
+        p4 = out.get("multiproc_specgrid_cells_per_s_p4")
+        if p1 and p4:
+            out["multiproc_specgrid_speedup_p4"] = round(p4 / p1, 2)
+
+    # -- fleet: thread vs process replica boundary -------------------------
+    from fm_returnprediction_tpu.serving import (
+        ServingFleet,
+        build_serving_state,
+        replay_journal,
+    )
+
+    tf, nf, pf = (60, 200, 5) if fast else (120, 600, 5)
+    rngf = np.random.default_rng(2016)
+    xf = rngf.standard_normal((tf, nf, pf)).astype(np.float32)
+    betaf = (rngf.standard_normal(pf) * 0.05).astype(np.float32)
+    yf = (xf @ betaf + 0.1 * rngf.standard_normal((tf, nf))).astype(
+        np.float32
+    )
+    maskf = rngf.random((tf, nf)) > 0.2
+    yf = np.where(maskf, yf, np.nan).astype(np.float32)
+    state = build_serving_state(
+        yf, xf, maskf, window=min(60, tf // 2), min_periods=min(24, tf // 4)
+    )
+    per_mode = int(os.environ.get(
+        "FMRP_BENCH_MULTIPROC_QUERIES", 400 if fast else 2000
+    ))
+    n_workers = 8
+    have = np.nonzero(state.have_coef())[0]
+    with tempfile.TemporaryDirectory() as root:
+        for mode in ("thread", "process"):
+            journal = os.path.join(root, f"journal_{mode}.jsonl")
+            fleet = ServingFleet(
+                state, 2, replica_mode=mode, max_batch=64,
+                max_latency_ms=1.0, journal=journal,
+            )
+            try:
+                mon = have[rngf.integers(0, len(have), per_mode)]
+                rows = rngf.standard_normal(
+                    (per_mode, pf)
+                ).astype(np.float32)
+                # warm the path before timing (first queries pay dispatch
+                # warm-up either side of the boundary)
+                fleet.query(int(mon[0]), rows[0])
+                errors = []
+                t0 = time.perf_counter()
+
+                def worker(k0, k1, mon=mon, rows=rows, fleet=fleet,
+                           errors=errors):
+                    for k in range(k0, k1):
+                        try:
+                            fleet.query(int(mon[k]), rows[k])
+                        except Exception as exc:  # noqa: BLE001
+                            errors.append(repr(exc))
+
+                chunk = per_mode // n_workers
+                threads = [
+                    _threading.Thread(
+                        target=worker,
+                        args=(w * chunk,
+                              per_mode if w == n_workers - 1
+                              else (w + 1) * chunk),
+                    )
+                    for w in range(n_workers)
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                wall = time.perf_counter() - t0
+                fleet.drain()
+                out[f"multiproc_fleet_rows_per_s_{mode}"] = round(
+                    per_mode / wall, 1
+                )
+                out[f"multiproc_fleet_query_errors_{mode}"] = len(errors)
+            finally:
+                fleet.close()
+            replay = replay_journal(journal)
+            out[f"multiproc_fleet_journal_clean_{mode}"] = bool(replay.clean)
+    thr = out.get("multiproc_fleet_rows_per_s_thread")
+    prc = out.get("multiproc_fleet_rows_per_s_process")
+    if thr and prc:
+        out["multiproc_fleet_process_over_thread"] = round(prc / thr, 3)
+    return out
+
+
+def _mp_grid_run(specgrid, y, x, masks, grid, procs, cpw):
+    """One multi-process grid run with the worker core budget pinned for
+    this section (restored after; the pool reads it at spawn)."""
+    prev = os.environ.get("FMRP_SPECGRID_CPUS_PER_PROC")
+    os.environ["FMRP_SPECGRID_CPUS_PER_PROC"] = str(cpw)
+    try:
+        return specgrid.run_spec_grid(y, x, masks, grid, procs=procs)
+    finally:
+        if prev is None:
+            os.environ.pop("FMRP_SPECGRID_CPUS_PER_PROC", None)
+        else:
+            os.environ["FMRP_SPECGRID_CPUS_PER_PROC"] = prev
+
+
 def _bench_specgrid_scale(fast: bool):
     """Pod-scale spec-grid: a CELL-COUNT LADDER through the lazy tile
     engine (``specgrid.cellspace``/``specgrid.engine``) and the streaming
@@ -2533,6 +2722,7 @@ def main() -> None:
     sections.append(_bench_fleet_capacity)  # _FLEET_CAPACITY=0 in-section
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
+    sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_obs)  # _OBS=0 handled in-section

@@ -129,3 +129,30 @@ if __name__ == "__main__":
             "    python -m fm_returnprediction_tpu.taskgraph [task ...]\n"
             "(same DAG, same semantics; this dodo.py is a doit-compat shim)."
         )
+
+
+def task_multiprocess_smoke():
+    """The cross-process suite as one named exit-1 gate: every spawned-
+    subprocess test in ``tests/test_multiprocess.py`` — host-exchange
+    collectives, the 2-process taskgraph DAG, the multi-process
+    spec-grid differential, the process-replica fleet kill/replay —
+    plus anything else carrying the ``multiprocess`` marker. Pairs with
+    ``robustness_smoke`` (fleet+chaos) and ``perf_regress`` (bench
+    history): the three named pre-merge gates for the serving/dist
+    planes."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m multiprocess -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "multiprocess marker smoke suite (spawned-subprocess "
+               "bootstrap, spec-grid, process fleet) — exit-1 on any "
+               "failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }

@@ -39,6 +39,14 @@ from fm_returnprediction_tpu.parallel.time_sharded import (
     rolling_sum_time_sharded,
     weekly_rolling_beta_time_sharded,
 )
+from fm_returnprediction_tpu.parallel.distributed import (
+    DistConfig,
+    HostExchange,
+    dist_active,
+    host_exchange,
+    initialize_distributed,
+    shutdown_distributed,
+)
 from fm_returnprediction_tpu.parallel.multihost import (
     as_flat_mesh,
     fama_macbeth_hier,
@@ -48,7 +56,13 @@ from fm_returnprediction_tpu.parallel.multihost import (
 
 __all__ = [
     "BootstrapResult",
+    "DistConfig",
+    "HostExchange",
     "as_flat_mesh",
+    "dist_active",
+    "host_exchange",
+    "initialize_distributed",
+    "shutdown_distributed",
     "block_bootstrap_se",
     "bootstrap_replicate_means",
     "daily_characteristics_sharded",

@@ -1,0 +1,585 @@
+"""Multi-process runtime bootstrap + host-side sufficient-stats exchange.
+
+Everything below this module, process count is a DEPLOYMENT KNOB: the
+spec-grid contraction, the taskgraph barriers, and the serving fleet all
+ask *this* module "who am I, how many of us are there, and how do I merge
+with the others" instead of assuming one process.
+
+Two transports, one fallback ladder (disclosed, ``docs/architecture.md``):
+
+1. **Device collectives** (``jax.distributed``): the TPU-pod path. The
+   bootstrap wires ``jax.distributed.initialize`` from the same
+   ``FMRP_DIST_*`` coordinates, ``multihost.make_mesh_2d`` then spans the
+   GLOBAL device set, and psums ride ICI/DCN. Opt-in via
+   ``FMRP_DIST_JAX=1`` (or ``auto`` on a non-CPU platform) because this
+   container's jaxlib CPU backend refuses cross-process collectives
+   outright ("Multiprocess computations aren't implemented on the CPU
+   backend") — the named environment gap ``tests/test_multiprocess.py``
+   still probes for.
+2. **Host-side exchange** (:class:`HostExchange`, this module): a small
+   length-prefixed TCP allgather among the processes, rank 0 embedding
+   the server. Per-process Gram shards are ADDITIVE (the PR-3 property),
+   so ``sum_tree`` — allgather + rank-ordered tree summation computed
+   identically on every rank — is a drop-in for the device ``psum``:
+   deterministic, and differentially pinned against the single-process
+   contraction (``tests/test_multiprocess.py``). This is the route that
+   works on ANY backend, device collectives or not.
+
+Wire format: every frame is an 8-byte big-endian length followed by a
+pickled payload (trusted intra-cluster links only — the same stance as
+the registry's pickled executables). One allgather ROUND is: every rank
+sends ``(rank, seq, bytes)``, the server buffers until all ``world``
+ranks posted that ``seq``, then sends each rank the rank-ordered list.
+Rounds complete strictly in ``seq`` order, so a rank that runs ahead
+never observes reordered replies. Byte and round counters land in the
+metrics registry (``fmrp_dist_exchange_*``) — the bench's
+``multiproc_transport_*`` series reads them.
+
+Configuration (``FMRP_DIST_*``, mirrored by :class:`DistConfig`):
+
+- ``FMRP_DIST_COORDINATOR`` — ``host:port`` of rank 0's exchange server;
+- ``FMRP_DIST_PROCS``       — world size;
+- ``FMRP_DIST_PROC_ID``     — this process's rank;
+- ``FMRP_DIST_JAX``         — ``0``/``1``/``auto``: also bring up the
+  ``jax.distributed`` device-collective runtime (auto: only off-CPU).
+
+``initialize_distributed()`` is idempotent and a no-op when the env is
+not set — the safe default for laptops and CI. It also stamps the
+process's telemetry identity (``telemetry.identity``) so merged traces
+and Prometheus exports from N processes stay attributable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "DistConfig",
+    "DistributedError",
+    "HostExchange",
+    "dist_active",
+    "free_port",
+    "host_exchange",
+    "initialize_distributed",
+    "process_count",
+    "process_index",
+    "recv_frame",
+    "send_frame",
+    "shutdown_distributed",
+    "worker_env",
+]
+
+_LEN = struct.Struct(">Q")
+
+
+class DistributedError(RuntimeError):
+    """A host-exchange protocol failure (timeout, peer death, tag skew)."""
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (tests/bench spawning local workers)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _send_frame(sock: socket.socket, payload: bytes, lock=None) -> int:
+    data = _LEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+    return len(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise DistributedError("exchange peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, n)
+
+
+# public spellings: the SAME 8-byte big-endian length-prefixed framing is
+# the repo's one wire format — the exchange above and the serving fleet's
+# replica transport (``serving.replica_proc``) share it
+send_frame = _send_frame
+recv_frame = _recv_frame
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    """One process's distributed coordinates."""
+
+    coordinator: str            # "host:port" of rank 0's exchange server
+    num_processes: int
+    process_id: int
+    jax_collectives: str = "0"  # "0" | "1" | "auto"
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["DistConfig"]:
+        """``FMRP_DIST_COORDINATOR`` + ``FMRP_DIST_PROCS`` +
+        ``FMRP_DIST_PROC_ID``; None (single-process) unless the first two
+        are both set."""
+        env = os.environ if environ is None else environ
+        coord = env.get("FMRP_DIST_COORDINATOR", "").strip()
+        procs = env.get("FMRP_DIST_PROCS", "").strip()
+        if not coord or not procs:
+            return None
+        return cls(
+            coordinator=coord,
+            num_processes=int(procs),
+            process_id=int(env.get("FMRP_DIST_PROC_ID", "0")),
+            jax_collectives=env.get("FMRP_DIST_JAX", "0").strip() or "0",
+        )
+
+    @property
+    def host(self) -> str:
+        return self.coordinator.rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.coordinator.rsplit(":", 1)[1])
+
+
+def worker_env(rank: int, world: int, port: int,
+               host: str = "127.0.0.1", jax_collectives: str = "0",
+               base: Optional[dict] = None) -> Dict[str, str]:
+    """The child-process environment for one exchange worker — the one
+    place the ``FMRP_DIST_*`` spelling lives for spawners (tests, bench,
+    the spec-grid pool)."""
+    env = dict(os.environ if base is None else base)
+    env["FMRP_DIST_COORDINATOR"] = f"{host}:{port}"
+    env["FMRP_DIST_PROCS"] = str(world)
+    env["FMRP_DIST_PROC_ID"] = str(rank)
+    env["FMRP_DIST_JAX"] = jax_collectives
+    return env
+
+
+# -- the exchange server (embedded in rank 0) --------------------------------
+
+
+class _ExchangeServer:
+    """Rank 0's round broker: accepts ``world`` rank connections, buffers
+    each round until every rank posted, answers in strict seq order."""
+
+    def __init__(self, host: str, port: int, world: int,
+                 accept_timeout_s: float):
+        self.world = int(world)
+        self._listener = socket.create_server(
+            (host, port), reuse_port=False
+        )
+        self._listener.settimeout(accept_timeout_s)
+        self._conns: Dict[int, socket.socket] = {}
+        self._wlocks: Dict[int, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._rounds: Dict[int, Dict[int, bytes]] = {}
+        self._next_seq = 1
+        self._fail: Optional[str] = None
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fmrp-exchange-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        try:
+            while len(self._conns) < self.world:
+                conn, _ = self._listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hello = pickle.loads(_recv_frame(conn))
+                rank = int(hello["rank"])
+                with self._lock:
+                    if rank in self._conns:
+                        raise DistributedError(f"duplicate rank {rank}")
+                    self._conns[rank] = conn
+                    self._wlocks[rank] = threading.Lock()
+            # all present: release everyone (the startup barrier)
+            ok = pickle.dumps({"ok": True, "world": self.world})
+            for rank, conn in self._conns.items():
+                _send_frame(conn, ok, self._wlocks[rank])
+                t = threading.Thread(
+                    target=self._reader, args=(rank, conn),
+                    name=f"fmrp-exchange-r{rank}", daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        except Exception as exc:  # noqa: BLE001 — surfaced to every rank
+            self._die(f"exchange server accept failed: {exc!r}")
+
+    def _die(self, why: str) -> None:
+        """One rank's death is everyone's: a blocked allgather can never
+        complete, so every connection is torn down (peers see EOF and
+        raise) rather than letting the fleet hang in recv."""
+        with self._lock:
+            if self._fail is None:
+                self._fail = why
+            conns = list(self._conns.values())
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reader(self, rank: int, conn: socket.socket) -> None:
+        try:
+            while True:
+                rank_in, seq, payload, root = pickle.loads(_recv_frame(conn))
+                with self._lock:
+                    bucket = self._rounds.setdefault(int(seq), {})
+                    bucket[int(rank_in)] = (payload, root)
+                    done = []
+                    # complete strictly in seq order: seq k+1 can only be
+                    # complete if every rank already posted seq k
+                    while len(self._rounds.get(self._next_seq, {})) \
+                            == self.world:
+                        full = self._rounds.pop(self._next_seq)
+                        roots = {r for _, r in full.values()}
+                        if len(roots) != 1:
+                            raise DistributedError(
+                                f"round {self._next_seq} root skew: {roots}"
+                            )
+                        done.append((self._next_seq,
+                                     [full[r][0] for r in range(self.world)],
+                                     roots.pop()))
+                        self._next_seq += 1
+                for seq_done, ordered, root_done in done:
+                    # root=None: allgather (everyone gets the list);
+                    # root=k: gather (only rank k pays the fan-in
+                    # bandwidth; the rest get a tiny completion ack)
+                    full_reply = pickle.dumps((seq_done, ordered))
+                    ack_reply = (pickle.dumps((seq_done, []))
+                                 if root_done is not None else full_reply)
+                    for r, c in list(self._conns.items()):
+                        reply = (full_reply
+                                 if root_done is None or r == root_done
+                                 else ack_reply)
+                        _send_frame(c, reply, self._wlocks[r])
+        except (DistributedError, OSError, EOFError, pickle.PickleError):
+            self._die(f"rank {rank} left the exchange")
+
+    def close(self) -> None:
+        self._die("server closed")
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# -- the per-process exchange client -----------------------------------------
+
+
+class HostExchange:
+    """One process's handle on the host-merge transport.
+
+    ``allgather`` is the primitive; ``sum_tree`` / ``barrier`` /
+    ``broadcast_obj`` build on it client-side, so every rank computes the
+    SAME rank-ordered result — the determinism that substitutes for the
+    device ``psum``'s. Thread-safety: one round at a time per process
+    (the round lock); concurrent rounds from one process would deadlock
+    the seq ordering by construction, so they serialize here.
+    """
+
+    def __init__(self, config: DistConfig, timeout_s: Optional[float] = None):
+        self.config = config
+        self.rank = int(config.process_id)
+        self.world = int(config.num_processes)
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("FMRP_DIST_TIMEOUT_S", "120"))
+        self.timeout_s = timeout_s
+        self._server: Optional[_ExchangeServer] = None
+        if self.rank == 0:
+            self._server = _ExchangeServer(
+                config.host, config.port, self.world, timeout_s
+            )
+        self._sock = self._connect()
+        self._seq = 0
+        self._round_lock = threading.Lock()
+        self._wlock = threading.Lock()
+        # transport accounting (the bench's multiproc_transport_* series)
+        from fm_returnprediction_tpu import telemetry
+
+        reg = telemetry.registry()
+        self._m_bytes_out = reg.counter(
+            "fmrp_dist_exchange_bytes_total",
+            help="host-exchange payload bytes by direction",
+            direction="sent",
+        )
+        self._m_bytes_in = reg.counter(
+            "fmrp_dist_exchange_bytes_total",
+            help="host-exchange payload bytes by direction",
+            direction="received",
+        )
+        self._m_rounds = reg.counter(
+            "fmrp_dist_exchange_rounds_total",
+            help="completed host-exchange allgather rounds",
+        )
+        self.last_round_s = 0.0
+
+    def _connect(self) -> socket.socket:
+        deadline = time.monotonic() + self.timeout_s
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection(
+                    (self.config.host, self.config.port), timeout=self.timeout_s
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _send_frame(sock, pickle.dumps({"rank": self.rank}))
+                ok = pickle.loads(_recv_frame(sock))
+                if not ok.get("ok") or ok.get("world") != self.world:
+                    raise DistributedError(f"bad exchange handshake: {ok}")
+                sock.settimeout(self.timeout_s)
+                return sock
+            except (ConnectionError, socket.timeout, OSError) as exc:
+                last = exc
+                time.sleep(0.05)
+        raise DistributedError(
+            f"rank {self.rank} could not join exchange at "
+            f"{self.config.coordinator} within {self.timeout_s}s: {last!r}"
+        )
+
+    # -- primitives --------------------------------------------------------
+
+    def allgather(self, payload: bytes, root: Optional[int] = None
+                  ) -> List[bytes]:
+        """One round: every rank contributes ``payload``. With
+        ``root=None`` every rank receives the rank-ordered list of all
+        contributions (allgather); with ``root=k`` only rank k receives
+        the list and every other rank gets ``[]`` back (gather — the
+        fan-in bandwidth lands on the one rank that needs it). All ranks
+        of a round must agree on ``root`` (the broker raises on skew)."""
+        with self._round_lock:
+            self._seq += 1
+            seq = self._seq
+            t0 = time.perf_counter()
+            msg = pickle.dumps((self.rank, seq, payload, root))
+            sent = _send_frame(self._sock, msg, self._wlock)
+            try:
+                raw = _recv_frame(self._sock)
+            except (OSError, socket.timeout) as exc:
+                raise DistributedError(
+                    f"exchange round {seq} failed on rank {self.rank}: "
+                    f"{exc!r}"
+                ) from exc
+            seq_done, ordered = pickle.loads(raw)
+            if seq_done != seq:
+                raise DistributedError(
+                    f"exchange answered round {seq_done}, expected {seq}"
+                )
+            self.last_round_s = time.perf_counter() - t0
+            self._m_bytes_out.inc(sent)
+            self._m_bytes_in.inc(len(raw))
+            self._m_rounds.inc()
+            return ordered
+
+    def barrier(self, tag: str = "") -> None:
+        """Rendezvous; mismatched tags raise (program-order divergence —
+        the failure ``sync_global_devices`` hides as a hang)."""
+        tags = self.allgather(tag.encode())
+        if any(t != tags[0] for t in tags):
+            raise DistributedError(
+                f"barrier tag skew: {sorted(set(t.decode() for t in tags))}"
+            )
+
+    def allgather_obj(self, obj) -> list:
+        return [pickle.loads(b) for b in self.allgather(pickle.dumps(obj))]
+
+    def gather_obj(self, obj, root: int = 0) -> list:
+        """Gather: rank ``root`` returns every rank's object in rank
+        order; every other rank returns ``[]`` (contributing only). The
+        merge shape for root-solves-everything patterns — the spec-grid
+        pool's stats fan-in — where allgathering the full payload to
+        every rank would square the broker's bandwidth bill."""
+        parts = self.allgather(pickle.dumps(obj), root=root)
+        return [pickle.loads(b) for b in parts]
+
+    def broadcast_obj(self, obj, root: int = 0):
+        """Every rank receives ``root``'s object (non-root contributions
+        are ignored)."""
+        parts = self.allgather(
+            pickle.dumps(obj) if self.rank == root else b""
+        )
+        return pickle.loads(parts[root])
+
+    def sum_tree(self, tree):
+        """Allgather a pytree of numpy arrays and sum leaf-wise in RANK
+        ORDER — the host-merge drop-in for a device ``psum`` over
+        additive sufficient statistics. Deterministic: every rank
+        computes the identical left-to-right fold, so all ranks hold the
+        same merged stats bit-for-bit."""
+        import jax
+        import numpy as np
+
+        trees = self.allgather_obj(jax.tree.map(np.asarray, tree))
+        out = trees[0]
+        for t in trees[1:]:
+            out = jax.tree.map(lambda a, b: np.add(a, b), out, t)
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            self._server.close()
+
+
+# -- process-wide bootstrap --------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_EXCHANGE: Optional[HostExchange] = None
+_COORDS: Optional[tuple] = None  # (process_id, num_processes)
+
+
+def dist_active() -> bool:
+    """True when this process joined a multi-process run (host exchange
+    up, or the ``jax.distributed`` runtime initialized through here)."""
+    return _EXCHANGE is not None
+
+
+def host_exchange() -> Optional[HostExchange]:
+    """The process's exchange client, or None (single-process)."""
+    return _EXCHANGE
+
+
+def process_index() -> int:
+    """This process's rank — WITHOUT touching jax (a ``jax.process_index``
+    call initializes the XLA backends; see ``multihost``'s caveat)."""
+    if _COORDS is not None:
+        return _COORDS[0]
+    cfg = DistConfig.from_env()
+    return cfg.process_id if cfg is not None else 0
+
+
+def process_count() -> int:
+    if _COORDS is not None:
+        return _COORDS[1]
+    cfg = DistConfig.from_env()
+    return cfg.num_processes if cfg is not None else 1
+
+
+def _want_jax_collectives(cfg: DistConfig) -> bool:
+    mode = cfg.jax_collectives.lower()
+    if mode == "1":
+        return True
+    if mode == "auto":
+        # without initializing a backend, the platform hint is the env:
+        # the CPU backend refuses cross-process collectives (the named
+        # gap), so auto only arms the device path off-CPU
+        plat = os.environ.get("JAX_PLATFORMS", "").lower()
+        return plat not in ("", "cpu")
+    return False
+
+
+def initialize_distributed(
+    config: Optional[DistConfig] = None,
+) -> tuple:
+    """Join the multi-process run this process was launched into.
+
+    Reads :class:`DistConfig` from ``FMRP_DIST_*`` when not given; a
+    missing config is the single-process no-op ``(0, 1)``. Otherwise:
+
+    1. brings up the host exchange (rank 0 embeds the server) — the
+       startup rendezvous doubles as the cluster barrier;
+    2. optionally wires ``jax.distributed.initialize`` through
+       ``multihost.initialize_multihost`` (``FMRP_DIST_JAX``) so device
+       collectives and global meshes work where the backend supports
+       them;
+    3. stamps the telemetry identity (``process_index`` label on metrics
+       and trace meta).
+
+    Idempotent; returns ``(process_index, process_count)``.
+    """
+    global _EXCHANGE, _COORDS
+    with _STATE_LOCK:
+        if _EXCHANGE is not None:
+            return _COORDS
+        cfg = config if config is not None else DistConfig.from_env()
+        if cfg is None:
+            return (0, 1)
+        exchange = HostExchange(cfg)
+        if _want_jax_collectives(cfg):
+            from fm_returnprediction_tpu.parallel.multihost import (
+                initialize_multihost,
+            )
+
+            initialize_multihost(
+                coordinator_address=(
+                    f"{cfg.host}:{cfg.port + 1}"  # device runtime: own port
+                ),
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+            )
+        from fm_returnprediction_tpu.telemetry import identity
+
+        identity.set_process_index(cfg.process_id)
+        _EXCHANGE = exchange
+        _COORDS = (cfg.process_id, cfg.num_processes)
+        return _COORDS
+
+
+def shutdown_distributed() -> None:
+    """Leave the exchange (tests); the jax.distributed runtime — when it
+    was armed — stays up for the process lifetime, as jax requires."""
+    global _EXCHANGE, _COORDS
+    with _STATE_LOCK:
+        if _EXCHANGE is not None:
+            _EXCHANGE.close()
+        _EXCHANGE = None
+        _COORDS = None
+
+
+def apply_cpu_affinity_from_env() -> Optional[set]:
+    """Pin this process to ``FMRP_PROC_CPUS`` ("0-3" or "4,5,6") BEFORE
+    jax initializes — XLA's CPU thread pools size themselves from the
+    schedulable-CPU count, so affinity is the one knob that bounds both
+    scheduling and pool width. This is how a one-box bench models the
+    pod's fixed-compute-per-process story (each worker = one "host" of K
+    cores); unset = no pinning. Returns the applied set, or None."""
+    spec = os.environ.get("FMRP_PROC_CPUS", "").strip()
+    if not spec or not hasattr(os, "sched_setaffinity"):
+        return None
+    cpus: set = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            cpus.update(range(int(lo), int(hi) + 1))
+        elif part:
+            cpus.add(int(part))
+    if not cpus:
+        return None
+    os.sched_setaffinity(0, cpus)
+    return cpus
+
+
+def run_rounds(handler: Callable[[dict], Optional[dict]]) -> None:
+    """Worker-side job loop over the exchange: rank 0 broadcasts job
+    dicts; ``handler(job)`` runs each one; a ``{"op": "stop"}`` job ends
+    the loop. (The spec-grid worker pool's protocol — kept here so the
+    pool and any future worker kind share one loop shape.)"""
+    ex = host_exchange()
+    if ex is None:
+        raise DistributedError("run_rounds needs an initialized exchange")
+    while True:
+        job = ex.broadcast_obj(None, root=0)
+        if not isinstance(job, dict) or job.get("op") == "stop":
+            return
+        handler(job)

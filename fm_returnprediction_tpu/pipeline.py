@@ -1038,11 +1038,24 @@ def _main() -> None:
              "FMRP_FLEET_RATE/_BURST/_SHED_OCCUPANCY shape admission, "
              "FMRP_FLEET_JOURNAL arms the request journal",
     )
+    parser.add_argument(
+        "--replica-mode", choices=("thread", "process"), default=None,
+        help="fleet smoke replica boundary: in-process threads or "
+             "spawned child processes behind the socket transport; "
+             "default follows FMRP_FLEET_REPLICA_MODE (thread)",
+    )
     args = parser.parse_args()
 
+    from fm_returnprediction_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
     from fm_returnprediction_tpu.settings import apply_backend, enable_compilation_cache
 
+    # join a multi-process run when FMRP_DIST_* is set (host exchange +
+    # telemetry identity; optionally jax.distributed per FMRP_DIST_JAX) —
+    # a no-op otherwise, and it must precede any backend init
+    initialize_distributed()
     initialize_multihost()  # no-op unless FMRP_MULTIHOST=1; must precede backend init
     apply_backend(args.backend)
     enable_compilation_cache()
@@ -1095,6 +1108,7 @@ def _main() -> None:
                 smoke = fleet_smoke(
                     result.serving_state, fleet_size,
                     registry_dir=args.registry_dir,
+                    replica_mode=args.replica_mode,
                 )
                 print()
                 print("serving fleet smoke: "

@@ -49,9 +49,48 @@ REGISTRY_ENV = "FMRP_REGISTRY_DIR"
 SCHEMA_VERSION = 1
 
 META_FILE = "meta.json"
+LOCK_FILE = ".publish.lock"
 _EXE_DIRNAME = "executables"
 _ART_DIRNAME = "artifacts"
 _PREPARED_DIRNAME = "prepared"
+
+
+class _publish_lock:
+    """Advisory, blocking, cross-process exclusive lock on one entry
+    directory (``fcntl.flock``; auto-released on close AND on process
+    death). The lock file is a SIBLING of the entry
+    (``.<entry>.publish.lock``), not inside it: ``drop()``/``gc()``
+    rmtree entry dirs, and an in-dir lock would let delete+recreate mint
+    a fresh inode while a publisher still holds the old one — two
+    writers holding "the" lock at once. A sibling inode survives entry
+    deletion, so publishers and maintenance serialize on one file.
+    Dot-prefixed and a plain file, so entry scans (directories) never
+    see it."""
+
+    def __init__(self, entry_dir: Path):
+        entry_dir = Path(entry_dir)
+        self._path = entry_dir.parent / f".{entry_dir.name}{LOCK_FILE}"
+        self._fh = None
+
+    def __enter__(self) -> "_publish_lock":
+        try:
+            import fcntl
+        except ImportError:  # non-POSIX: historical unlocked protocol
+            return self
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self._path, "a+")
+        fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._fh is not None:
+            try:
+                import fcntl
+
+                fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            finally:
+                self._fh.close()
+                self._fh = None
 
 
 def registry_dir() -> Optional[Path]:
@@ -192,24 +231,40 @@ class Registry:
     def _publish_entry(self, entry_dir: Path, payload_names, emit,
                        meta: dict) -> Path:
         """The ONE crash-consistency protocol both entry writers share:
-        reserved-name guard, meta invalidation BEFORE payloads, per-file
-        tmp+rename, manifest-bearing meta LAST."""
+        reserved-name guard, advisory cross-PROCESS publish lock, meta
+        invalidation BEFORE payloads, per-file tmp+rename,
+        manifest-bearing meta LAST.
+
+        The lock (``fcntl.flock`` on the entry's sibling
+        ``.<entry>.publish.lock``) serializes concurrent publishers: N
+        processes warming the same
+        registry simultaneously — the multi-process spec-grid workers,
+        fleet replica spawns — would otherwise interleave their per-file
+        renames and publish file A from one writer under file B's
+        manifest (a half-renamed entry a reader sees as corruption).
+        Readers need no lock: meta is still written last, so mid-publish
+        they observe an ABSENT entry (degrade to a fresh compile), never
+        a torn one. Advisory flocks release on process death, so a
+        crashed publisher cannot wedge the registry; on platforms
+        without ``fcntl`` the lock degrades to the historical unlocked
+        protocol."""
         if META_FILE in payload_names:
             raise ValueError(f"payload name {META_FILE!r} is reserved")
         entry_dir = Path(entry_dir)
         entry_dir.mkdir(parents=True, exist_ok=True)
-        meta_path = entry_dir / META_FILE
-        meta_path.unlink(missing_ok=True)  # invalidate before payloads
-        written = emit(entry_dir)
-        meta = dict(meta)
-        meta["schema"] = SCHEMA_VERSION
-        meta["manifest"] = integrity.build_manifest(written)
-        tmp = entry_dir / f".{META_FILE}.tmp-{os.getpid()}"
-        try:
-            tmp.write_text(json.dumps(meta, sort_keys=True))
-            os.replace(tmp, meta_path)
-        finally:
-            tmp.unlink(missing_ok=True)
+        with _publish_lock(entry_dir):
+            meta_path = entry_dir / META_FILE
+            meta_path.unlink(missing_ok=True)  # invalidate before payloads
+            written = emit(entry_dir)
+            meta = dict(meta)
+            meta["schema"] = SCHEMA_VERSION
+            meta["manifest"] = integrity.build_manifest(written)
+            tmp = entry_dir / f".{META_FILE}.tmp-{os.getpid()}"
+            try:
+                tmp.write_text(json.dumps(meta, sort_keys=True))
+                os.replace(tmp, meta_path)
+            finally:
+                tmp.unlink(missing_ok=True)
         return entry_dir
 
     def read_meta(self, entry_dir: Path) -> Optional[dict]:
@@ -335,10 +390,14 @@ class Registry:
 
     def drop(self, entry_dir: Path) -> None:
         """Remove one entry (meta first, so a concurrent reader sees an
-        absent entry rather than payload-less meta)."""
+        absent entry rather than payload-less meta). Serialized on the
+        entry's publish lock: deleting the dir out from under a
+        mid-publish writer would both fail its emit and — were the lock
+        inside the dir — hand the lock's identity to the next writer."""
         entry_dir = Path(entry_dir)
-        (entry_dir / META_FILE).unlink(missing_ok=True)
-        shutil.rmtree(entry_dir, ignore_errors=True)
+        with _publish_lock(entry_dir):
+            (entry_dir / META_FILE).unlink(missing_ok=True)
+            shutil.rmtree(entry_dir, ignore_errors=True)
 
     def gc(self, keep: int = 4, drop_skewed: bool = False,
            dry_run: bool = False) -> List[dict]:

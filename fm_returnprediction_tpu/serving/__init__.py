@@ -64,6 +64,10 @@ from fm_returnprediction_tpu.serving.loadgen import (
     capacity_model,
     query_with_retry,
 )
+from fm_returnprediction_tpu.serving.replica_proc import (
+    ProcessReplica,
+    ReplicaSpawnError,
+)
 from fm_returnprediction_tpu.serving.recovery import (
     RecoveryReport,
     recover_journal,
@@ -113,4 +117,6 @@ __all__ = [
     "RecoveryReport",
     "recover_journal",
     "repair_journal",
+    "ProcessReplica",
+    "ReplicaSpawnError",
 ]

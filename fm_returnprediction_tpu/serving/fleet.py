@@ -58,7 +58,21 @@ from inside the submit path), ``fleet.hard_crash`` (abandon the fleet the
 way a process death would), ``fleet.journal_torn_tail`` (tear the
 journal's final line as the handle drops).
 
+- **Process replicas (ISSUE 13).** ``replica_mode="process"``
+  (``FMRP_FLEET_REPLICA_MODE``) promotes the replica boundary to a REAL
+  process: each replica is a spawned child owning its own ``ERService``
+  behind a length-prefixed socket transport
+  (``serving.replica_proc``/``replica_worker``), spawned warm through the
+  registry (fork + ``warm_from_registry``, WarmReport evidence in the
+  hello). The WAL journal stays with the router, so the exactly-once
+  replay proof survives a replica *process* SIGKILL; the supervisor's
+  stats probe doubles as the wire heartbeat (a dead child cannot answer
+  → ``heartbeat:stats-raised`` → kill → warm replacement). Routing,
+  admission, rollover and recovery code paths are IDENTICAL in both
+  modes — process count is a deployment knob, not an architecture.
+
 Knobs: ``FMRP_FLEET_SIZE`` (default replica count),
+``FMRP_FLEET_REPLICA_MODE`` (``thread``/``process`` replica boundary),
 ``FMRP_FLEET_RATE``/``FMRP_FLEET_BURST`` (admission token bucket),
 ``FMRP_FLEET_SHED_OCCUPANCY`` (queue-occupancy shed threshold),
 ``FMRP_FLEET_JOURNAL`` (journal path), ``FMRP_FLEET_JOURNAL_KEEP``
@@ -302,12 +316,35 @@ class ServingFleet:
         vnodes: int = 64,
         probe_interval_s: Optional[float] = None,
         admission_clock=time.monotonic,
+        replica_mode: Optional[str] = None,
         **service_kwargs,
     ):
         if n_replicas is None:
             n_replicas = int(os.environ.get("FMRP_FLEET_SIZE", "2"))
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        # replica boundary: "thread" (historical in-process replicas) or
+        # "process" (spawned children behind the length-prefixed socket
+        # transport, serving.replica_proc) — a DEPLOYMENT knob: routing,
+        # admission, journaling, supervision and rollover are identical
+        # either side of it
+        if replica_mode is None:
+            replica_mode = os.environ.get(
+                "FMRP_FLEET_REPLICA_MODE", "thread"
+            ).strip().lower() or "thread"
+        if replica_mode not in ("thread", "process"):
+            raise ValueError(
+                f"replica_mode {replica_mode!r} is not 'thread'|'process'"
+            )
+        self.replica_mode = replica_mode
+        self._proc_scratch = None
+        if replica_mode == "process":
+            import tempfile
+            from pathlib import Path as _Path
+
+            self._proc_scratch = _Path(
+                tempfile.mkdtemp(prefix="fmrp_fleet_proc_")
+            )
         self.state = state
         self.version = 0          # bumped by every committed rollover
         self._registry_dir = registry_dir
@@ -395,8 +432,27 @@ class ServingFleet:
         else:
             self.brownout = None
         self._crashed = False
-        for _ in range(n_replicas):
-            self._add_replica()
+        try:
+            for _ in range(n_replicas):
+                self._add_replica()
+        except Exception:
+            # a spawn failure mid-loop must not leak what already
+            # started — in process mode those are REAL child processes
+            # (and a scratch tree) the caller has no handle to reap
+            with self._lock:
+                reps = list(self._replicas.values())
+            for rep in reps:
+                try:
+                    rep.service.kill("fleet start aborted")
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+            if self._proc_scratch is not None:
+                from fm_returnprediction_tpu.serving.replica_proc import (
+                    cleanup_scratch,
+                )
+
+                cleanup_scratch(self._proc_scratch)
+            raise
         self._update_gauges()
         # the journal doubles as the fleet's topology record: size-carrying
         # marks (here, scale_out/scale_in/retire) are what crash-restart
@@ -440,6 +496,23 @@ class ServingFleet:
             from fm_returnprediction_tpu.registry.store import registry_dir
 
             reg_dir = registry_dir()
+        if self.replica_mode == "process":
+            # the replica is a spawned CHILD: warm-pool spawn happens in
+            # the child (fork + warm_from_registry), its WarmReport rides
+            # back in the hello — same zero-compile evidence, one process
+            # boundary over
+            from fm_returnprediction_tpu.serving.replica_proc import (
+                ProcessReplica,
+            )
+
+            service = ProcessReplica(
+                rid, state, scratch=self._proc_scratch,
+                service_kwargs=self._service_kwargs,
+                registry_dir=reg_dir,
+            )
+            if service.warm_report is not None:
+                self.warm_reports[rid] = service.warm_report
+            return service
         if reg_dir is not None:
             from fm_returnprediction_tpu.registry.warm import (
                 warm_from_registry,
@@ -1230,6 +1303,12 @@ class ServingFleet:
                     rep.service.kill("hard crash")
                 except Exception:  # noqa: BLE001 — a corpse is a corpse
                     pass
+        if self._proc_scratch is not None:
+            from fm_returnprediction_tpu.serving.replica_proc import (
+                cleanup_scratch,
+            )
+
+            cleanup_scratch(self._proc_scratch)
 
     @classmethod
     def recover(cls, journal, registry_dir=None, state=None,
@@ -1334,6 +1413,12 @@ class ServingFleet:
                 rep.service.close()
         if self.journal is not None and self._own_journal:
             self.journal.close()
+        if self._proc_scratch is not None:
+            from fm_returnprediction_tpu.serving.replica_proc import (
+                cleanup_scratch,
+            )
+
+            cleanup_scratch(self._proc_scratch)
 
     def __enter__(self) -> "ServingFleet":
         return self

@@ -1,0 +1,434 @@
+"""Process replicas: the serving fleet's replica boundary as a REAL
+process behind a length-prefixed socket transport.
+
+Thread replicas (the historical mode) share the router's address space,
+so a "replica death" is a cooperative fiction. This module promotes the
+boundary: each replica is a spawned Python process owning its own
+``ERService`` (microbatcher + bucketed executor + SLO monitor), and the
+router talks to it over the repo's one wire format — 8-byte big-endian
+length-prefixed pickle frames (``parallel.distributed.send_frame``), the
+same framing the host-merge exchange uses. Trusted intra-cluster links
+only, the registry's pickled-executable stance.
+
+Protocol (every request frame carries a correlation ``id``):
+
+========== ================================================================
+``hello``   child → parent once the service is WARM: rid, pid, the
+            ``WarmReport`` evidence dict (warm-pool spawn = fork +
+            ``warm_from_registry``, zero compiles with a populated
+            registry), or ``ok=False`` + error on a failed start.
+``submit``  parent → child; the child answers TWICE: an immediate
+            ``accept``/``reject`` (``reject`` carries the child batcher's
+            own ``QueueFullError`` evidence, or the pickled synchronous
+            exception — backpressure semantics stay EXACTLY the thread
+            mode's), then a ``result`` when the inner future resolves.
+``stats``   one round trip to the child's ``ERService.stats()`` — this IS
+            the supervisor's heartbeat: a dead process cannot answer, the
+            probe raises, and the existing ``heartbeat:stats-raised`` →
+            kill → warm replace machinery runs unchanged.
+``drain``   pump the child's batcher dry (``flush_all`` in process mode).
+``prepare`` / ``commit``  the two-phase rollover verbs: the candidate
+            state ships as a ``ServingState`` bundle on the shared
+            filesystem; the child warms phase 1, flips phase 2.
+``close``   graceful shutdown (drain, close, exit 0).
+========== ================================================================
+
+The WAL journal stays in the ROUTER: admits/routes/requeues/terminals are
+journaled parent-side exactly as before, so ``replay_journal``'s
+exactly-once proof now covers a replica PROCESS kill — a SIGKILLed child
+drops its socket, the reader thread fails every in-flight future with
+``ReplicaDeadError``, and the fleet's requeue machinery re-routes them
+(``tests/test_multiprocess.py`` kills a live child and asserts the replay
+is clean).
+
+Parent-side backpressure visibility: ``queue_depth`` is the count of
+routed-but-unresolved requests (no RPC on the admission hot path — the
+fleet's ``_queue_snapshot`` runs under the fleet lock); the authoritative
+ceiling rides back on every ``reject``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+from concurrent.futures import Future
+from pathlib import Path
+from typing import Dict, Optional
+
+from fm_returnprediction_tpu.parallel.distributed import (
+    recv_frame,
+    send_frame,
+)
+from fm_returnprediction_tpu.registry.warm import WarmReport
+from fm_returnprediction_tpu.resilience.errors import ReplicaDeadError
+from fm_returnprediction_tpu.serving.batcher import QueueFullError
+
+__all__ = ["ProcessReplica", "ReplicaSpawnError"]
+
+_STATE_ATTR = "_fmrp_proc_bundle"
+
+
+class ReplicaSpawnError(RuntimeError):
+    """A replica child failed to start (handshake timeout, bad hello)."""
+
+
+def _ship_state(state, scratch: Path) -> Path:
+    """The state bundle path every process replica of this version loads —
+    written ONCE per state object (cached on the object itself: the fleet
+    spawns N replicas and M failover replacements from the same version,
+    and a rollover candidate prepares on every replica)."""
+    cached = getattr(state, _STATE_ATTR, None)
+    if cached is not None and Path(cached).exists():
+        return Path(cached)
+    scratch.mkdir(parents=True, exist_ok=True)
+    fd, name = tempfile.mkstemp(suffix=".npz", prefix="state_",
+                                dir=str(scratch))
+    os.close(fd)
+    path = state.save(name)
+    try:
+        object.__setattr__(state, _STATE_ATTR, str(path))
+    except (AttributeError, TypeError):
+        pass  # a slotted/frozen state just re-ships per spawn
+    return Path(path)
+
+
+class _RemoteBatcher:
+    """The slice of the ``MicroBatcher`` surface the fleet reads on a
+    replica it does not own: ``queue_depth`` (parent-side in-flight
+    count — the admission snapshot must not RPC under the fleet lock),
+    ``max_queue`` (from the spawn config), ``drain()`` (one RPC), and
+    ``_thread`` (None: the flusher lives in the child; the supervisor's
+    liveness check is the stats round trip itself)."""
+
+    _thread = None
+
+    def __init__(self, owner: "ProcessReplica", max_queue: int):
+        self._owner = owner
+        self.max_queue = int(max_queue)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._owner.inflight
+
+    def drain(self) -> int:
+        try:
+            return int(self._owner._call("drain"))
+        except ReplicaDeadError:
+            return 0  # a corpse has nothing left to pump
+
+
+class ProcessReplica:
+    """Parent-side handle on one spawned replica process, mirroring the
+    ``ERService`` surface the fleet drives: ``submit`` (sync
+    ``QueueFullError``/``RuntimeError`` semantics preserved via the
+    accept/reject round), ``stats``, ``kill``, ``close``,
+    ``prepare_state``/``commit_state``, ``batcher.{queue_depth,max_queue,
+    drain}``. ``slo`` is None parent-side — the monitor lives in the
+    child and its verdict rides back in ``stats()['slo_state']``, which
+    is what the supervisor's probe keys off."""
+
+    slo = None
+
+    def __init__(self, rid: str, state, *, scratch: Path,
+                 service_kwargs: Optional[dict] = None,
+                 registry_dir=None,
+                 spawn_timeout_s: float = 180.0,
+                 call_timeout_s: float = 120.0):
+        self.replica_id = rid
+        self._call_timeout_s = float(call_timeout_s)
+        self._dead: Optional[str] = None
+        self._wlock = threading.Lock()
+        self._idlock = threading.Lock()
+        self._next_id = 0
+        # id → {"kind": "call"|"submit", "future": Future, "accept": Future}
+        self._pending: Dict[int, dict] = {}
+        kwargs = dict(service_kwargs or {})
+        kwargs.pop("metric_labels", None)  # the child stamps its own
+        max_queue = int(kwargs.get("max_queue", 1024))
+        self.batcher = _RemoteBatcher(self, max_queue)
+        scratch = Path(scratch)
+        state_path = _ship_state(state, scratch)
+        listener = socket.create_server(("127.0.0.1", 0))
+        listener.settimeout(spawn_timeout_s)
+        port = listener.getsockname()[1]
+        cfg = {
+            "rid": rid,
+            "port": port,
+            "state_path": str(state_path),
+            "registry_dir": str(registry_dir) if registry_dir else None,
+            "service_kwargs": kwargs,
+        }
+        fd, cfg_path = tempfile.mkstemp(suffix=".pkl", prefix=f"{rid}_cfg_",
+                                        dir=str(scratch))
+        with os.fdopen(fd, "wb") as fh:
+            pickle.dump(cfg, fh)
+        self.log_path = scratch / f"{rid}.log"
+        env = dict(os.environ)
+        # the parent's virtual-device harness flag must not leak — a
+        # replica needs one device, not a forced eight (the worker-pool
+        # rule, one subsystem over)
+        env.pop("XLA_FLAGS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            import jax
+
+            env["JAX_ENABLE_X64"] = "1" if jax.config.jax_enable_x64 else "0"
+        except Exception:  # noqa: BLE001 — jax not imported yet: inherit
+            pass
+        # per-process telemetry identity: the replica's exports label
+        # themselves process_index=<k> (identity.py's generic knob)
+        digits = "".join(c for c in rid if c.isdigit())
+        env["FMRP_PROC_INDEX"] = digits or "0"
+        repo_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        self._log_fh = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "fm_returnprediction_tpu.serving.replica_worker", cfg_path],
+            env=env, stdout=self._log_fh, stderr=subprocess.STDOUT,
+        )
+        try:
+            conn, _ = listener.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(spawn_timeout_s)
+            hello = pickle.loads(recv_frame(conn))
+        except (socket.timeout, OSError, EOFError) as exc:
+            self.proc.kill()
+            raise ReplicaSpawnError(
+                f"replica {rid} never said hello within {spawn_timeout_s}s "
+                f"({exc!r}); log: {self._log_tail()}"
+            ) from exc
+        finally:
+            listener.close()
+        if not hello.get("ok"):
+            self.proc.kill()
+            raise ReplicaSpawnError(
+                f"replica {rid} failed to start: {hello.get('error')}; "
+                f"log: {self._log_tail()}"
+            )
+        conn.settimeout(None)
+        self._sock = conn
+        self.pid = int(hello["pid"])
+        warm = hello.get("warm")
+        self.warm_report: Optional[WarmReport] = (
+            WarmReport(**{**warm, "programs": tuple(warm["programs"])})
+            if warm is not None else None
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"fmrp-replica-{rid}", daemon=True
+        )
+        self._reader.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _log_tail(self, n: int = 2000) -> str:
+        try:
+            data = Path(self.log_path).read_bytes()
+            return data[-n:].decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    @property
+    def inflight(self) -> int:
+        with self._idlock:
+            return sum(1 for e in self._pending.values()
+                       if e["kind"] == "submit")
+
+    def _send(self, msg: dict) -> None:
+        if self._dead is not None:
+            raise ReplicaDeadError(self._dead)
+        try:
+            send_frame(self._sock, pickle.dumps(msg), self._wlock)
+        except OSError as exc:
+            self._mark_dead(f"replica {self.replica_id} socket write "
+                            f"failed: {exc!r}")
+            raise ReplicaDeadError(self._dead) from exc
+
+    def _register(self, kind: str) -> dict:
+        with self._idlock:
+            self._next_id += 1
+            entry = {"id": self._next_id, "kind": kind,
+                     "future": Future(), "accept": Future()}
+            self._pending[self._next_id] = entry
+            return entry
+
+    def _mark_dead(self, why: str) -> None:
+        with self._idlock:
+            if self._dead is not None:
+                return
+            self._dead = why
+            pending = list(self._pending.values())
+            self._pending.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            self._log_fh.close()
+        except OSError:
+            pass
+        # fail the in-flight work OUTSIDE the lock: the futures'
+        # done-callbacks are the fleet's requeue path, which re-enters
+        # submit on another replica
+        exc = ReplicaDeadError(why)
+        for e in pending:
+            if not e["accept"].done():
+                e["accept"].set_exception(exc)
+            if not e["future"].done():
+                e["future"].set_exception(exc)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = pickle.loads(recv_frame(self._sock))
+                op = msg.get("op")
+                with self._idlock:
+                    entry = self._pending.get(msg.get("id"))
+                if entry is None:
+                    continue
+                if op == "accept":
+                    entry["accept"].set_result(None)
+                elif op == "reject":
+                    with self._idlock:
+                        self._pending.pop(entry["id"], None)
+                    entry["accept"].set_exception(self._reject_exc(msg))
+                elif op == "result":
+                    with self._idlock:
+                        self._pending.pop(entry["id"], None)
+                    if not entry["accept"].done():
+                        entry["accept"].set_result(None)
+                    if msg.get("ok"):
+                        entry["future"].set_result(msg.get("value"))
+                    else:
+                        entry["future"].set_exception(
+                            self._unpickle_exc(msg)
+                        )
+        except Exception as exc:  # noqa: BLE001 — EOF/OSError: child died
+            self._mark_dead(
+                f"replica {self.replica_id} process died "
+                f"(transport: {type(exc).__name__})"
+            )
+
+    @staticmethod
+    def _reject_exc(msg: dict) -> BaseException:
+        kind = msg.get("kind")
+        if kind == "queue_full":
+            return QueueFullError(
+                msg.get("message", "replica queue full"),
+                queue_depth=msg.get("queue_depth"),
+                max_queue=msg.get("max_queue"),
+            )
+        if kind == "closed":
+            return RuntimeError(msg.get("message", "batcher is closed"))
+        return ProcessReplica._unpickle_exc(msg)
+
+    @staticmethod
+    def _unpickle_exc(msg: dict) -> BaseException:
+        blob = msg.get("exc")
+        if blob is not None:
+            try:
+                exc = pickle.loads(blob)
+                if isinstance(exc, BaseException):
+                    return exc
+            except Exception:  # noqa: BLE001 — fall through to repr
+                pass
+        return RuntimeError(msg.get("error", "replica-side failure"))
+
+    # -- the ERService mirror ----------------------------------------------
+
+    def submit(self, month, x) -> Future:
+        """Async query via the child. Synchronous-raise semantics match
+        the in-process service: ``QueueFullError`` under child
+        backpressure, ``RuntimeError`` when the child batcher is closed,
+        the child's own synchronous exception (e.g. ``KeyError`` for an
+        unknown month) re-raised here; a dead process raises
+        ``RuntimeError`` (the fleet's replica_closed requeue signal)."""
+        if self._dead is not None:
+            raise RuntimeError(f"replica process is dead: {self._dead}")
+        entry = self._register("submit")
+        try:
+            self._send({"op": "submit", "id": entry["id"],
+                        "month": month, "x": x})
+            entry["accept"].result(timeout=self._call_timeout_s)
+        except ReplicaDeadError as exc:
+            with self._idlock:
+                self._pending.pop(entry["id"], None)
+            raise RuntimeError(f"replica process is dead: {exc}") from exc
+        except BaseException:
+            with self._idlock:
+                self._pending.pop(entry["id"], None)
+            raise
+        return entry["future"]
+
+    def _call(self, op: str, timeout: Optional[float] = None, **fields):
+        """One synchronous round trip (stats/drain/prepare/commit/close)."""
+        if self._dead is not None:
+            raise ReplicaDeadError(self._dead)
+        entry = self._register("call")
+        try:
+            self._send({"op": op, "id": entry["id"], **fields})
+            return entry["future"].result(
+                timeout=timeout if timeout is not None
+                else self._call_timeout_s
+            )
+        finally:
+            with self._idlock:
+                self._pending.pop(entry["id"], None)
+
+    def stats(self) -> dict:
+        out = dict(self._call("stats"))
+        out["proc_pid"] = self.pid
+        out["proc_inflight"] = self.inflight
+        return out
+
+    def prepare_state(self, new_state):
+        """Phase 1 over the wire: ship the candidate bundle, the child
+        builds + fully warms its executor without publishing. The token
+        is child-side; the parent's opaque handle is just the rid."""
+        path = _ship_state(new_state, Path(self.log_path).parent)
+        self._call("prepare", state_path=str(path))
+        return ("proc-prepared", self.replica_id)
+
+    def commit_state(self, prepared) -> None:
+        self._call("commit")
+
+    def kill(self, reason: str = "replica killed") -> int:
+        """Abrupt death: SIGKILL the child. In-flight requests fail with
+        ``ReplicaDeadError`` (the fleet requeues on that signal); returns
+        how many were stranded."""
+        stranded = self.inflight
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        self._mark_dead(f"replica {self.replica_id} killed: {reason}")
+        return stranded
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Graceful: ask the child to drain + close + exit; escalate to
+        kill if it does not comply in time."""
+        if self._dead is None:
+            try:
+                self._call("close", timeout=timeout)
+            except Exception:  # noqa: BLE001 — already dying is fine
+                pass
+        try:
+            self.proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10.0)
+        self._mark_dead(f"replica {self.replica_id} closed")
+
+
+def cleanup_scratch(scratch: Optional[Path]) -> None:
+    """Best-effort removal of a fleet's process-mode scratch tree."""
+    if scratch is not None:
+        shutil.rmtree(scratch, ignore_errors=True)

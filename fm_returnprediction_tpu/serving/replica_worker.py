@@ -1,0 +1,167 @@
+"""Spawned fleet-replica child (``serving.replica_proc``'s other end).
+
+Usage: ``python -m fm_returnprediction_tpu.serving.replica_worker
+<config.pkl>``. Loads its ``ServingState`` bundle from the shared
+filesystem, builds the replica service — through the registry warm pool
+when the spawn config arms one (fork + ``warm_from_registry`` = zero
+process-local compiles, the ``WarmReport`` shipped back in ``hello`` as
+evidence) — and answers the router's verbs over the length-prefixed
+socket until ``close`` or parent EOF.
+
+Exactly-once discipline: this process journals NOTHING. The WAL journal
+belongs to the router; a SIGKILL here tears the socket, the parent fails
+the in-flight futures with ``ReplicaDeadError``, and the fleet requeues —
+which is precisely what makes the replay-clean proof hold across a
+replica *process* death.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import sys
+import threading
+from dataclasses import asdict
+
+
+def _serve(cfg: dict) -> None:
+    from fm_returnprediction_tpu.parallel.distributed import (
+        recv_frame,
+        send_frame,
+    )
+
+    rid = cfg["rid"]
+    sock = socket.create_connection(("127.0.0.1", int(cfg["port"])),
+                                    timeout=120.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    wlock = threading.Lock()
+
+    def send(msg: dict) -> None:
+        send_frame(sock, pickle.dumps(msg), wlock)
+
+    try:
+        from fm_returnprediction_tpu.serving.fleet import _ReplicaService
+        from fm_returnprediction_tpu.serving.state import ServingState
+
+        state = ServingState.load(cfg["state_path"])
+        kwargs = dict(cfg.get("service_kwargs") or {})
+        kwargs["metric_labels"] = {"replica": rid}
+        kwargs["replica_id"] = rid
+        reg_dir = cfg.get("registry_dir")
+        warm = None
+        if reg_dir:
+            from fm_returnprediction_tpu.registry.warm import (
+                warm_from_registry,
+            )
+
+            service, report = warm_from_registry(
+                state=state, registry_dir=reg_dir,
+                service_cls=_ReplicaService, **kwargs,
+            )
+            warm = asdict(report)
+        else:
+            service = _ReplicaService(state, **kwargs)
+    except Exception as exc:  # noqa: BLE001 — the parent needs the why
+        send({"op": "hello", "ok": False, "error": repr(exc)[:500]})
+        raise
+    send({"op": "hello", "ok": True, "rid": rid, "pid": os.getpid(),
+          "warm": warm})
+
+    prepared = {}  # one slot: the fleet serializes rollovers
+
+    def on_done(req_id: int, inner) -> None:
+        exc = inner.exception()
+        if exc is None:
+            send({"op": "result", "id": req_id, "ok": True,
+                  "value": float(inner.result())})
+        else:
+            try:
+                blob = pickle.dumps(exc)
+            except Exception:  # noqa: BLE001 — unpicklable: repr travels
+                blob = None
+            send({"op": "result", "id": req_id, "ok": False,
+                  "exc": blob, "error": repr(exc)[:300]})
+
+    while True:
+        try:
+            msg = pickle.loads(recv_frame(sock))
+        except Exception:  # noqa: BLE001 — parent gone: die quietly
+            break
+        op, req_id = msg.get("op"), msg.get("id")
+        if op == "submit":
+            from fm_returnprediction_tpu.serving.batcher import (
+                QueueFullError,
+            )
+
+            try:
+                inner = service.submit(msg["month"], msg["x"])
+            except QueueFullError as qe:
+                send({"op": "reject", "id": req_id, "kind": "queue_full",
+                      "message": str(qe), "queue_depth": qe.queue_depth,
+                      "max_queue": qe.max_queue})
+                continue
+            except RuntimeError as exc:
+                send({"op": "reject", "id": req_id, "kind": "closed",
+                      "message": str(exc)})
+                continue
+            except Exception as exc:  # noqa: BLE001 — sync raise travels
+                try:
+                    blob = pickle.dumps(exc)
+                except Exception:  # noqa: BLE001
+                    blob = None
+                send({"op": "reject", "id": req_id, "kind": "error",
+                      "exc": blob, "error": repr(exc)[:300]})
+                continue
+            send({"op": "accept", "id": req_id})
+            inner.add_done_callback(
+                lambda fut, i=req_id: on_done(i, fut)
+            )
+            continue
+        try:
+            if op == "stats":
+                value = service.stats()
+            elif op == "drain":
+                value = service.batcher.drain()
+            elif op == "prepare":
+                from fm_returnprediction_tpu.serving.state import (
+                    ServingState as _SS,
+                )
+
+                candidate = _SS.load(msg["state_path"])
+                prepared["slot"] = service.prepare_state(candidate)
+                value = int(candidate.n_months)
+            elif op == "commit":
+                service.commit_state(prepared.pop("slot"))
+                value = True
+            elif op == "ping":
+                value = "pong"
+            elif op == "close":
+                service.close()
+                send({"op": "result", "id": req_id, "ok": True,
+                      "value": True})
+                break
+            else:
+                raise ValueError(f"unknown verb {op!r}")
+            send({"op": "result", "id": req_id, "ok": True, "value": value})
+        except Exception as exc:  # noqa: BLE001 — verbs fail loudly
+            try:
+                blob = pickle.dumps(exc)
+            except Exception:  # noqa: BLE001
+                blob = None
+            send({"op": "result", "id": req_id, "ok": False,
+                  "exc": blob, "error": repr(exc)[:300]})
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def main() -> None:
+    with open(sys.argv[1], "rb") as fh:
+        cfg = pickle.load(fh)
+    _serve(cfg)
+
+
+if __name__ == "__main__":
+    main()

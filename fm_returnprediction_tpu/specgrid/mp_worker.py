@@ -1,0 +1,22 @@
+"""Spawned spec-grid contraction worker (``specgrid.multiproc``'s child).
+
+Usage: ``python -m fm_returnprediction_tpu.specgrid.mp_worker <paneldir>``
+with ``FMRP_DIST_*`` in the environment (the pool spawns it via
+``parallel.distributed.worker_env``). Joins the host exchange as rank
+1..procs, loads its contiguous firm shard from the shared scratch
+directory, and answers contract jobs until the parent broadcasts stop.
+"""
+
+import sys
+
+from fm_returnprediction_tpu.parallel.distributed import (
+    apply_cpu_affinity_from_env,
+)
+
+if __name__ == "__main__":
+    # BEFORE any jax init: the affinity bounds XLA's thread pools (the
+    # fixed-compute-per-process knob the pool's cpus_per_worker sets)
+    apply_cpu_affinity_from_env()
+    from fm_returnprediction_tpu.specgrid.multiproc import worker_main
+
+    worker_main(sys.argv[1])

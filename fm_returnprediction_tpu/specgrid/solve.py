@@ -422,6 +422,7 @@ def run_spec_grid(
     referee: bool = True,
     firm_chunk: Optional[int] = None,
     mesh=None,
+    procs: Optional[int] = None,
     row_weights=None,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
@@ -441,7 +442,7 @@ def run_spec_grid(
     """
     return run_spec_grid_weights(
         y, x, universe_masks, grid, (grid.weight,),
-        referee=referee, firm_chunk=firm_chunk, mesh=mesh,
+        referee=referee, firm_chunk=firm_chunk, mesh=mesh, procs=procs,
         row_weights=row_weights, gram_route=gram_route, precision=precision,
     )[grid.weight]
 
@@ -455,6 +456,7 @@ def run_spec_grid_weights(
     referee: bool = True,
     firm_chunk: Optional[int] = None,
     mesh=None,
+    procs: Optional[int] = None,
     row_weights=None,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
@@ -484,12 +486,34 @@ def run_spec_grid_weights(
     """
     gram_route = resolve_gram_route(gram_route)
     precision = resolve_gram_precision(precision)
+    from fm_returnprediction_tpu.specgrid.multiproc import (
+        resolve_specgrid_procs,
+    )
+
+    procs = resolve_specgrid_procs(procs)
+    if mesh is not None and procs > 1:
+        raise ValueError(
+            "mesh= and procs>1 are mutually exclusive sharding stories: "
+            "the mesh spans devices in one process, FMRP_SPECGRID_PROCS "
+            "spans processes — pick one per run"
+        )
     if mesh is not None and precision == "bf16":
         raise ValueError(
             "precision='bf16' is a single-device route; the mesh path's "
             "psum merge of bf16-floored stats is not refereed yet"
         )
+    if procs > 1 and precision == "bf16":
+        raise ValueError(
+            "precision='bf16' is a single-process route; the host-side "
+            "merge of bf16-floored shard stats is not refereed yet (the "
+            "mesh rule, one process boundary up)"
+        )
     names = list(universe_masks)
+    # the multi-process route keys its persistent worker pool on the
+    # CALLER'S array identities — captured before the jnp conversions
+    # below mint fresh objects every call
+    raw_y, raw_x, raw_rw = y, x, row_weights
+    raw_universes = tuple(universe_masks[nm] for nm in names)
     y = jnp.asarray(y)
     x = jnp.asarray(x)
     universes = _universe_stack(universe_masks, names)
@@ -525,6 +549,22 @@ def run_spec_grid_weights(
         out = sharded_grid_parts(
             y, x, universes, uidx, col_sel, jnp.asarray(window_np),
             mesh=mesh, row_weights=row_weights, **static_kwargs,
+        )
+    elif procs > 1:
+        from fm_returnprediction_tpu.specgrid.multiproc import (
+            multiproc_grid_parts,
+        )
+
+        # the worker-side contraction predates the route/precision knobs
+        # exactly like the mesh path: xla at full precision (the knob
+        # combinations were rejected above)
+        mp_kwargs = {
+            k: v for k, v in static_kwargs.items()
+            if k not in ("gram_route", "precision")
+        }
+        out = multiproc_grid_parts(
+            raw_y, raw_x, raw_universes, uidx, col_sel, window_np,
+            procs=procs, row_weights=raw_rw, **mp_kwargs,
         )
     else:
         program_args = (y, x, universes, uidx, col_sel, window_np,

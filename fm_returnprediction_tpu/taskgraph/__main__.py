@@ -67,10 +67,22 @@ def main(argv=None) -> int:
                              "FMRP_FLEET_RATE/_BURST/_SHED_OCCUPANCY "
                              "shape admission, FMRP_FLEET_JOURNAL arms "
                              "the request journal)")
+    parser.add_argument("--replica-mode", choices=("thread", "process"),
+                        default=None,
+                        help="fleet smoke replica boundary: in-process "
+                             "threads or spawned child processes behind "
+                             "the socket transport; default follows "
+                             "FMRP_FLEET_REPLICA_MODE (thread)")
     args = parser.parse_args(argv)
 
+    from fm_returnprediction_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
     from fm_returnprediction_tpu.parallel.multihost import initialize_multihost
 
+    # join a multi-process run when FMRP_DIST_* is set (host exchange +
+    # telemetry identity) — a no-op otherwise; must precede backend init
+    initialize_distributed()
     initialize_multihost()  # no-op unless FMRP_MULTIHOST=1; must precede backend init
     apply_backend(args.backend)
     enable_compilation_cache()
@@ -132,6 +144,7 @@ def main(argv=None) -> int:
                     smoke = fleet_smoke(
                         state_path, fleet_size,
                         registry_dir=args.registry_dir,
+                        replica_mode=args.replica_mode,
                     )
                     print("serving fleet smoke: "
                           + _json.dumps(smoke, sort_keys=True))

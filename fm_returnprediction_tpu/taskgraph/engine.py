@@ -339,6 +339,18 @@ class TaskRunner:
         backends (pinning the platform, dialing remote runtimes) on the
         very first skip check of a plain local run.
         """
+        # transport ladder: the host exchange first (answers on every
+        # backend and never initializes XLA), then the device-collective
+        # runtime when it is up
+        from fm_returnprediction_tpu.parallel import distributed as _dist
+
+        ex = _dist.host_exchange()
+        if ex is not None:
+            import numpy as _np
+
+            flags = ex.allgather_obj(bool(flag))
+            return bool(reduce(_np.asarray(flags)))
+
         from fm_returnprediction_tpu.parallel.multihost import (
             distributed_client_active,
         )

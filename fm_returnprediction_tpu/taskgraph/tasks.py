@@ -63,7 +63,17 @@ def _is_primary() -> bool:
     """True on process 0 (or single-process). Multi-host taskgraph runs
     execute every task on every process — compute is replicated, but only
     one process may write shared-filesystem artifacts (same gating as
-    ``run_pipeline``; concurrent multi-GB npz writes tear)."""
+    ``run_pipeline``; concurrent multi-GB npz writes tear).
+
+    The host-exchange identity (``parallel.distributed``) is consulted
+    FIRST: it answers without initializing the XLA backends, and it is
+    the only answer on a backend whose device collectives are missing
+    (the CPU gap) — ``jax.process_index()`` remains the device-runtime
+    path."""
+    from fm_returnprediction_tpu.parallel import distributed as _dist
+
+    if _dist.dist_active():
+        return _dist.process_index() == 0
     import jax
 
     return jax.process_index() == 0
@@ -71,7 +81,18 @@ def _is_primary() -> bool:
 
 def _sync_processes(tag: str) -> None:
     """Barrier after a primary-only write so other processes cannot read a
-    half-written artifact in the next task. No-op single-process."""
+    half-written artifact in the next task. No-op single-process.
+
+    Transport ladder: the host exchange when armed (works on every
+    backend, and its tag check turns a program-order divergence into a
+    raise instead of a silent hang), else ``sync_global_devices`` (the
+    device-collective path pods use)."""
+    from fm_returnprediction_tpu.parallel import distributed as _dist
+
+    ex = _dist.host_exchange()
+    if ex is not None:
+        ex.barrier(tag)
+        return
     import jax
 
     if jax.process_count() > 1:

@@ -149,19 +149,21 @@ def write_jsonl(path, include_metrics: bool = True) -> Path:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     stats = _spans.collector_stats()
-    lines = [
-        json.dumps(
-            {
-                "type": "meta",
-                "schema": 1,
-                "pid": os.getpid(),
-                "spans": stats["spans"],
-                "events": stats["events"],
-                "dropped": stats["dropped"],
-            },
-            sort_keys=True,
-        )
-    ]
+    meta = {
+        "type": "meta",
+        "schema": 1,
+        "pid": os.getpid(),
+        "spans": stats["spans"],
+        "events": stats["events"],
+        "dropped": stats["dropped"],
+    }
+    # per-process identity (multi-process runs): merged jsonl files stay
+    # attributable; absent when unarmed, keeping exports byte-identical
+    from fm_returnprediction_tpu.telemetry import identity as _identity
+
+    if _identity.process_index() is not None:
+        meta["process_index"] = _identity.process_index()
+    lines = [json.dumps(meta, sort_keys=True)]
     lines += [json.dumps(r, sort_keys=True) for r in _ordered_records()]
     from fm_returnprediction_tpu.telemetry import perf as _perf
 
@@ -185,13 +187,17 @@ def write_jsonl(path, include_metrics: bool = True) -> Path:
 def chrome_trace_events(pid: Optional[int] = None) -> List[dict]:
     """Chrome trace-event dicts for every collected span and event."""
     pid = os.getpid() if pid is None else pid
+    from fm_returnprediction_tpu.telemetry import identity as _identity
+
     out: List[dict] = [
         {
             "ph": "M",
             "name": "process_name",
             "pid": pid,
             "tid": 0,
-            "args": {"name": "fmrp-host"},
+            # "[pK]" under a multi-process identity: N processes' traces
+            # merged in Perfetto keep distinct, attributable rows
+            "args": {"name": f"fmrp-host{_identity.process_suffix()}"},
         }
     ]
     threads = {}

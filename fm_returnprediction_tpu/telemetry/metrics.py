@@ -382,7 +382,16 @@ class MetricsRegistry:
         return out
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format for every family."""
+        """Prometheus text exposition format for every family.
+
+        With a per-process identity armed (``telemetry.identity`` — the
+        distributed bootstrap or a fleet replica child), every series
+        additionally carries ``process_index="k"`` so scrapes merged
+        from N processes stay attributable; unarmed output is
+        byte-identical to the historical single-process export."""
+        from fm_returnprediction_tpu.telemetry import identity as _identity
+
+        proc_idx = _identity.process_index()
         lines: List[str] = []
         collected = self.collect()
         with self._lock:
@@ -398,6 +407,12 @@ class MetricsRegistry:
             lines.append(f"# TYPE {pname} {kind}")
             for key in sorted(collected[name]):
                 value = collected[name][key]
+                if proc_idx is not None and not any(
+                    k == "process_index" for k, _ in key
+                ):
+                    key = tuple(sorted(
+                        (*key, ("process_index", str(proc_idx)))
+                    ))
                 label_str = ",".join(
                     f'{sanitize(k)}="{escape_label_value(v)}"' for k, v in key
                 )

@@ -1,0 +1,59 @@
+"""Worker for the host-exchange collective tests (test_multiprocess.py).
+
+Each spawned process joins the ``FMRP_DIST_*`` bootstrap and exercises
+every exchange primitive the platform builds on — allgather (rank
+ordering), sum_tree (the psum drop-in: identical merged leaves on every
+rank), broadcast, barrier — plus the telemetry identity the bootstrap
+stamps (``process_index`` label on the Prometheus export).
+
+Usage: python mp_exchange_worker.py <pid> <nprocs> <port>
+"""
+
+import os
+import sys
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["FMRP_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["FMRP_DIST_PROCS"] = str(nprocs)
+os.environ["FMRP_DIST_PROC_ID"] = str(pid)
+os.environ["FMRP_DIST_JAX"] = "0"
+
+import numpy as np  # noqa: E402
+
+from fm_returnprediction_tpu.parallel import distributed as dist  # noqa: E402
+
+assert dist.initialize_distributed() == (pid, nprocs)
+ex = dist.host_exchange()
+assert ex is not None and dist.dist_active()
+
+# allgather: every rank sees every contribution, rank-ordered
+vals = ex.allgather_obj(pid * 10)
+assert vals == [r * 10 for r in range(nprocs)], vals
+
+# sum_tree: the host-merge drop-in for psum over additive stats — every
+# rank computes the identical rank-ordered fold
+tree = {"gram": np.full((2, 3), float(pid + 1)), "n": np.array([pid])}
+merged = ex.sum_tree(tree)
+want_gram = sum(r + 1.0 for r in range(nprocs))
+assert np.array_equal(merged["gram"], np.full((2, 3), want_gram))
+assert merged["n"][0] == sum(range(nprocs))
+
+# broadcast: non-root contributions are ignored
+got = ex.broadcast_obj("root-truth" if pid == 0 else f"noise-{pid}")
+assert got == "root-truth", got
+
+# barrier with an agreed tag passes; the transport counters moved
+ex.barrier("checkpoint")
+assert ex._m_rounds.value >= 4
+
+# the bootstrap stamped the telemetry identity: every exported series
+# carries process_index="<rank>" (merged scrapes stay attributable)
+from fm_returnprediction_tpu import telemetry  # noqa: E402
+from fm_returnprediction_tpu.telemetry import identity  # noqa: E402
+
+assert identity.process_index() == pid
+text = telemetry.registry().to_prometheus()
+assert f'process_index="{pid}"' in text, text[:400]
+
+print(f"EX_OK {pid}", flush=True)

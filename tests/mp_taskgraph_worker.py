@@ -1,7 +1,7 @@
 """Worker for the two-process TASKGRAPH test (test_multiprocess.py).
 
-Drives the five-task pipeline DAG with 2 real `jax.distributed` processes
-sharing one filesystem (the pod scenario):
+Drives the five-task pipeline DAG with 2 real processes sharing one
+filesystem (the pod scenario):
 
 - phase 1: both processes run the DAG from empty state — process 0 must
   write every artifact exactly once (``_primary_writes``), the barriers
@@ -11,8 +11,20 @@ sharing one filesystem (the pod scenario):
   Without the runner's cross-process consensus this deadlocks: process 1
   enters an action barrier process 0 never reaches. With consensus, both
   re-run everything and succeed.
+- phase 3: a ONE-SIDED failure must stop both processes symmetrically.
 
-Usage: python mp_taskgraph_worker.py <pid> <nprocs> <port> <workdir>
+Two transports (argv[5], the engine's fallback ladder):
+
+- ``host``  — the ``FMRP_DIST_*`` bootstrap (``parallel.distributed``):
+  barriers and consensus ride the host-side exchange, which answers on
+  EVERY backend — this is the mode that runs for real on this
+  container's CPU jaxlib (no device collectives needed anywhere).
+- ``jax``   — ``jax.distributed`` device collectives via
+  ``initialize_multihost`` (the pod path); on a CPU backend without
+  cross-process collectives the first collective raises the named gap
+  the parent test probes for.
+
+Usage: python mp_taskgraph_worker.py <pid> <nprocs> <port> <workdir> <transport>
 """
 
 import os
@@ -21,20 +33,43 @@ from pathlib import Path
 
 pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 workdir = Path(sys.argv[4])
+transport = sys.argv[5] if len(sys.argv) > 5 else "jax"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "1"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
-from fm_returnprediction_tpu.parallel.multihost import (  # noqa: E402
-    initialize_multihost,
-)
+if transport == "host":
+    os.environ["FMRP_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["FMRP_DIST_PROCS"] = str(nprocs)
+    os.environ["FMRP_DIST_PROC_ID"] = str(pid)
+    os.environ["FMRP_DIST_JAX"] = "0"
 
-initialize_multihost(
-    coordinator_address=f"localhost:{port}", num_processes=nprocs, process_id=pid
-)
+    from fm_returnprediction_tpu.parallel import distributed as dist
 
-from jax.experimental import multihost_utils  # noqa: E402
+    assert dist.initialize_distributed() == (pid, nprocs)
+    # idempotent second call must return the same coords
+    assert dist.initialize_distributed() == (pid, nprocs)
+
+    def sync(tag: str) -> None:
+        dist.host_exchange().barrier(tag)
+
+else:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    from fm_returnprediction_tpu.parallel.multihost import (
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=f"localhost:{port}", num_processes=nprocs,
+        process_id=pid,
+    )
+
+    def sync(tag: str) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
 
 from fm_returnprediction_tpu.data.synthetic import SyntheticConfig  # noqa: E402
 from fm_returnprediction_tpu.taskgraph.engine import (  # noqa: E402
@@ -68,10 +103,10 @@ with TaskRunner(make_tasks(), db_path=db, reporter=PlainReporter()) as r:
     assert r.run(), "phase-1 DAG run failed"
 assert (out / "table_1.pkl").exists() and (processed / "lewellen_panel.npz").exists()
 
-multihost_utils.sync_global_devices("phase2_setup")
+sync("phase2_setup")
 if pid == 1:  # asymmetric staleness: process 1 forgets everything
     db.unlink()
-multihost_utils.sync_global_devices("phase2_go")
+sync("phase2_go")
 
 with TaskRunner(make_tasks(), db_path=db, reporter=PlainReporter()) as r2:
     assert r2.run(), "phase-2 (asymmetric staleness) run failed"
@@ -80,7 +115,7 @@ assert (out / "table_1.pkl").exists()
 # phase 3: ONE-SIDED failure must stop BOTH processes symmetrically (the
 # engine's per-task success consensus) — without it, process 0 would march
 # into the next collective and hang while process 1 holds the traceback.
-multihost_utils.sync_global_devices("phase3_go")
+sync("phase3_go")
 from fm_returnprediction_tpu.taskgraph.engine import Task  # noqa: E402
 
 

@@ -1,27 +1,51 @@
-"""REAL multi-process ``jax.distributed``: two local CPU processes.
+"""REAL multi-process execution: spawned Python processes, no fictions.
 
-Round-3 verdict item 5: ``initialize_multihost``'s ``jax.distributed`` path
-had only ever run with one process. Here the parent spawns two fresh Python
-processes (``tests/mp_worker.py``) that rendezvous on a local coordinator,
-form the 4-device global topology (2 processes × 2 virtual CPU devices),
-build the production months×firms mesh with one row per process, and run a
-hierarchical Fama-MacBeth step whose collectives actually cross the process
-boundary (Gloo transport) — asserting agreement with the single-device
-solver inside each worker.
+Until ISSUE 13 both tests here skipped on this container — the jaxlib CPU
+backend refuses cross-process device collectives, and everything
+multi-process rode them. The ``parallel.distributed`` bootstrap's
+host-side exchange removes that dependency, so this module is now the
+tier-1 evidence of the cross-process claim, all of it against REAL
+spawned subprocesses:
+
+- host-exchange collectives (allgather / sum_tree / broadcast / barrier)
+  plus the telemetry ``process_index`` identity, across 3 processes;
+- the full taskgraph DAG across 2 processes sharing a filesystem, with
+  process-0-only writes, exchange barriers, and the asymmetric-staleness
+  consensus — running FOR REAL on the CPU backend;
+- the multi-process spec-grid route differentially pinned against the
+  single-process program (≤1e-6 f32 / ≤1e-13 f64 rtol, the mesh-route
+  precedent), including the "only one worker compiles fresh" registry
+  evidence;
+- the serving fleet in ``replica_mode="process"``: a SIGKILLed replica
+  process whose in-flight requests requeue and whose journal replays
+  CLEAN (exactly-once across a process death), and warm-pool process
+  spawns with zero-compile WarmReports plus a two-phase rollover over
+  the wire.
+
+The ONE remaining skip is the named environment gap it always was:
+``jax.distributed`` device collectives on a CPU jaxlib without
+cross-process collective support (``test_two_process_distributed_fm_hier``
+probes the worker output for the exact refusal — on TPU/GPU it runs and
+must pass).
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
 import time
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 _WORKER = Path(__file__).parent / "mp_worker.py"
 _TG_WORKER = Path(__file__).parent / "mp_taskgraph_worker.py"
+_EX_WORKER = Path(__file__).parent / "mp_exchange_worker.py"
 _REPO = Path(__file__).parent.parent
+
+pytestmark = pytest.mark.multiprocess
 
 
 def _free_port() -> int:
@@ -30,8 +54,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_worker_pair(worker: Path, extra_args, marker: str, budget_s: float):
-    port, nprocs = _free_port(), 2
+def _spawn_workers(worker: Path, extra_args, nprocs: int, budget_s: float):
+    """Spawn ``nprocs`` copies of ``worker`` and gather their outputs
+    within one shared wall budget."""
+    port = _free_port()
     env = {**os.environ, "PYTHONPATH": str(_REPO)}
     # the parent's pytest env must not leak its 8-device flag into workers
     env.pop("XLA_FLAGS", None)
@@ -56,38 +82,319 @@ def _run_worker_pair(worker: Path, extra_args, marker: str, budget_s: float):
         for p in procs:  # never leak workers holding the coordinator port
             if p.poll() is None:
                 p.kill()
-    # Environment gap, not a code fault: this container's jaxlib CPU
-    # backend refuses cross-process collectives outright ("Multiprocess
-    # computations aren't implemented on the CPU backend") — the workers
-    # rendezvous, form the topology, and die at the FIRST collective. On
-    # a backend with cross-process collectives (TPU/GPU, or a CPU build
-    # with Gloo-backed XLA collectives) the tests run and must pass, so
-    # we probe the worker output for the exact refusal instead of
-    # skipping unconditionally.
+    return procs, outs
+
+
+def _assert_ok(procs, outs, marker: str):
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
+        assert f"{marker} {i}" in out, f"worker {i} missing marker:\n{out}"
+
+
+# -- the host-exchange bootstrap (works on EVERY backend) --------------------
+
+
+@pytest.mark.timeout(180)
+def test_three_process_host_exchange_collectives():
+    """allgather / sum_tree / broadcast / barrier across 3 spawned
+    processes, plus the process_index telemetry identity the bootstrap
+    stamps — the transport everything else in this module rides."""
+    procs, outs = _spawn_workers(_EX_WORKER, [], nprocs=3, budget_s=120)
+    _assert_ok(procs, outs, "EX_OK")
+
+
+@pytest.mark.timeout(420)
+def test_two_process_taskgraph_dag_host_exchange(tmp_path):
+    """The full five-task DAG across 2 real processes sharing a
+    filesystem — process-0-only writes with exchange barriers, then an
+    ASYMMETRIC-staleness rerun (one fresh state DB, one warm) that
+    deadlocks without the runner's cross-process stale consensus, then a
+    one-sided failure that must stop both sides. Runs FOR REAL on the
+    CPU backend: every collective is a host-exchange round."""
+    procs, outs = _spawn_workers(
+        _TG_WORKER, [str(tmp_path), "host"], nprocs=2, budget_s=360
+    )
+    _assert_ok(procs, outs, "TG_OK")
+
+
+def test_barrier_tag_skew_raises():
+    """Program-order divergence is a RAISE, not a hang: two ranks enter
+    barriers with different tags and both get DistributedError naming the
+    skew (the failure sync_global_devices would hide as a deadlock)."""
+    import threading
+
+    from fm_returnprediction_tpu.parallel.distributed import (
+        DistConfig,
+        DistributedError,
+        HostExchange,
+        free_port,
+    )
+
+    port = free_port()
+    cfg = lambda r: DistConfig(  # noqa: E731
+        coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=r
+    )
+    errs = {}
+
+    def rank(r, tag):
+        ex = HostExchange(cfg(r), timeout_s=30.0)
+        try:
+            ex.barrier(tag)
+        except DistributedError as exc:
+            errs[r] = str(exc)
+        finally:
+            ex.close()
+
+    t1 = threading.Thread(target=rank, args=(1, "phase_B"))
+    t1.start()
+    rank(0, "phase_A")
+    t1.join(timeout=30)
+    assert "tag skew" in errs[0] and "tag skew" in errs[1]
+
+
+# -- the multi-process spec-grid route ---------------------------------------
+
+
+def _mp_panel(rng, t=48, n=90, p=6, dtype=np.float64):
+    x = rng.standard_normal((t, n, p)).astype(dtype)
+    beta = rng.standard_normal(p) * 0.1
+    y = (x @ beta + 0.2 * rng.standard_normal((t, n))).astype(dtype)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(dtype)
+    size = rng.random(n)
+    masks = {"All": mask, "Big": mask & (size > 0.4)[None, :]}
+    return y, x, masks
+
+
+def _mp_grid():
+    from fm_returnprediction_tpu.specgrid import Spec, SpecGrid
+
+    names = [f"x{i}" for i in range(6)]
+    return SpecGrid(tuple(
+        Spec(f"m{k} | {u}", tuple(names[:k]), u)
+        for k in (3, 6) for u in ("All", "Big")
+    ))
+
+
+_GRID_FIELDS = ("coef", "tstat", "nw_se", "slopes", "intercept",
+                "mean_r2", "mean_n", "r2", "month_valid")
+
+
+def _assert_grid_close(ref, got, rtol, atol):
+    for field in _GRID_FIELDS:
+        a = np.asarray(getattr(ref, field), float)
+        b = np.asarray(getattr(got, field), float)
+        both_nan = np.isnan(a) & np.isnan(b)
+        np.testing.assert_allclose(
+            np.where(both_nan, 0.0, a), np.where(both_nan, 0.0, b),
+            rtol=rtol, atol=atol, err_msg=field,
+        )
+
+
+@pytest.mark.specgrid
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("dtype,rtol,atol", [
+    (np.float64, 1e-13, 1e-13),
+    (np.float32, 1e-6, 1e-6),
+])
+def test_multiproc_specgrid_matches_single_process(dtype, rtol, atol):
+    """The ISSUE-13 differential pin: 2 spawned contraction workers +
+    host-exchange merge equals the in-process route to ≤1e-6 f32 /
+    ≤1e-13 f64 (the mesh-route tolerance precedent), every output
+    field. The additive-Gram property is what makes the shard merge
+    exact; the rank-ordered sum_tree fold is what makes it
+    deterministic."""
+    from fm_returnprediction_tpu.specgrid import multiproc, run_spec_grid
+
+    rng = np.random.default_rng(7)
+    y, x, masks = _mp_panel(rng, dtype=dtype)
+    grid = _mp_grid()
+    try:
+        ref = run_spec_grid(y, x, masks, grid)
+        got = run_spec_grid(y, x, masks, grid, procs=2)
+    finally:
+        multiproc._close_cached_pool()
+    _assert_grid_close(ref, got, rtol, atol)
+
+
+@pytest.mark.specgrid
+@pytest.mark.registry
+@pytest.mark.timeout(300)
+def test_multiproc_specgrid_only_one_worker_compiles(tmp_path):
+    """With a registry armed, the pool's staggered warm-up means exactly
+    ONE worker process pays the fresh contraction compile; the other
+    deserializes — the per-worker cost-ledger provenance split is the
+    evidence (`pool.last_reports`)."""
+    from fm_returnprediction_tpu.specgrid import multiproc
+
+    rng = np.random.default_rng(11)
+    p = 4
+    y, x, masks = _mp_panel(rng, t=36, n=60, p=p)
+    uni = np.stack([masks["All"]]).astype(bool)
+    uidx = np.zeros(1, np.int64)
+    t = y.shape[0]
+    window = np.ones((1, t), bool)
+    col_sel = np.ones((1, p), bool)
+    reg_dir = tmp_path / "registry"
+    pool = multiproc.SpecGridWorkerPool(
+        2, y, x, uni, child_env={"FMRP_REGISTRY_DIR": str(reg_dir)},
+    )
+    try:
+        pool.contract(uidx, col_sel, window, report=True)
+        reports = {r["rank"]: r for r in pool.last_reports}
+        assert len(reports) == 2, reports
+        fresh = sum(r["fresh"] for r in reports.values())
+        fetched = sum(r["deserialized"] for r in reports.values())
+        assert fresh == 1, f"exactly one fresh compile expected: {reports}"
+        assert fetched == 1, f"the other worker must deserialize: {reports}"
+        # transport accounting moved (the bench's multiproc_transport_*)
+        assert pool.last_merge_bytes > 0 and pool.last_merge_s > 0
+    finally:
+        pool.close()
+
+
+# -- the multi-process serving fleet -----------------------------------------
+
+
+def _fleet_state(rng, t=48, n=120, p=4):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=24, min_periods=12)
+    return state, y, x, mask
+
+
+@pytest.mark.fleet
+@pytest.mark.timeout(420)
+def test_process_fleet_sigkill_replica_journal_replays_clean(tmp_path):
+    """THE acceptance pin: replicas are real OS processes; one is
+    SIGKILLed with requests in flight. The torn socket fails them with
+    ReplicaDeadError, the router requeues onto the survivor, the
+    supervisor's wire heartbeat detects the corpse and warm-replaces it,
+    and the WAL journal — written by the router, so the kill cannot
+    lose it — replays CLEAN: zero dropped, zero duplicated."""
+    from fm_returnprediction_tpu.serving import ServingFleet, replay_journal
+
+    rng = np.random.default_rng(0)
+    state, _, x, _ = _fleet_state(rng)
+    journal = tmp_path / "journal.jsonl"
+    fleet = ServingFleet(state, 2, replica_mode="process",
+                         journal=str(journal), max_batch=32,
+                         max_latency_ms=2.0)
+    try:
+        assert fleet.replica_mode == "process"
+        months = np.nonzero(state.have_coef())[0]
+        xs = rng.standard_normal((40, state.n_predictors)).astype(np.float32)
+        out = fleet.query_many(
+            [int(months[i % len(months)]) for i in range(40)], xs
+        )
+        assert np.isfinite(out).sum() == 40
+
+        rid = sorted(fleet.replica_states())[0]
+        rep = fleet.replica(rid)
+        child_pid = rep.service.pid
+        futs = [fleet.submit(int(months[0]), xs[0]) for _ in range(10)]
+        rep.service.proc.send_signal(signal.SIGKILL)  # a REAL process death
+        rep.service.proc.wait(timeout=30)
+
+        # the supervisor's stats probe is the heartbeat: the corpse cannot
+        # answer, so the tick kills + (next tick) warm-replaces it
+        deadline = time.monotonic() + 60
+        while (fleet.replica_states().get(rid) not in (None, "dead")
+               and time.monotonic() < deadline):
+            fleet.supervisor.tick()
+            time.sleep(0.05)
+        fleet.supervisor.tick()  # DEAD → failover replacement
+
+        res = np.asarray([f.result(timeout=60) for f in futs])
+        assert np.isfinite(res).all(), "in-flight requests must survive"
+        stats = fleet.stats()
+        assert stats["requeues_total"] >= 1 or stats["failovers_total"] >= 1
+        assert stats["healthy_replicas"] >= 2  # replacement spawned
+        new_rids = set(fleet.replica_states()) - {rid}
+        assert all(
+            fleet.replica(r).service.pid != child_pid for r in new_rids
+        ), "the replacement must be a NEW process"
+    finally:
+        fleet.close()
+    replay = replay_journal(journal)
+    assert replay.clean, (replay.dropped, replay.duplicated)
+    assert replay.n_admitted == 50
+
+
+@pytest.mark.fleet
+@pytest.mark.registry
+@pytest.mark.timeout(420)
+def test_process_fleet_warm_spawn_and_rollover_over_the_wire(tmp_path):
+    """Warm-pool process spawn: with a populated registry every replica
+    CHILD starts zero-compile (WarmReport evidence rides back in the
+    hello), and the two-phase rollover ships the candidate bundle over
+    the shared filesystem — prepare warms in every child, commit flips,
+    and the new month slot quotes."""
+    from fm_returnprediction_tpu.registry.store import using_registry
+    from fm_returnprediction_tpu.serving import (
+        ERService,
+        ServingFleet,
+        ingest_month,
+    )
+
+    rng = np.random.default_rng(1)
+    state, y, x, mask = _fleet_state(rng)
+    new_state = ingest_month(
+        state, y[-1], x[-1], mask[-1], np.datetime64("2035-01-31", "ns")
+    )
+    reg_dir = tmp_path / "registry"
+    with using_registry(reg_dir):
+        ERService(state, max_batch=32, auto_flush=False).close()
+        ERService(new_state, max_batch=32, auto_flush=False).close()
+    fleet = ServingFleet(state, 2, replica_mode="process",
+                         registry_dir=reg_dir, max_batch=32)
+    try:
+        assert set(fleet.warm_reports) == set(fleet.replica_states())
+        assert all(r.zero_compile for r in fleet.warm_reports.values()), (
+            fleet.warm_reports
+        )
+        assert fleet.rollover(new_state) == 1
+        q = fleet.query(int(new_state.n_months - 1),
+                        np.zeros(state.n_predictors, np.float32))
+        assert isinstance(q, float) or np.isscalar(q)
+        # per-child telemetry identity: the replica's own export labels
+        # itself (FMRP_PROC_INDEX threaded by the spawner)
+        assert fleet.replica("r0").service.stats()["n_done"] >= 0
+    finally:
+        fleet.close()
+
+
+# -- the named environment gap (device collectives) --------------------------
+
+
+@pytest.mark.timeout(300)
+def test_two_process_distributed_fm_hier():
+    """``jax.distributed`` DEVICE collectives: 2 processes × 2 virtual
+    CPU devices form the 4-device global topology and run a hierarchical
+    Fama-MacBeth step whose psums actually cross the process boundary.
+
+    Environment gap, not a code fault: this container's jaxlib CPU
+    backend refuses cross-process collectives outright — the workers
+    rendezvous, form the topology, and die at the FIRST collective. On a
+    backend with cross-process collectives (TPU/GPU, or a CPU build with
+    Gloo-backed XLA collectives) the test runs and must pass, so we
+    probe the worker output for the exact refusal instead of skipping
+    unconditionally. Every OTHER test in this module runs for real: the
+    host-side exchange is the disclosed fallback for exactly this gap.
+    """
+    procs, outs = _spawn_workers(_WORKER, [], nprocs=2, budget_s=240)
     gap = "Multiprocess computations aren't implemented on the CPU backend"
     if any(gap in out for out in outs):
         pytest.skip(
             "environment gap: jaxlib's CPU backend cannot run "
             f"cross-process collectives (XlaRuntimeError: {gap!r}); "
             "needs TPU/GPU or a CPU jaxlib with cross-process collective "
-            "support"
+            "support. The host-exchange tests above cover the fallback "
+            "transport on this backend."
         )
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
-        assert f"{marker} {i}" in out, f"worker {i} missing marker:\n{out}"
-
-
-@pytest.mark.timeout(300)
-def test_two_process_distributed_fm_hier():
-    _run_worker_pair(_WORKER, [], "MP_OK", budget_s=240)
-
-
-@pytest.mark.timeout(420)
-def test_two_process_taskgraph_dag(tmp_path):
-    """The full five-task DAG across 2 real processes sharing a filesystem:
-    process-0-only writes with barriers, then an ASYMMETRIC-staleness rerun
-    (one fresh state DB, one warm) that deadlocks without the runner's
-    cross-process stale consensus."""
-    _run_worker_pair(
-        _TG_WORKER, [str(tmp_path)], "TG_OK", budget_s=360
-    )
+    _assert_ok(procs, outs, "MP_OK")

@@ -539,3 +539,88 @@ def test_telemetry_off_pipeline_artifacts_bit_identical():
         on = run_pipeline(**kw)
     pd.testing.assert_frame_equal(on.table_1, off.table_1)
     pd.testing.assert_frame_equal(on.table_2, off.table_2)
+
+
+# -- per-process identity (ISSUE 13) -----------------------------------------
+
+
+def test_process_identity_precedence(monkeypatch):
+    """explicit set_process_index > FMRP_PROC_INDEX (fleet replica
+    children) > FMRP_DIST_PROC_ID (exchange workers) > None; resolved
+    LIVE (the repo-wide env-knob discipline)."""
+    from fm_returnprediction_tpu.telemetry import identity
+
+    monkeypatch.delenv("FMRP_PROC_INDEX", raising=False)
+    monkeypatch.delenv("FMRP_DIST_PROC_ID", raising=False)
+    identity.set_process_index(None)
+    assert identity.process_index() is None
+    assert identity.process_suffix() == ""
+    monkeypatch.setenv("FMRP_DIST_PROC_ID", "3")
+    assert identity.process_index() == 3
+    monkeypatch.setenv("FMRP_PROC_INDEX", "7")
+    assert identity.process_index() == 7  # generic identity wins
+    identity.set_process_index(2)
+    try:
+        assert identity.process_index() == 2  # the bootstrap's pin wins
+        assert identity.process_suffix() == "[p2]"
+    finally:
+        identity.set_process_index(None)
+
+
+def test_prometheus_export_carries_process_index_only_when_armed(
+    monkeypatch,
+):
+    """Armed: every exported series gains process_index="k" so merged
+    multi-process scrapes stay attributable. Unarmed: the export is
+    byte-identical to the historical single-process text."""
+    from fm_returnprediction_tpu.telemetry import identity
+    from fm_returnprediction_tpu.telemetry.metrics import MetricsRegistry
+
+    monkeypatch.delenv("FMRP_PROC_INDEX", raising=False)
+    monkeypatch.delenv("FMRP_DIST_PROC_ID", raising=False)
+    identity.set_process_index(None)
+    reg = MetricsRegistry()
+    reg.counter("fmrp_test_ident_total", help="h", route="a").inc(2)
+    reg.gauge("fmrp_test_ident_gauge", help="h").set(1.5)
+    unarmed = reg.to_prometheus()
+    assert "process_index" not in unarmed
+    identity.set_process_index(4)
+    try:
+        armed = reg.to_prometheus()
+    finally:
+        identity.set_process_index(None)
+    for line in armed.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        assert 'process_index="4"' in line, line
+    # disarming restores the byte-identical historical export
+    assert reg.to_prometheus() == unarmed
+
+
+def test_jsonl_meta_and_chrome_trace_carry_identity(tmp_path, monkeypatch):
+    from fm_returnprediction_tpu.telemetry import export, identity
+
+    monkeypatch.delenv("FMRP_PROC_INDEX", raising=False)
+    monkeypatch.delenv("FMRP_DIST_PROC_ID", raising=False)
+    identity.set_process_index(None)
+    with telemetry.enabled(True):
+        with telemetry.span("ident.work", cat="test"):
+            pass
+        meta_off = json.loads(
+            export.write_jsonl(tmp_path / "off.jsonl").read_text()
+            .splitlines()[0]
+        )
+        assert "process_index" not in meta_off
+        name_off = export.chrome_trace_events()[0]["args"]["name"]
+        assert name_off == "fmrp-host"
+        identity.set_process_index(5)
+        try:
+            meta_on = json.loads(
+                export.write_jsonl(tmp_path / "on.jsonl").read_text()
+                .splitlines()[0]
+            )
+            assert meta_on["process_index"] == 5
+            name_on = export.chrome_trace_events()[0]["args"]["name"]
+            assert name_on == "fmrp-host[p5]"
+        finally:
+            identity.set_process_index(None)
