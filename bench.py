@@ -40,6 +40,13 @@ over-claim without (round-1 VERDICT "What's weak" #1-2):
   top-k sink, ``cells_per_s`` per rung (higher-is-better series), warm
   repeats under ``recompile_watch``, and the tracemalloc peak vs the
   one-tile memory bound.
+- ``grid_factorized_*`` / ``grid_boot_*`` — the month-axis reuse layer
+  (ISSUE 14): factorized (unique-pair) vs legacy (per-spec) contraction
+  cells/s at the same window-swept shape with the contraction-work
+  ledger's pairs-vs-specs disclosure, device vs host bootstrap-draw
+  aggregation, frame parity pins, and the Gram-bank build/query leg
+  (new-window + new-bootstrap scenario queries answered with zero
+  (T, N, P) panel reads).
 
 All timings synchronize by pulling a result to the host (``np.asarray``
 or a scalar device-side reduction), not ``block_until_ready`` alone — on
@@ -1413,6 +1420,159 @@ def _bench_specgrid_scale(fast: bool):
     return out
 
 
+def _bench_grid_factorized(fast: bool):
+    """Month-axis factorization + device bootstrap + banked queries (the
+    ISSUE-14 acceptance evidence). One window-swept CellSpace (8 windows,
+    32 bootstrap draws) runs three warm routes at the SAME shape:
+
+    - legacy: per-spec contraction (``factorize="off"``), per-draw host
+      numpy aggregation (``boot_route="host"``) — the incumbent;
+    - factorized: unique-pair contraction + device-batched draw
+      aggregation (the new default resolution for this space);
+    - factorized/host: isolates the boot route's share of the win.
+
+    Gated series: ``grid_factorized_cells_per_s`` (higher-better),
+    ``grid_factorized_speedup`` (factorized / legacy, the ≥2× acceptance
+    floor), ``grid_boot_speedup`` (device / host draw aggregation at
+    fixed contraction route). The contraction-work ledger discloses that
+    the factorized route contracted PAIRS, not S
+    (``grid_factorized_pairs_per_tile`` vs ``_specs_per_tile``), and the
+    two frames' max |Δcoef| pins parity in every round. The bank leg
+    times ``grambank.scenario_query`` answering a NEW window split + a
+    NEW bootstrap depth from banked stats — the ledger staying flat
+    proves zero (T, N, P) panel reads. FMRP_BENCH_GRID_FACTORIZED=0
+    skips."""
+    if os.environ.get("FMRP_BENCH_GRID_FACTORIZED", "1") == "0":
+        return {}
+    from fm_returnprediction_tpu.specgrid import CellSpace, run_cellspace
+    from fm_returnprediction_tpu.specgrid.grambank import (
+        build_bank,
+        scenario_query,
+    )
+    from fm_returnprediction_tpu.specgrid.scenarios import subperiod_windows
+    from fm_returnprediction_tpu.specgrid.solve import contraction_counts
+
+    t = int(os.environ.get("FMRP_BENCH_GRID_FACT_MONTHS", 60))
+    # the firm axis is the lever that makes the contraction the dominant
+    # cost (the regime the factorization targets): N=8000 measured 2.1x
+    # on the bench box vs 1.9x at N=4000 (rows_for + per-run dispatch are
+    # route-independent floors)
+    n = int(os.environ.get("FMRP_BENCH_GRID_FACT_FIRMS",
+                           200 if fast else 8000))
+    p = 8
+    draws = int(os.environ.get("FMRP_BENCH_GRID_FACT_DRAWS",
+                               8 if fast else 32))
+    n_sets = 4 if fast else 12
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    rng = np.random.default_rng(2014)
+    sets = [("s00_full", tuple(names))]
+    while len(sets) < n_sets:
+        k = 2 + (len(sets) % (p - 2))
+        cols = np.sort(rng.choice(p, size=k, replace=False))
+        sets.append((f"s{len(sets):02d}_{k}", tuple(names[c] for c in cols)))
+    # 8 windows (full + 7 staggered subsamples): windows are the axis the
+    # factorization collapses, and they also shrink the tile's pair pad —
+    # at spec_pad=16 a tile spans ≤3 pairs vs 16 legacy spec rows
+    # (measured 2.7x on the bench box vs 2.1x with 4 windows)
+    n_wins = 4 if fast else 8
+    windows = (("full", None),) + tuple(
+        (f"w{i}", (i * t // 10, t - (n_wins - 2 - i) * t // 30))
+        for i in range(n_wins - 1)
+    )
+    space = CellSpace(
+        regressor_sets=tuple(sets), universes=tuple(masks),
+        windows=windows, bootstrap=draws,
+    )
+    routes = {
+        "legacy": dict(factorize="off", boot_route="host"),
+        "fact": dict(factorize="on", boot_route="device"),
+        "fact_host": dict(factorize="on", boot_route="host"),
+    }
+    out = {
+        "grid_factorized_shape":
+            f"T{t}_N{n}_P{p}_S{space.n_specs}_D{draws}",
+        "grid_factorized_cells": len(space),
+        "grid_factorized_pairs": space.n_pairs,
+    }
+    frames, warm = {}, {}
+    for label, kw in routes.items():
+        # cold pass compiles; the warm repeat is the gated wall
+        run_cellspace(y, x, masks, space, mask=masks["All"], **kw)
+        before = contraction_counts()
+        with _timed(f"bench.grid_factorized_{label}_warm") as warm_t:
+            frame, stats = run_cellspace(
+                y, x, masks, space, mask=masks["All"], **kw,
+            )
+        delta = {
+            k: contraction_counts().get(k, 0) - before.get(k, 0)
+            for k in ("specs_solved", "specs_contracted", "pairs_unique",
+                      "pairs_contracted")
+        }
+        frames[label], warm[label] = frame, warm_t.s
+        if label != "fact_host":
+            out[f"grid_factorized_{label}_warm_s"] = round(warm_t.s, 4)
+            out[f"grid_factorized_{label}_cells_per_s"] = round(
+                len(space) / warm_t.s, 1)
+        if label == "fact":
+            assert stats["gram_factorize"] == "on"
+            tiles = stats["tiles"]
+            # the acceptance ledger: contraction rows per tile track the
+            # tile's unique (universe, col_sel) pairs, not its spec count
+            out["grid_factorized_specs_per_tile"] = round(
+                delta["specs_solved"] / tiles, 1)
+            out["grid_factorized_pairs_per_tile"] = round(
+                delta["pairs_contracted"] / tiles, 1)
+            out["grid_factorized_pairs_unique_per_tile"] = round(
+                delta["pairs_unique"] / tiles, 1)
+        elif label == "legacy":
+            assert stats["gram_factorize"] == "off"
+            out["grid_factorized_legacy_specs_per_tile"] = round(
+                delta["specs_contracted"] / stats["tiles"], 1)
+    out["grid_factorized_cells_per_s"] = out[
+        "grid_factorized_fact_cells_per_s"]
+    out["grid_factorized_speedup"] = round(
+        warm["legacy"] / warm["fact"], 2)
+    out["grid_boot_shape"] = out["grid_factorized_shape"]
+    out["grid_boot_device_warm_s"] = round(warm["fact"], 4)
+    out["grid_boot_host_warm_s"] = round(warm["fact_host"], 4)
+    out["grid_boot_speedup"] = round(warm["fact_host"] / warm["fact"], 2)
+    # parity pin: same cells, same draws, two routes (device draws carry
+    # ~1e-9 of f32 gather/aggregation reordering vs the host loop)
+    key = ["cell", "predictor"]
+    a = frames["legacy"].sort_values(key).reset_index(drop=True)
+    b = frames["fact"].sort_values(key).reset_index(drop=True)
+    diffs = (a["coef"] - b["coef"]).abs()
+    out["grid_factorized_max_abs_coef_diff"] = float(diffs.max())
+    out["grid_factorized_nan_pattern_mismatches"] = int(
+        (a["coef"].isna() != b["coef"].isna()).sum())
+
+    # the bank leg: contract once, then answer a NEW window split and a
+    # NEW bootstrap depth from banked stats — zero panel reads
+    with _timed("bench.grid_factorized_bank_build") as build_t:
+        bank = build_bank(y, x, masks, space, fingerprint="bench")
+    boot_d = 8 if fast else 16
+    bank_windows = subperiod_windows(t, 3)
+    # cold pass compiles BOTH query programs (the window solve tail and
+    # the D-draw pairs-batched aggregator); the timed repeat is warm
+    scenario_query(bank, windows=bank_windows, bootstrap=boot_d)
+    before = contraction_counts()
+    with _timed("bench.grid_factorized_bank_query") as query_t:
+        qframe = scenario_query(
+            bank, windows=bank_windows, bootstrap=boot_d,
+        )
+    out["grid_factorized_bank_build_s"] = round(build_t.s, 4)
+    out["grid_factorized_bank_query_s"] = round(query_t.s, 4)
+    out["grid_factorized_bank_query_rows_per_s"] = round(
+        len(qframe) / query_t.s, 1)
+    out["grid_factorized_bank_query_panel_contractions"] = sum(
+        contraction_counts().get(k, 0) - before.get(k, 0)
+        for k in ("specs_contracted", "pairs_contracted")
+    )
+    return out
+
+
 def _bench_serving(fast: bool):
     """Warm microbatched serving path on a synthetic state (the online
     E[r] query service, ``fm_returnprediction_tpu/serving``): build a
@@ -2722,6 +2882,7 @@ def main() -> None:
     sections.append(_bench_fleet_capacity)  # _FLEET_CAPACITY=0 in-section
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
+    sections.append(_bench_grid_factorized)  # _GRID_FACTORIZED=0 in-section
     sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section

@@ -118,6 +118,34 @@ def task_robustness_smoke():
     }
 
 
+def task_grid_parity():
+    """The spec-grid differential suite as one named exit-1 gate: the
+    factorized-vs-legacy contraction parity (``tests/test_grid_factorize``),
+    the device-vs-host bootstrap aggregation (``tests/test_boot_device``),
+    the banked-query-vs-engine differential (``tests/test_grambank``) and
+    every other ``specgrid``-marked Gram-route pin — the pre-merge gate
+    for anything touching the month-axis factorization or the solve tail.
+    Complements ``perf_regress`` (which gates the ``grid_factorized_*`` /
+    ``grid_boot_*`` bench series the same layer produces, archived since
+    BENCH_r08) and ``robustness_smoke``/``multiprocess_smoke``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m specgrid -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "specgrid marker differential suite (factorized Gram "
+               "parity, device bootstrap, gram bank) — exit-1 on any "
+               "failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }
+
+
 if __name__ == "__main__":
     try:
         from doit.doit_cmd import DoitMain

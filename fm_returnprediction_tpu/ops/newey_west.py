@@ -19,8 +19,9 @@ exactly under static shapes.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["nw_mean_se", "compact_front"]
+__all__ = ["nw_mean_se", "nw_mean_se_np", "compact_front"]
 
 
 def compact_front(x: jnp.ndarray, valid: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -69,3 +70,35 @@ def nw_mean_se(
 
     var_mean = (gamma0 + 2.0 * acc) / jnp.maximum(nf, 1.0) ** 2
     return jnp.where(n >= 2, jnp.sqrt(var_mean), jnp.nan)
+
+
+def nw_mean_se_np(vals: np.ndarray, lags: int = 4,
+                  weight: str = "reference") -> float:
+    """Numpy mirror of :func:`nw_mean_se` on an ALREADY-compacted valid
+    series — the host-route oracle of the spec-grid bootstrap aggregation
+    (``specgrid.boot``; historically ``specgrid.engine._nw_se_np``, moved
+    here so the jax kernel and its host mirror live behind one
+    differential-pinned home, ``tests/test_boot_device.py``).
+
+    Same contracts as the jax path: fewer than 2 entries → NaN, and a
+    negative small-sample HAC variance is legal and reads as NaN (the
+    guard/checks NW-tap note).
+    """
+    vals = np.asarray(vals, float)
+    n = vals.size
+    if n < 2:
+        return float("nan")
+    u = vals - vals.mean()
+    gamma0 = float(u @ u)
+    acc = 0.0
+    for k in range(1, lags + 1):
+        gamma_k = float(u[k:] @ u[:-k]) if k < n else 0.0
+        if weight == "reference":
+            w = max(1.0 - k / n, 0.0)
+        elif weight == "textbook":
+            w = 1.0 - k / (lags + 1.0)
+        else:
+            raise ValueError(f"Unknown NW weight scheme: {weight}")
+        acc += w * gamma_k
+    var_mean = (gamma0 + 2.0 * acc) / n**2
+    return float(np.sqrt(var_mean)) if var_mean >= 0 else float("nan")

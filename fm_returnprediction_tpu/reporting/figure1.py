@@ -272,9 +272,28 @@ def rolling_slopes(
 
     # Roll over consecutive surviving result rows (the reference rolls the
     # slope FRAME, src/calc_Lewellen_2014.py:926), label by their dates.
-    rolled_cal = rolled if rolled is not None else rolling_over_valid_rows(
-        cs.slopes, cs.month_valid, window, min_periods
-    )
+    # An explicit FMRP_BOOT_ROUTE=device routes this through the same
+    # gathered month-window aggregator the bootstrap draws ride
+    # (specgrid.boot.rolling_fm_windows — each rolling point is one
+    # gather row); the fused-cumsum route stays the pinned default, and
+    # the two are differentially locked in tests/test_boot_device.py.
+    if rolled is not None:
+        rolled_cal = rolled
+    else:
+        from fm_returnprediction_tpu.specgrid.boot import (
+            resolve_boot_route,
+            rolling_fm_windows,
+        )
+
+        if resolve_boot_route() == "device":
+            rolled_cal = rolling_fm_windows(
+                np.asarray(cs.slopes), np.asarray(cs.month_valid),
+                window, min_periods,
+            )
+        else:
+            rolled_cal = rolling_over_valid_rows(
+                cs.slopes, cs.month_valid, window, min_periods
+            )
     valid = np.asarray(cs.month_valid)
     months = pd.DatetimeIndex(panel.months)[valid]
     frame = pd.DataFrame(

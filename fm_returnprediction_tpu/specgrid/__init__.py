@@ -58,7 +58,9 @@ from fm_returnprediction_tpu.specgrid.sinks import (
     resolve_sink,
 )
 from fm_returnprediction_tpu.specgrid.scenarios import (
+    bank_for_scenarios,
     run_scenarios,
+    run_scenarios_banked,
     scenario_grid,
     subperiod_windows,
     winsor_variant,
@@ -86,12 +88,22 @@ from fm_returnprediction_tpu.specgrid.specs import (
 _SHARDED_NAMES = ("resolve_specgrid_mesh", "sharded_grid_parts",
                   "specgrid_mesh")
 
+# the gram bank loads lazily for the same reason: it pulls the registry
+# plane, which a plain Table-2 import never touches
+_GRAMBANK_NAMES = ("GramBank", "build_bank", "save_bank", "load_bank",
+                   "ingest_month", "window_query", "bootstrap_query",
+                   "scenario_query", "bank_key")
+
 
 def __getattr__(name):
     if name in _SHARDED_NAMES:
         from fm_returnprediction_tpu.specgrid import sharded
 
         return getattr(sharded, name)
+    if name in _GRAMBANK_NAMES:
+        from fm_returnprediction_tpu.specgrid import grambank
+
+        return getattr(grambank, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
@@ -103,6 +115,7 @@ __all__ = [
     "CellTile",
     "CoresetPlan",
     "FrameSink",
+    "GramBank",
     "ParquetSink",
     "Sink",
     "Spec",
@@ -112,10 +125,16 @@ __all__ = [
     "SummarySink",
     "TopKSink",
     "auto_firm_chunk",
+    "bank_for_scenarios",
+    "bank_key",
     "block_bootstrap_months",
+    "bootstrap_query",
+    "build_bank",
     "contract_spec_grams",
     "coreset_plan",
     "figure1_grid",
+    "ingest_month",
+    "load_bank",
     "product_grid",
     "program_trace_counts",
     "resolve_route",
@@ -123,15 +142,19 @@ __all__ = [
     "resolve_specgrid_mesh",
     "run_cellspace",
     "run_scenarios",
+    "run_scenarios_banked",
     "run_spec_grid",
     "run_spec_grid_on_panel",
     "run_spec_grid_weights",
+    "save_bank",
     "scenario_grid",
+    "scenario_query",
     "scenario_space",
     "sharded_grid_parts",
     "solve_spec_stats",
     "specgrid_mesh",
     "subperiod_windows",
     "table2_grid",
+    "window_query",
     "winsor_variant",
 ]

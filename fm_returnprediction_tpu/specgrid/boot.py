@@ -1,0 +1,263 @@
+"""Device-batched month-resample aggregation — bootstrap draws and rolling
+windows as ONE vmapped program over a (T, P) slope series.
+
+Every Lewellen Table-2/Figure-1 estimand beyond the point estimate differs
+only in WHICH months enter the FM aggregation: a bootstrap draw is a
+month resample, a subperiod is a month mask, a Figure-1 rolling point is a
+window of consecutive surviving months. The tile engine historically
+re-aggregated bootstrap draws host-side, one draw at a time
+(``engine._fm_aggregate_np`` over ``engine._nw_se_np`` — tiny O(T·P) numpy
+work chosen because a device dispatch PER DRAW would dominate). This
+module batches the month-gather axis instead: one jitted program gathers
+D index rows of the (T, P) slope series and runs the EXISTING FM summary
+(``ops.fama_macbeth.fama_macbeth_summary`` — mean + Newey-West SE with the
+reference's compact-then-lag semantics) under ``vmap``, so a 1000-draw
+cell costs one dispatch, not 1000 host loops. The same gathered program
+serves Figure-1's 120-month rolling slope means (``rolling_fm_windows``:
+each rolling point is a gather row of the last ``window`` surviving
+months), which is what makes the Gram bank's window/bootstrap queries one
+code path (``specgrid.grambank``).
+
+Routes (``FMRP_BOOT_ROUTE``):
+
+- ``"device"`` — the batched program above;
+- ``"host"``   — the retained per-draw numpy loop (``fm_aggregate_np``,
+  the differential oracle; its NW kernel now lives in
+  ``ops.newey_west.nw_mean_se_np`` next to the jax kernel it mirrors);
+- ``"auto"``   — device whenever a sweep actually has bootstrap draws
+  (the amortization case), host otherwise. Figure-1's rolling means keep
+  their incumbent fused-cumsum route except under an explicit
+  ``"device"`` (the figure is a pinned parity surface; the gathered
+  route is differentially tested against it, ``tests/test_boot_device.py``).
+
+Numerics: the device route aggregates in the slope series' dtype (f64
+under x64 — the parity configuration, pinned ≤1e-12 against the host
+float64 oracle; f32 panels carry f32 rounding into draw rows, disclosed
+the same way the bf16 contraction route is).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth_summary
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se_np
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+
+__all__ = [
+    "BOOT_ROUTES",
+    "resolve_boot_route",
+    "fm_aggregate_np",
+    "resample_matrix",
+    "bootstrap_aggregate_device",
+    "bootstrap_aggregate_pairs",
+    "rolling_fm_windows",
+]
+
+BOOT_ROUTES = ("auto", "device", "host")
+
+
+def resolve_boot_route(route: Optional[str] = None) -> str:
+    """The bootstrap/rolling aggregation route: explicit argument >
+    ``FMRP_BOOT_ROUTE`` env > ``"auto"``. Resolved OUTSIDE jit (the
+    repo's knob discipline: a static program choice, flippable per
+    call)."""
+    if route is None:
+        route = os.environ.get("FMRP_BOOT_ROUTE", "auto").strip().lower() \
+            or "auto"
+    if route not in BOOT_ROUTES:
+        raise ValueError(
+            f"boot route must be one of {BOOT_ROUTES}, got {route!r}"
+        )
+    return route
+
+
+def fm_aggregate_np(slopes, r2, n_obs, month_valid,
+                    nw_lags: int, min_months: int, weight: str):
+    """Numpy mirror of ``ops.fama_macbeth.fama_macbeth_summary`` over a
+    (T, P) slope series — the HOST route (and differential oracle) of the
+    bootstrap re-aggregation, applied to month-RESAMPLED series (same
+    dropna/min-months/NW semantics; the input row order is the resampled
+    order, which is what the autocovariances should see)."""
+    slopes = np.asarray(slopes, float)
+    month_valid = np.asarray(month_valid, bool)
+    slope_valid = month_valid[:, None] & np.isfinite(slopes)
+    count = slope_valid.sum(axis=0)
+    p = slopes.shape[1]
+    coef = np.full(p, np.nan)
+    tstat = np.full(p, np.nan)
+    nw_se = np.full(p, np.nan)
+    for j in range(p):
+        vals = slopes[slope_valid[:, j], j]
+        se = nw_mean_se_np(vals, nw_lags, weight)
+        if vals.size:
+            mean = float(vals.mean())
+        else:
+            mean = np.nan
+        nw_se[j] = se
+        if count[j] >= min_months:
+            coef[j] = mean
+            tstat[j] = mean / se if se and np.isfinite(se) else np.nan
+    r2 = np.asarray(r2, float)
+    r2_valid = month_valid & np.isfinite(r2)
+    mean_r2 = float(r2[r2_valid].mean()) if r2_valid.any() else float("nan")
+    n_months = int(month_valid.sum())
+    mean_n = (float(np.asarray(n_obs, float)[month_valid].mean())
+              if n_months else float("nan"))
+    return coef, tstat, nw_se, mean_r2, mean_n, n_months
+
+
+def resample_matrix(t: int, draws: int, seed: int = 0,
+                    block: Optional[int] = None) -> np.ndarray:
+    """The (draws-1, T) stack of circular moving-block month resamples for
+    draws 1..draws-1 (draw 0 is the point estimate and never resampled) —
+    the ONE gather operand the batched device aggregation consumes per
+    sweep, built from the same per-draw generator every host-route draw
+    uses (``engine.block_bootstrap_months``), so the two routes see
+    byte-identical index rows."""
+    from fm_returnprediction_tpu.specgrid.engine import block_bootstrap_months
+
+    if draws < 2:
+        return np.zeros((0, t), np.int64)
+    return np.stack([
+        block_bootstrap_months(t, d, seed=seed, block=block)
+        for d in range(1, draws)
+    ])
+
+
+def _gathered_fm(slopes, r2, n_obs, month_valid, idx, in_window,
+                 nw_lags: int, min_months: int, weight: str):
+    """ONE vmapped computation: gather D month-index rows of a (T, P)
+    slope series and run the existing FM summary on each gathered series.
+
+    ``idx`` (D, W) gathers along the month axis; ``in_window`` (D, W) bool
+    masks gathered slots that exist (short rolling windows pad with an
+    arbitrary index and mask it off — a masked slot contributes exactly
+    like a month the FM summary already drops). The summary is
+    ``fama_macbeth_summary`` itself — mean/NW-SE/min-months/dropna
+    semantics are inherited, not re-derived."""
+    def one(rows, keep):
+        cs = CSRegressionResult(
+            slopes=slopes[rows],
+            intercept=jnp.zeros(rows.shape[0], slopes.dtype),
+            r2=r2[rows],
+            n_obs=n_obs[rows],
+            month_valid=month_valid[rows] & keep,
+        )
+        return fama_macbeth_summary(
+            cs, nw_lags=nw_lags, min_months=min_months, weight=weight
+        )
+
+    return jax.vmap(one)(idx, in_window)
+
+
+_gathered_fm_program = functools.partial(
+    jax.jit, static_argnames=("nw_lags", "min_months", "weight")
+)(_gathered_fm)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nw_lags", "min_months", "weight")
+)
+def _gathered_fm_pairs_program(slopes, r2, n_obs, month_valid, idx,
+                               in_window, *, nw_lags: int, min_months: int,
+                               weight: str):
+    """The pairs-batched twin: a SECOND vmap axis over K series sharing
+    one (D, W) gather plan — all of a bank query's pairs × draws in one
+    dispatch (``grambank.bootstrap_query``), instead of a host loop
+    paying one round-trip per pair."""
+    return jax.vmap(
+        lambda s, r, n, mv: _gathered_fm(
+            s, r, n, mv, idx, in_window, nw_lags, min_months, weight
+        )
+    )(slopes, r2, n_obs, month_valid)
+
+
+def bootstrap_aggregate_device(slopes, r2, n_obs, month_valid, idx,
+                               nw_lags: int, min_months: int, weight: str):
+    """All of one spec's bootstrap draws in one dispatch: gather the
+    (D, T) resample rows of the (T, P) slope series and FM-aggregate each
+    on device. Returns host numpy ``(coef (D, P), tstat (D, P),
+    nw_se (D, P), mean_r2 (D,), mean_n (D,), n_months (D,))`` — one row
+    per draw, same fields as the host oracle ``fm_aggregate_np``."""
+    idx = jnp.asarray(idx)
+    out = _gathered_fm_program(
+        jnp.asarray(slopes), jnp.asarray(r2), jnp.asarray(n_obs),
+        jnp.asarray(month_valid), idx,
+        jnp.ones(idx.shape, bool),
+        nw_lags=int(nw_lags), min_months=int(min_months), weight=str(weight),
+    )
+    coef, tstat, nw_se, mean_r2, mean_n, n_months = jax.device_get(out)
+    return (np.asarray(coef), np.asarray(tstat), np.asarray(nw_se),
+            np.asarray(mean_r2), np.asarray(mean_n),
+            np.asarray(n_months).astype(np.int64))
+
+
+def bootstrap_aggregate_pairs(slopes, r2, n_obs, month_valid, idx,
+                              nw_lags: int, min_months: int, weight: str):
+    """All draws of ALL K series in one dispatch: ``slopes`` (K, T, P),
+    ``r2``/``n_obs``/``month_valid`` (K, T), ``idx`` (D, T) shared draw
+    rows. Returns host numpy ``(coef (K, D, P), tstat, nw_se,
+    mean_r2 (K, D), mean_n, n_months)`` — per-series rows identical to
+    :func:`bootstrap_aggregate_device` on that series."""
+    idx = jnp.asarray(idx)
+    out = _gathered_fm_pairs_program(
+        jnp.asarray(slopes), jnp.asarray(r2), jnp.asarray(n_obs),
+        jnp.asarray(month_valid), idx, jnp.ones(idx.shape, bool),
+        nw_lags=int(nw_lags), min_months=int(min_months), weight=str(weight),
+    )
+    coef, tstat, nw_se, mean_r2, mean_n, n_months = jax.device_get(out)
+    return (np.asarray(coef), np.asarray(tstat), np.asarray(nw_se),
+            np.asarray(mean_r2), np.asarray(mean_n),
+            np.asarray(n_months).astype(np.int64))
+
+
+def _rolling_gather(valid: np.ndarray, window: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side gather plan for rolling-over-surviving-rows: one (V, W)
+    index row per surviving month (its window = the last ``window``
+    surviving months up to and including it), plus the (V, W) in-window
+    mask and the (V,) calendar positions the results scatter back to."""
+    pos = np.flatnonzero(np.asarray(valid, bool))
+    v = pos.size
+    idx = np.zeros((v, window), np.int64)
+    keep = np.zeros((v, window), bool)
+    for j in range(v):
+        lo = max(0, j - window + 1)
+        rows = pos[lo:j + 1]
+        idx[j, :rows.size] = rows
+        keep[j, :rows.size] = True
+    return idx, keep, pos
+
+
+def rolling_fm_windows(slopes, month_valid, window: int, min_periods: int):
+    """Figure-1's rolling slope means through the SAME gathered aggregator
+    as the bootstrap draws: each rolling point is one gather row (the last
+    ``window`` surviving months), its mean is the FM summary's ``coef``
+    with ``min_months=min_periods``. Returns the calendar-placed (T, P)
+    array — the differential twin of
+    ``ops.compaction.rolling_over_valid_rows`` (pinned in
+    ``tests/test_boot_device.py``); the figure's default stays the fused
+    cumsum route, this is the route the window-sweep/Gram-bank side
+    shares with the draws."""
+    slopes = np.asarray(slopes)
+    month_valid = np.asarray(month_valid, bool)
+    t, p = slopes.shape
+    out = np.full((t, p), np.nan, slopes.dtype)
+    if not month_valid.any():
+        return out
+    idx, keep, pos = _rolling_gather(month_valid, int(window))
+    res = _gathered_fm_program(
+        jnp.asarray(slopes),
+        jnp.zeros(t, slopes.dtype), jnp.zeros(t, slopes.dtype),
+        jnp.asarray(month_valid), jnp.asarray(idx), jnp.asarray(keep),
+        nw_lags=0, min_months=int(min_periods), weight="reference",
+    )
+    out[pos] = np.asarray(jax.device_get(res.coef))
+    return out

@@ -132,6 +132,15 @@ class CellSpace:
         return len(self.regressor_sets) * len(self.universes) * len(self.windows)
 
     @property
+    def n_pairs(self) -> int:
+        """Size of the (set, universe) PAIR product — the factorized
+        contraction axis (``specgrid.grams.unique_pairs``): specs
+        differing only in their sample window share one pair, so a
+        W-window sweep contracts ``n_pairs`` spec-rows, not
+        ``n_specs = n_pairs · W``."""
+        return len(self.regressor_sets) * len(self.universes)
+
+    @property
     def union_predictors(self) -> Tuple[str, ...]:
         """Union of every set's columns, first-seen order — the column
         order of the union tensor every tile contracts."""
@@ -175,6 +184,12 @@ class CellSpace:
         rem, u = divmod(rem, n_uni)
         _, s = divmod(rem, len(self.regressor_sets))
         return (s * n_uni + u) * n_wins + w
+
+    def pair_index(self, index: int) -> int:
+        """The cell's position in the (set, universe) pair product — cells
+        differing only in winsor/weight/WINDOW/draw share it (and, under
+        the factorized route, share one panel contraction)."""
+        return self.spec_index(index) // len(self.windows)
 
     def tiles(self, tile_cells: Optional[int] = None) -> Iterator["CellTile"]:
         """Fixed-width contiguous tiles covering the space exactly once.

@@ -34,6 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from fm_returnprediction_tpu.specgrid.boot import (
+    bootstrap_aggregate_device,
+    fm_aggregate_np,
+)
 from fm_returnprediction_tpu.specgrid.cellspace import (
     Cell,
     CellSpace,
@@ -67,63 +71,10 @@ def block_bootstrap_months(t: int, draw: int, seed: int = 0,
     return idx[:t]
 
 
-def _nw_se_np(vals: np.ndarray, nw_lags: int, weight: str) -> float:
-    """Numpy mirror of ``ops.newey_west.nw_mean_se`` on a compacted valid
-    series — the bootstrap draws re-aggregate resampled series host-side
-    (tiny O(T) work; a device dispatch per draw would dominate)."""
-    n = vals.size
-    if n < 2:
-        return float("nan")
-    u = vals - vals.mean()
-    gamma0 = float(u @ u)
-    acc = 0.0
-    for k in range(1, nw_lags + 1):
-        gamma_k = float(u[k:] @ u[:-k]) if k < n else 0.0
-        if weight == "reference":
-            w = max(1.0 - k / n, 0.0)
-        elif weight == "textbook":
-            w = 1.0 - k / (nw_lags + 1.0)
-        else:
-            raise ValueError(f"Unknown NW weight scheme: {weight}")
-        acc += w * gamma_k
-    var_mean = (gamma0 + 2.0 * acc) / n**2
-    # negative small-sample HAC variance is legal and reads as NaN — the
-    # same contract as the jax path (guard/checks NW-tap note)
-    return float(np.sqrt(var_mean)) if var_mean >= 0 else float("nan")
-
-
-def _fm_aggregate_np(slopes, r2, n_obs, month_valid,
-                     nw_lags: int, min_months: int, weight: str):
-    """Numpy mirror of ``ops.fama_macbeth.fama_macbeth_summary`` over a
-    (T, P) slope series — applied to month-RESAMPLED series for bootstrap
-    draws (same dropna/min-months/NW semantics; order of the input rows is
-    the resampled order, which is what the autocovariances should see)."""
-    slopes = np.asarray(slopes, float)
-    month_valid = np.asarray(month_valid, bool)
-    slope_valid = month_valid[:, None] & np.isfinite(slopes)
-    count = slope_valid.sum(axis=0)
-    p = slopes.shape[1]
-    coef = np.full(p, np.nan)
-    tstat = np.full(p, np.nan)
-    nw_se = np.full(p, np.nan)
-    for j in range(p):
-        vals = slopes[slope_valid[:, j], j]
-        se = _nw_se_np(vals, nw_lags, weight)
-        if vals.size:
-            mean = float(vals.mean())
-        else:
-            mean = np.nan
-        nw_se[j] = se
-        if count[j] >= min_months:
-            coef[j] = mean
-            tstat[j] = mean / se if se and np.isfinite(se) else np.nan
-    r2 = np.asarray(r2, float)
-    r2_valid = month_valid & np.isfinite(r2)
-    mean_r2 = float(r2[r2_valid].mean()) if r2_valid.any() else float("nan")
-    n_months = int(month_valid.sum())
-    mean_n = (float(np.asarray(n_obs, float)[month_valid].mean())
-              if n_months else float("nan"))
-    return coef, tstat, nw_se, mean_r2, mean_n, n_months
+# the host-route draw aggregation (and its NW kernel) moved behind one
+# differential-pinned home: ``specgrid.boot.fm_aggregate_np`` over
+# ``ops.newey_west.nw_mean_se_np`` — the engine now only ROUTES between
+# that host oracle and the device-batched program (``specgrid.boot``)
 
 
 # -- tile grouping ----------------------------------------------------------
@@ -181,10 +132,16 @@ class _Engine:
                  mask, route: str, mesh, referee: bool,
                  firm_chunk, label_of, seed: int,
                  coreset_m, coreset_budget_mb, tile_cells,
-                 gram_route=None, precision=None):
+                 gram_route=None, precision=None, factorize=None,
+                 boot_route=None):
+        from fm_returnprediction_tpu.specgrid.boot import resolve_boot_route
         from fm_returnprediction_tpu.specgrid.grams import (
+            resolve_gram_factorize,
             resolve_gram_precision,
             resolve_gram_route,
+        )
+        from fm_returnprediction_tpu.specgrid.multiproc import (
+            resolve_specgrid_procs,
         )
         from fm_returnprediction_tpu.specgrid.sharded import (
             resolve_specgrid_mesh,
@@ -194,6 +151,7 @@ class _Engine:
         # numerics regimes into one result frame)
         self.gram_route = resolve_gram_route(gram_route)
         self.precision = resolve_gram_precision(precision)
+        self.boot_route = resolve_boot_route(boot_route)
         self.space = space
         self.union = space.union_predictors
         self.y = jnp.asarray(y)
@@ -231,6 +189,43 @@ class _Engine:
             space.n_specs,
             max(1, math.ceil(self.tile_cells / space.bootstrap)),
         )
+        # month-axis factorization (ISSUE 14): resolved once per sweep.
+        # "auto" turns ON exactly when the space repeats (universe,
+        # col_sel) pairs across windows — the tile batches then contract
+        # unique pairs instead of specs — and stays off on the mesh and
+        # multi-process routes, whose contraction programs predate the
+        # knob. ``pair_pad`` fixes the factorized program's pair-axis
+        # width for the WHOLE sweep: any run of ``spec_pad`` consecutive
+        # spec indices (windows innermost in the spec product) spans at
+        # most (spec_pad-1)//n_windows + 2 distinct pairs, so one padded
+        # signature serves every batch (the engine's one-compiled-program
+        # discipline).
+        single_device = self.mesh is None and resolve_specgrid_procs(None) == 1
+        fact = resolve_gram_factorize(factorize)
+        if fact == "on" and not single_device:
+            raise ValueError(
+                "factorize='on' is a single-device route — the mesh and "
+                "multi-process contraction programs keep the window term "
+                "in validity (specgrid.solve docstring)"
+            )
+        if fact == "auto":
+            fact = ("on" if single_device and len(space.windows) > 1
+                    else "off")
+        self.gram_factorize = fact
+        n_wins = len(space.windows)
+        n_pairs = len(space.regressor_sets) * len(space.universes)
+        self.pair_pad = (
+            min(self.spec_pad, (self.spec_pad - 1) // n_wins + 2, n_pairs)
+            if fact == "on" else None
+        )
+        # bootstrap draw aggregation: device (one vmapped month-gather
+        # program per spec, all draws in one dispatch) whenever the sweep
+        # actually has draws; the host numpy loop stays the oracle route
+        self.boot_device = self.boot_route == "device" or (
+            self.boot_route == "auto" and space.bootstrap > 1
+        )
+        self._boot_cache: Dict[Tuple[int, str], tuple] = {}
+        self._resample_mat: Optional[np.ndarray] = None
         t, n = self.y.shape
         self._resample_cache: Dict[int, np.ndarray] = {}
         self._winsor_cache: Optional[Tuple[float, object]] = None
@@ -311,6 +306,7 @@ class _Engine:
             firm_chunk=self.firm_chunk, mesh=self.mesh,
             row_weights=self.row_weights,
             gram_route=self.gram_route, precision=self.precision,
+            factorize=self.gram_factorize, pair_pad=self.pair_pad,
         )
 
     def resample(self, draw: int) -> np.ndarray:
@@ -322,6 +318,34 @@ class _Engine:
             if len(self._resample_cache) > 8:  # bounded; draws arrive in order
                 self._resample_cache.pop(next(iter(self._resample_cache)))
         return idx
+
+    def resamples(self) -> np.ndarray:
+        """The (draws-1, T) resample stack the device route gathers — all
+        cells of a sweep share one paired-bootstrap matrix, built once."""
+        if self._resample_mat is None:
+            from fm_returnprediction_tpu.specgrid.boot import resample_matrix
+
+            self._resample_mat = resample_matrix(
+                int(self.y.shape[0]), self.space.bootstrap, seed=self.seed
+            )
+        return self._resample_mat
+
+    def boot_draws(self, cell: Cell, res, row: int) -> tuple:
+        """Every bootstrap draw of one (spec, weight, winsor) run in ONE
+        device dispatch (``boot.bootstrap_aggregate_device``), cached for
+        the run's remaining cells — draws are the innermost cell dimension,
+        so the whole run lives inside one tile and the cache is cleared at
+        tile boundaries."""
+        key = (cell.index - cell.draw, cell.weight)
+        out = self._boot_cache.get(key)
+        if out is None:
+            out = bootstrap_aggregate_device(
+                res.slopes[row], res.r2[row], res.n_obs[row],
+                res.month_valid[row], self.resamples(),
+                self.space.nw_lags, self.space.min_months, cell.weight,
+            )
+            self._boot_cache[key] = out
+        return out
 
     def coreset_rate(self, cell: Cell) -> float:
         key = (cell.universe, cell.window)
@@ -351,10 +375,25 @@ class _Engine:
             mean_r2 = float(res.mean_r2[row])
             mean_n = float(res.mean_n[row])
             n_months = int(res.n_months[row])
+        elif self.boot_device:
+            d = cell.draw - 1  # draw rows start at draw 1
+            coef_d, tstat_d, nw_d, r2_d, n_d, m_d = self.boot_draws(
+                cell, res, row
+            )
+            coef_c, tstat_c, nw_c = coef_d[d], tstat_d[d], nw_d[d]
+            mean_r2, mean_n, n_months = (
+                float(r2_d[d]), float(n_d[d]), int(m_d[d])
+            )
+            coef = np.full(len(self.union), np.nan)
+            tstat = np.full(len(self.union), np.nan)
+            nw_se = np.full(len(self.union), np.nan)
+            coef[pos] = coef_c[pos]
+            tstat[pos] = tstat_c[pos]
+            nw_se[pos] = nw_c[pos]
         else:
             idx = self.resample(cell.draw)
             coef_c, tstat_c, nw_c, mean_r2, mean_n, n_months = (
-                _fm_aggregate_np(
+                fm_aggregate_np(
                     res.slopes[row][idx], res.r2[row][idx],
                     res.n_obs[row][idx], res.month_valid[row][idx],
                     space.nw_lags, space.min_months, cell.weight,
@@ -424,6 +463,8 @@ def run_cellspace(
     output_dir=None,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
+    factorize: Optional[str] = None,
+    boot_route: Optional[str] = None,
 ):
     """Stream a ``CellSpace`` sweep through a sink.
 
@@ -435,6 +476,7 @@ def run_cellspace(
     reads them).
     """
     from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.specgrid.solve import contraction_counts
 
     sink_obj: Sink = resolve_sink(sink, output_dir=output_dir)
     engine = _Engine(
@@ -443,7 +485,9 @@ def run_cellspace(
         firm_chunk=firm_chunk, label_of=label_of, seed=seed,
         coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
         tile_cells=tile_cells, gram_route=gram_route, precision=precision,
+        factorize=factorize, boot_route=boot_route,
     )
+    contractions_before = contraction_counts()
     cells_counter = telemetry.registry().counter(
         "fmrp_specgrid_cells_total",
         help="scenario cells streamed through the spec-grid tile engine",
@@ -462,9 +506,16 @@ def run_cellspace(
                         res, row = solver.cell_view(cell)
                         frames.extend(engine.rows_for(cell, res, row))
                     del solver  # one tile of solve leaves live at a time
+                engine._boot_cache.clear()  # draw runs never straddle tiles
                 sink_obj.consume(pd.DataFrame(frames))
                 cells_counter.inc(len(tile))
             n_tiles += 1
+    contractions_after = contraction_counts()
+    c_delta = {
+        k: contractions_after.get(k, 0) - contractions_before.get(k, 0)
+        for k in ("specs_solved", "specs_contracted", "pairs_contracted",
+                  "pairs_unique")
+    }
     stats = {
         "cells": len(space),
         "rows": sink_obj.rows_seen,
@@ -476,6 +527,20 @@ def run_cellspace(
         "route": route,
         "gram_route": engine.gram_route,
         "precision": engine.precision,
+        # the ISSUE-14 acceptance ledger: how many spec-rows the panel
+        # contraction actually ran vs specs solved — under the factorized
+        # route the per-tile contraction axis is unique (universe,
+        # col_sel) pairs (plus inert signature-pad repeats), not S
+        "gram_factorize": engine.gram_factorize,
+        "boot_route": ("device" if engine.boot_device else "host"),
+        "specs_solved": c_delta["specs_solved"],
+        "specs_contracted": (
+            c_delta["pairs_contracted"]
+            if engine.gram_factorize == "on"
+            else c_delta["specs_contracted"]
+        ),
+        "pairs_unique": c_delta["pairs_unique"],
+        "pair_pad": engine.pair_pad,
     }
     if engine.plan is not None:
         stats["coreset_m"] = engine.plan.m_per_month

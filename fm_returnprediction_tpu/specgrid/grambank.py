@@ -1,0 +1,678 @@
+"""Registry Gram bank — content-addressed per-month Gram sufficient stats.
+
+The month-axis factorization's persistence leg (ISSUE 14 part c). A bank
+holds the UNWINDOWED per-(universe, col_sel)-pair, per-month Gram stats of
+one panel — exactly what ``contract_spec_grams(window=None)`` produces for
+the factorized grid route — as a registry artifact. Because every
+Table-2/Figure-1 estimand beyond the point estimate differs only in WHICH
+months enter the FM aggregation, the banked ``(K, T, Q, Q)`` leaves answer
+
+- a NEW WINDOW query  — mask the month axis (``solve.expand_window_stats``,
+  exact) and run the existing padded solve + FM tail, and
+- a NEW BOOTSTRAP query — solve the slope series once, then the
+  device-batched month-resample aggregation (``specgrid.boot``),
+
+both in O(T·Q²)-per-pair work, WITHOUT touching the ``(T, N, P)`` panel —
+the scenario-service latency story of ROADMAP item 5. ``ingest_month``
+appends one month's cross-section by Gram additivity (one O(N·Q²) monthly
+contraction), the live-service bridge: the bank a batch run published
+keeps answering queries as new months arrive.
+
+Keying follows the registry's executable discipline
+(``registry.executables.executable_key``): the entry address is a sha256
+over the caller's data fingerprint, the union/universe names, a digest of
+the pair selectors, the MONTH-AXIS labels (an ``ingest_month``-grown bank
+is a different panel and publishes to a different entry — never over its
+parent), the stats dtype, and the contraction route/precision. The x64
+flag rides the entry META instead of the key, so a bank contracted under
+x64 never silently answers an x32 process: the skewed process hits the
+entry and gets a WARNED miss (callers re-contract). Entries live on the artifact plane
+(``artifacts/gram_bank/<key>/``) under the registry's crash-consistency +
+manifest protocol; corruption surfaces as the usual typed
+``CorruptArtifactError`` → rebuild.
+
+Honest contract: bank queries have no QR referee — the panel is not there
+to re-solve against — so suspect months are DISCLOSED per pair
+(``suspect_months`` column) instead of refereed; callers needing the
+refereed numbers run the full grid route. Differential parity of the
+non-suspect cells against the refereed engine is pinned in
+``tests/test_grambank.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import io
+import json
+import tempfile
+import warnings
+from pathlib import Path
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.specgrid.grams import (
+    SpecGramStats,
+    contract_spec_grams,
+    resolve_gram_precision,
+    resolve_gram_route,
+    unique_pairs,
+)
+
+__all__ = [
+    "GramBank",
+    "bank_key",
+    "build_bank",
+    "save_bank",
+    "load_bank",
+    "ingest_month",
+    "window_query",
+    "bootstrap_query",
+    "scenario_query",
+]
+
+BANK_NAME = "gram_bank"
+BANK_FILE = "bank.npz"
+#: bump when the banked-array layout changes — an old bank must read as a
+#: miss to a new process, never as a half-compatible hit
+BANK_SCHEMA = 1
+
+_ARRAY_FIELDS = ("gram", "moment", "n", "ysum", "yy", "center",
+                 "uidx", "col_sel", "months")
+
+
+class GramBank(NamedTuple):
+    """One panel's banked per-pair, per-month Gram stats (host numpy).
+
+    ``gram``/``moment``/``n``/``ysum``/``yy`` are the UNWINDOWED
+    ``SpecGramStats`` leaves over the K unique (universe, col_sel) pairs;
+    ``center`` is the (T, P) per-month shift they were contracted against
+    (the additivity anchor — ``ingest_month`` extends it one row per
+    appended month). ``months`` carries the calendar labels so window
+    queries and ingest stay month-addressed, and ``pair_labels`` names
+    each pair ``(set_name, universe_name)`` for the tidy query frames."""
+
+    gram: np.ndarray          # (K, T, Q, Q)
+    moment: np.ndarray        # (K, T, Q)
+    n: np.ndarray             # (K, T)
+    ysum: np.ndarray          # (K, T)
+    yy: np.ndarray            # (K, T)
+    center: np.ndarray        # (T, P)
+    uidx: np.ndarray          # (K,) universe row per pair
+    col_sel: np.ndarray       # (K, P) bool
+    months: np.ndarray        # (T,) int64 calendar labels
+    union: Tuple[str, ...]    # union predictor column names (P)
+    universes: Tuple[str, ...]  # universe names (U)
+    pair_labels: Tuple[Tuple[str, str], ...]  # (set_name, universe) per pair
+    dtype: str                # panel dtype the stats were contracted in
+    meta: dict                # provenance: fingerprint, route, precision...
+
+    @property
+    def n_pairs(self) -> int:
+        return int(self.gram.shape[0])
+
+    @property
+    def n_months(self) -> int:
+        return int(self.gram.shape[1])
+
+    def stats(self) -> SpecGramStats:
+        """The banked leaves as a device ``SpecGramStats`` tree."""
+        return SpecGramStats(
+            jnp.asarray(self.gram), jnp.asarray(self.moment),
+            jnp.asarray(self.n), jnp.asarray(self.ysum),
+            jnp.asarray(self.yy), jnp.asarray(self.center),
+        )
+
+
+def _pairs_digest(uidx: np.ndarray, col_sel: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(uidx, np.int64)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(col_sel, bool)).tobytes())
+    return h.hexdigest()
+
+
+def bank_key(
+    fingerprint: str,
+    union: Sequence[str],
+    universes: Sequence[str],
+    uidx: np.ndarray,
+    col_sel: np.ndarray,
+    dtype: str,
+    months: np.ndarray,
+    gram_route: str,
+    precision: str,
+) -> str:
+    """Content address of one bank entry, keyed like registry programs:
+    data provenance + pair selectors + MONTH AXIS + contraction numerics.
+    Anything that changes the banked NUMBERS changes the key — the month
+    digest is what keeps an ``ingest_month``-grown bank from silently
+    REPLACING its parent at the parent's address (the grown bank is a
+    different panel; it publishes to a different entry). The x64 flag is
+    deliberately NOT keyed: it lives in the entry meta so a skewed
+    process HITS the entry and gets the documented warned miss
+    (``load_bank``) instead of a silent absent-entry one."""
+    payload = json.dumps(
+        {
+            "schema": BANK_SCHEMA,
+            "fingerprint": str(fingerprint),
+            "union": list(union),
+            "universes": list(universes),
+            "pairs": _pairs_digest(uidx, col_sel),
+            "months": hashlib.sha256(
+                np.ascontiguousarray(np.asarray(months, np.int64)).tobytes()
+            ).hexdigest(),
+            "dtype": str(dtype),
+            "gram_route": gram_route,
+            "precision": precision,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def _space_pairs(space) -> Tuple[np.ndarray, np.ndarray, tuple]:
+    """The (uidx, col_sel, pair_labels) of a ``CellSpace``'s (set,
+    universe) pair product in pair-index order (set-major, the same order
+    ``cellspace.pair_index`` addresses)."""
+    union = space.union_predictors
+    pos = {c: i for i, c in enumerate(union)}
+    uidx, col_sel, labels = [], [], []
+    for set_name, cols in space.regressor_sets:
+        sel = np.zeros(len(union), bool)
+        for c in cols:
+            sel[pos[c]] = True
+        for u, uni in enumerate(space.universes):
+            uidx.append(u)
+            col_sel.append(sel)
+            labels.append((set_name, uni))
+    return (np.asarray(uidx, np.int64), np.stack(col_sel),
+            tuple(labels))
+
+
+def build_bank(
+    y,
+    x,
+    universe_masks: Dict[str, object],
+    space,
+    months: Optional[np.ndarray] = None,
+    fingerprint: str = "adhoc",
+    firm_chunk: Optional[int] = None,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
+) -> GramBank:
+    """Contract one panel into a bank: ONE ``window=None`` contraction
+    over the space's unique (universe, col_sel) pairs — the same program
+    shape the factorized grid route runs, reused here as the bank's
+    producer. ``x`` holds ``space.union_predictors`` columns; ``months``
+    defaults to ``0..T-1`` index labels."""
+    gram_route = resolve_gram_route(gram_route)
+    precision = resolve_gram_precision(precision)
+    uidx, col_sel, labels = _space_pairs(space)
+    # dedup defensively: duplicated regressor sets collapse to one pair
+    uidx_u, col_sel_u, pair_idx = unique_pairs(uidx, col_sel)
+    y = jnp.asarray(y)
+    x = jnp.asarray(x)
+    names = list(universe_masks)
+    missing = [u for u in space.universes if u not in names]
+    if missing:
+        raise ValueError(f"universe masks missing for {missing}")
+    universes = jnp.stack([
+        jnp.asarray(universe_masks[u]) for u in space.universes
+    ])
+    from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["grambank_contract"] += 1
+    record_trace("grambank_contract")
+    stats = jax.device_get(contract_spec_grams(
+        y, x, universes, jnp.asarray(uidx_u), jnp.asarray(col_sel_u), None,
+        firm_chunk=firm_chunk, route=gram_route, precision=precision,
+    ))
+    t = int(y.shape[0])
+    if months is None:
+        months = np.arange(t, dtype=np.int64)
+    months = np.asarray(months, np.int64)
+    if months.shape != (t,):
+        raise ValueError(
+            f"months labels must be (T,) == ({t},), got {months.shape}"
+        )
+    # re-expand the defensive dedup so pair k always matches labels[k]
+    return GramBank(
+        gram=np.asarray(stats.gram)[pair_idx],
+        moment=np.asarray(stats.moment)[pair_idx],
+        n=np.asarray(stats.n)[pair_idx],
+        ysum=np.asarray(stats.ysum)[pair_idx],
+        yy=np.asarray(stats.yy)[pair_idx],
+        center=np.asarray(stats.center),
+        uidx=uidx,
+        col_sel=col_sel,
+        months=months,
+        union=tuple(space.union_predictors),
+        universes=tuple(space.universes),
+        pair_labels=labels,
+        dtype=str(np.dtype(x.dtype)),
+        meta={
+            "fingerprint": str(fingerprint),
+            "gram_route": gram_route,
+            "precision": precision,
+            "nw_lags": int(space.nw_lags),
+            "min_months": int(space.min_months),
+        },
+    )
+
+
+def ingest_month(
+    bank: GramBank,
+    y_month,
+    x_month,
+    universe_masks_month: Dict[str, object],
+    month: int,
+) -> GramBank:
+    """Append ONE month's cross-section to the bank by Gram additivity —
+    the live scenario-service bridge: an O(N·Q²) monthly contraction
+    extends every banked leaf one slot along the month axis, and every
+    subsequent window/bootstrap query sees the new month with zero panel
+    re-reads.
+
+    ``y_month`` (N,), ``x_month`` (N, P) in the bank's union column
+    order, ``universe_masks_month`` name → (N,) bool for the bank's
+    universes. The month's own center row is its masked column mean —
+    exactly what the full-panel contraction would have computed for that
+    month (the center is per-month, so additivity needs no global
+    agreement across months)."""
+    if int(month) in set(int(m) for m in bank.months):
+        raise ValueError(
+            f"month {month} is already banked — ingest appends new "
+            "months; re-contract to replace one"
+        )
+    dtype = np.dtype(bank.dtype)
+    y1 = jnp.asarray(np.asarray(y_month, dtype)[None, :])      # (1, N)
+    x1 = jnp.asarray(np.asarray(x_month, dtype)[None, :, :])   # (1, N, P)
+    if x1.shape[2] != len(bank.union):
+        raise ValueError(
+            f"x_month has {x1.shape[2]} columns; the bank's union holds "
+            f"{len(bank.union)}"
+        )
+    missing = [u for u in bank.universes if u not in universe_masks_month]
+    if missing:
+        raise ValueError(f"universe masks missing for {missing}")
+    uni1 = jnp.stack([
+        jnp.asarray(universe_masks_month[u])[None, :]
+        for u in bank.universes
+    ])                                                         # (U, 1, N)
+    uidx_u, col_sel_u, pair_idx = unique_pairs(bank.uidx, bank.col_sel)
+    from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["grambank_ingest"] += 1
+    record_trace("grambank_ingest")
+    stats = jax.device_get(contract_spec_grams(
+        y1, x1, uni1, jnp.asarray(uidx_u), jnp.asarray(col_sel_u), None,
+        route=bank.meta.get("gram_route", "xla"),
+        precision=bank.meta.get("precision", "highest"),
+    ))
+
+    def app(old, new):
+        return np.concatenate([old, np.asarray(new)[pair_idx]], axis=1)
+
+    return bank._replace(
+        gram=app(bank.gram, stats.gram),
+        moment=app(bank.moment, stats.moment),
+        n=app(bank.n, stats.n),
+        ysum=app(bank.ysum, stats.ysum),
+        yy=app(bank.yy, stats.yy),
+        center=np.concatenate(
+            [bank.center, np.asarray(stats.center)], axis=0
+        ),
+        months=np.concatenate(
+            [bank.months, np.asarray([int(month)], np.int64)]
+        ),
+    )
+
+
+# -- persistence (registry artifact plane) -----------------------------------
+
+
+def save_bank(bank: GramBank, registry=None) -> Optional[Path]:
+    """Publish the bank as a registry artifact
+    (``artifacts/gram_bank/<key>/bank.npz`` + manifest-bearing meta) under
+    the registry's crash-consistency protocol. Returns the entry dir, or
+    None when the registry is off (banking is an accelerant, never a
+    correctness gate — same contract as every artifact publish)."""
+    from fm_returnprediction_tpu.registry import artifacts as _artifacts
+    from fm_returnprediction_tpu.registry.store import active_registry
+
+    registry = registry or active_registry()
+    if registry is None:
+        return None
+    key = bank_key(
+        bank.meta.get("fingerprint", "adhoc"), bank.union, bank.universes,
+        bank.uidx, bank.col_sel, bank.dtype, bank.months,
+        bank.meta.get("gram_route", "xla"),
+        bank.meta.get("precision", "highest"),
+    )
+    with tempfile.TemporaryDirectory() as td:
+        path = Path(td) / BANK_FILE
+        buf = io.BytesIO()
+        np.savez(buf, **{f: getattr(bank, f) for f in _ARRAY_FIELDS})
+        path.write_bytes(buf.getvalue())
+        return _artifacts.put_files(
+            BANK_NAME, key, [path], registry=registry,
+            meta={
+                "bank_schema": BANK_SCHEMA,
+                "union": list(bank.union),
+                "universes": list(bank.universes),
+                "pair_labels": [list(p) for p in bank.pair_labels],
+                "dtype": bank.dtype,
+                "n_pairs": bank.n_pairs,
+                "n_months": bank.n_months,
+                "x64": bool(jax.config.jax_enable_x64),
+                "bank_meta": dict(bank.meta),
+            },
+        )
+
+
+def load_bank(
+    fingerprint: str,
+    union: Sequence[str],
+    universes: Sequence[str],
+    uidx: np.ndarray,
+    col_sel: np.ndarray,
+    dtype: str,
+    months: np.ndarray,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
+    registry=None,
+) -> Optional[GramBank]:
+    """Fetch the bank for this exact (data, pairs, month-axis, numerics)
+    address, or None on any miss — absent registry, absent entry,
+    schema/env skew (warned), or corruption (the registry's typed error
+    path degrades to a warned miss here: callers re-contract, the
+    universal fallback). ``months`` are the calendar labels the caller
+    expects banked (part of the address — an ingest-grown bank lives at
+    its own entry)."""
+    from fm_returnprediction_tpu.registry import artifacts as _artifacts
+    from fm_returnprediction_tpu.registry import integrity
+    from fm_returnprediction_tpu.registry.store import active_registry
+
+    registry = registry or active_registry()
+    if registry is None:
+        return None
+    gram_route = resolve_gram_route(gram_route)
+    precision = resolve_gram_precision(precision)
+    key = bank_key(fingerprint, union, universes, uidx, col_sel, dtype,
+                   months, gram_route, precision)
+    entry = _artifacts.get_entry_dir(BANK_NAME, key, registry=registry)
+    if entry is None:
+        return None
+    meta = registry.read_meta(entry) or {}
+    if meta.get("bank_schema") != BANK_SCHEMA:
+        return None
+    # env-skew guard (the executable-plane discipline): x64 changes the
+    # banked numbers themselves, so a skewed entry is a miss, not a hit
+    if bool(meta.get("x64")) != bool(jax.config.jax_enable_x64):
+        warnings.warn(
+            f"gram bank {key} was contracted under "
+            f"x64={meta.get('x64')} — skewed against this process; "
+            "re-contracting", stacklevel=2,
+        )
+        return None
+    try:
+        path = _artifacts.get_file(BANK_NAME, BANK_FILE, key,
+                                   registry=registry)
+        if path is None:
+            return None
+        with np.load(path) as z:
+            arrays = {f: np.asarray(z[f]) for f in _ARRAY_FIELDS}
+    except (integrity.CorruptArtifactError, OSError, KeyError,
+            ValueError) as exc:
+        warnings.warn(f"gram bank {key} unreadable ({exc!r}); "
+                      "re-contracting", stacklevel=2)
+        return None
+    from fm_returnprediction_tpu import telemetry
+
+    telemetry.registry().counter(
+        "fmrp_grambank_fetches_total",
+        help="gram-bank registry fetches answered from banked stats",
+    ).inc()
+    return GramBank(
+        **arrays,
+        union=tuple(meta.get("union", list(union))),
+        universes=tuple(meta.get("universes", list(universes))),
+        pair_labels=tuple(
+            tuple(p) for p in meta.get("pair_labels", [])
+        ),
+        dtype=str(meta.get("dtype", dtype)),
+        meta=dict(meta.get("bank_meta", {})),
+    )
+
+
+# -- queries (no panel, O(T·Q²) per pair) ------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nw_lags", "min_months", "weights"),
+)
+def _bank_query_program(gram, moment, n, ysum, yy, center, col_sel,
+                        window, *, nw_lags: int, min_months: int,
+                        weights: Tuple[str, ...]):
+    """ONE fused program per query shape: window-mask the banked additive
+    stats (``expand_window_stats`` with the identity gather — exact) and
+    run the grid route's own padded solve + FM tail. The (T, N, P) panel
+    never appears; the largest operand is the (K, T, Q, Q) bank."""
+    from fm_returnprediction_tpu.specgrid.solve import (
+        PROGRAM_TRACES,
+        _solve_and_aggregate,
+        expand_window_stats,
+    )
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["grambank_query"] += 1
+    record_trace("grambank_query")
+    stats = SpecGramStats(gram, moment, n, ysum, yy, center)
+    k = gram.shape[0]
+    masked = expand_window_stats(stats, jnp.arange(k), window)
+    return _solve_and_aggregate(
+        masked, col_sel, gram.dtype,
+        nw_lags=nw_lags, min_months=min_months, weights=tuple(weights),
+        guard=False,
+    )
+
+
+def _window_mask(bank: GramBank, window) -> np.ndarray:
+    """Normalize a query window to a (T,) bool month mask: None = full
+    sample, ``(lo, hi)`` = half-open MONTH-LABEL range against
+    ``bank.months``, or an explicit (T,) bool mask."""
+    t = bank.n_months
+    if window is None:
+        return np.ones(t, bool)
+    if isinstance(window, (tuple, list)) and len(window) == 2:
+        lo, hi = window
+        mask = (bank.months >= int(lo)) & (bank.months < int(hi))
+        if not mask.any():
+            # almost always label/position confusion (a 0..T-1 index
+            # range against a calendar-labelled bank): an all-NaN query
+            # frame would be a silent wrong answer, so fail loudly — an
+            # explicit (T,) bool mask is the escape hatch
+            raise ValueError(
+                f"window ({lo}, {hi}) matches NO banked month labels "
+                f"(bank holds [{bank.months.min()}, {bank.months.max()}]) "
+                "— ranges address month LABELS, not positions; pass an "
+                "explicit (T,) bool mask to select by position"
+            )
+        return mask
+    mask = np.asarray(window, bool)
+    if mask.shape != (t,):
+        raise ValueError(
+            f"window mask must be ({t},), got {mask.shape}"
+        )
+    return mask
+
+
+class BankQueryResult(NamedTuple):
+    """Host-side result of one bank query, pair-major (the bank's twin of
+    ``SpecGridResult``, minus the referee — disclosed, not re-solved)."""
+
+    slopes: np.ndarray        # (K, T, P) calendar-placed, NaN unselected
+    r2: np.ndarray            # (K, T)
+    n_obs: np.ndarray         # (K, T)
+    month_valid: np.ndarray   # (K, T)
+    coef: np.ndarray          # (K, P)
+    tstat: np.ndarray         # (K, P)
+    nw_se: np.ndarray         # (K, P)
+    mean_r2: np.ndarray       # (K,)
+    mean_n: np.ndarray        # (K,)
+    n_months: np.ndarray      # (K,)
+    suspect_months: np.ndarray  # (K,) disclosed (no referee in the bank)
+
+
+def window_query(
+    bank: GramBank,
+    window=None,
+    nw_lags: Optional[int] = None,
+    min_months: Optional[int] = None,
+    weight: str = "reference",
+) -> BankQueryResult:
+    """FM estimates for every banked pair under a NEW sample window —
+    answered entirely from the banked month-axis stats (mask + solve +
+    aggregate; the panel is never read). ``window`` is None (full), a
+    half-open ``(lo, hi)`` month-label range, or a (T,) bool mask."""
+    nw_lags = int(bank.meta.get("nw_lags", 4) if nw_lags is None
+                  else nw_lags)
+    min_months = int(bank.meta.get("min_months", 10) if min_months is None
+                     else min_months)
+    mask = _window_mask(bank, window)
+    win = jnp.asarray(np.broadcast_to(mask, (bank.n_pairs, bank.n_months)))
+    s = bank.stats()
+    cs, fms, suspect = jax.device_get(_bank_query_program(
+        s.gram, s.moment, s.n, s.ysum, s.yy, s.center,
+        jnp.asarray(bank.col_sel), win,
+        nw_lags=nw_lags, min_months=min_months, weights=(str(weight),),
+    ))
+    fm = fms[0]
+    return BankQueryResult(
+        slopes=np.asarray(cs.slopes),
+        r2=np.asarray(cs.r2),
+        n_obs=np.asarray(cs.n_obs),
+        month_valid=np.asarray(cs.month_valid),
+        coef=np.asarray(fm.coef),
+        tstat=np.asarray(fm.tstat),
+        nw_se=np.asarray(fm.nw_se),
+        mean_r2=np.asarray(fm.mean_r2),
+        mean_n=np.asarray(fm.mean_n),
+        n_months=np.asarray(fm.n_months),
+        suspect_months=np.asarray(suspect).sum(axis=1).astype(np.int64),
+    )
+
+
+def bootstrap_query(
+    bank: GramBank,
+    draws: int,
+    window=None,
+    seed: int = 0,
+    block: Optional[int] = None,
+    nw_lags: Optional[int] = None,
+    min_months: Optional[int] = None,
+    weight: str = "reference",
+):
+    """Bootstrap draws for every banked pair under a (new) window: ONE
+    bank solve for the slope series, then ONE pairs-batched device
+    dispatch for every (pair, draw) aggregation (``specgrid.boot`` — the
+    same gathered program family, the same circular block draws as the
+    engine's archived seeds). Returns ``(point, draws_list)`` where
+    ``point`` is the :func:`window_query` result and ``draws_list[k]``
+    is pair k's ``(coef (D-1, P), tstat, nw_se, mean_r2, mean_n,
+    n_months)`` draw stack (draw 0 — the point estimate — is ``point``
+    itself, the engine's convention)."""
+    from fm_returnprediction_tpu.specgrid.boot import (
+        bootstrap_aggregate_pairs,
+        resample_matrix,
+    )
+
+    if draws < 1:
+        raise ValueError("draws counts the point estimate; must be >= 1")
+    nw_lags = int(bank.meta.get("nw_lags", 4) if nw_lags is None
+                  else nw_lags)
+    min_months = int(bank.meta.get("min_months", 10) if min_months is None
+                     else min_months)
+    point = window_query(bank, window, nw_lags=nw_lags,
+                         min_months=min_months, weight=weight)
+    idx = resample_matrix(bank.n_months, int(draws), seed=seed, block=block)
+    mask = _window_mask(bank, window)
+    stacked = bootstrap_aggregate_pairs(
+        point.slopes, point.r2, point.n_obs,
+        point.month_valid & mask[None, :], idx,
+        nw_lags, min_months, weight,
+    )
+    return point, [tuple(leaf[k] for leaf in stacked)
+                   for k in range(bank.n_pairs)]
+
+
+def scenario_query(
+    bank: GramBank,
+    windows: Optional[Dict[str, object]] = None,
+    bootstrap: int = 1,
+    seed: int = 0,
+    weights: Sequence[str] = ("reference",),
+    label_of: Optional[Dict[str, str]] = None,
+) -> pd.DataFrame:
+    """The scenarios path over banked stats: a tidy frame in the
+    ``run_scenarios`` row schema (model/universe/window/nw_weight/
+    predictor/coef/tstat/... plus ``draw`` when bootstrapped), answered
+    per (window, weight, draw) from the bank — a new-window or
+    new-bootstrap scenario sweep with ZERO panel reads. No QR referee
+    exists here, so ``refereed`` is always False and ``suspect_months``
+    carries the disclosure instead."""
+    windows = windows if windows is not None else {"full": None}
+    label_of = label_of or {}
+    rows = []
+    union = bank.union
+    for win_name, window in windows.items():
+        for w in weights:
+            if bootstrap > 1:
+                point, draw_stacks = bootstrap_query(
+                    bank, bootstrap, window, seed=seed, weight=w)
+            else:
+                point = window_query(bank, window, weight=w)
+                draw_stacks = None
+            for k, (set_name, uni) in enumerate(bank.pair_labels):
+                pos = np.flatnonzero(bank.col_sel[k])
+                for d in range(int(bootstrap)):
+                    if d == 0:
+                        coef, tstat, nw_se = (point.coef[k], point.tstat[k],
+                                              point.nw_se[k])
+                        mean_r2 = float(point.mean_r2[k])
+                        mean_n = float(point.mean_n[k])
+                        n_months = int(point.n_months[k])
+                    else:
+                        cd, td, nd, rd, ndm, md = draw_stacks[k]
+                        coef, tstat, nw_se = cd[d - 1], td[d - 1], nd[d - 1]
+                        mean_r2 = float(rd[d - 1])
+                        mean_n = float(ndm[d - 1])
+                        n_months = int(md[d - 1])
+                    for p in pos:
+                        col = union[p]
+                        r = {
+                            "model": set_name,
+                            "universe": uni,
+                            "window": win_name,
+                            "nw_weight": w,
+                            "predictor": label_of.get(col, col),
+                            "coef": float(coef[p]),
+                            "tstat": float(tstat[p]),
+                            "nw_se": float(nw_se[p]),
+                            "mean_r2": mean_r2,
+                            "mean_n": mean_n,
+                            "n_months": n_months,
+                            "refereed": False,
+                            "suspect_months": int(point.suspect_months[k]),
+                            "source": "bank",
+                        }
+                        if bootstrap > 1:
+                            r["draw"] = d
+                        rows.append(r)
+    return pd.DataFrame(rows)

@@ -59,14 +59,18 @@ __all__ = [
     "SpecGramStats",
     "contract_spec_grams",
     "auto_firm_chunk",
+    "shared_center",
+    "unique_pairs",
     "resolve_gram_route",
     "resolve_gram_precision",
+    "resolve_gram_factorize",
 ]
 
 _PRECISION = jax.lax.Precision.HIGHEST
 
 GRAM_ROUTES = ("xla", "pallas")
 GRAM_PRECISIONS = ("highest", "bf16")
+GRAM_FACTORIZE_MODES = ("auto", "on", "off")
 
 
 def resolve_gram_route(route: Optional[str] = None) -> str:
@@ -101,6 +105,92 @@ def resolve_gram_precision(precision: Optional[str] = None) -> str:
     return precision
 
 
+def resolve_gram_factorize(factorize: Optional[str] = None) -> str:
+    """The month-axis factorization policy: explicit argument >
+    ``FMRP_GRAM_FACTORIZE`` env > ``"auto"``.
+
+    ``"on"`` contracts once per unique (universe, col_sel) pair with the
+    window term DROPPED from validity and derives each spec's windowed
+    stats at the solve stage (``specgrid.solve`` — exact: a window
+    multiplies every row weight of a month by the same 0/1, so the
+    windowed Gram is the window-masked unwindowed Gram). ``"off"`` keeps
+    the legacy per-spec contraction — the differential oracle whose
+    default jaxpr is byte-pinned. ``"auto"`` factorizes where it pays
+    (repeated pairs on the single-device route; the tile engine resolves
+    it to ``"on"`` for the whole sweep) and stays off on the mesh and
+    multi-process routes, whose contraction programs predate the knob."""
+    if factorize is None:
+        factorize = (
+            os.environ.get("FMRP_GRAM_FACTORIZE", "auto").strip().lower()
+            or "auto"
+        )
+    if factorize not in GRAM_FACTORIZE_MODES:
+        raise ValueError(
+            f"gram factorize must be one of {GRAM_FACTORIZE_MODES}, "
+            f"got {factorize!r}"
+        )
+    return factorize
+
+
+def shared_center(x: jnp.ndarray) -> jnp.ndarray:
+    """The per-month masked column means of the union tensor — the ONE
+    definition of the default contraction center, shared by the
+    single-device route (``contract_spec_grams(center=None)``), the
+    mesh route's psum'd global center and the multi-process route's
+    exchange-merged center (those two compute the same quantity from
+    shard partials; this helper is the single-array reference)."""
+    fin_all = jnp.isfinite(x)
+    return (
+        jnp.where(fin_all, x, 0.0).sum(axis=1)
+        / jnp.maximum(fin_all.sum(axis=1), 1).astype(x.dtype)
+    )
+
+
+def unique_pairs(uidx, col_sel, pad_to: Optional[int] = None):
+    """Collapse the spec axis to its distinct (universe, col_sel) pairs —
+    the factorized route's contraction axis (host numpy; runs OUTSIDE jit
+    so the dedup is a program-shape decision, like the route knobs).
+
+    Returns ``(uidx_u (K,), col_sel_u (K, P), pair_idx (S,))`` with
+    ``uidx_u[pair_idx[s]] == uidx[s]`` and ``col_sel_u[pair_idx[s]] ==
+    col_sel[s]`` — specs differing only in their sample WINDOW share a
+    pair, which is the whole point: the window term is applied to the
+    ADDITIVE per-month stats at the solve stage
+    (``specgrid.solve.expand_window_stats``), so a W-window sweep
+    contracts K pairs instead of S = K·W specs.
+
+    ``pad_to`` (the tile engine's fixed per-sweep width) pads K up by
+    REPEATING pair 0 — inert duplicate rows that keep one program
+    signature per sweep; callers never read them back (``pair_idx``
+    only ever points at real pairs)."""
+    import numpy as np
+
+    uidx = np.asarray(uidx)
+    col_sel = np.asarray(col_sel, bool)
+    seen: dict = {}
+    pair_idx = np.empty(uidx.shape[0], np.int32)
+    u_rows, c_rows = [], []
+    for s in range(uidx.shape[0]):
+        key = (int(uidx[s]), col_sel[s].tobytes())
+        k = seen.get(key)
+        if k is None:
+            k = len(u_rows)
+            seen[key] = k
+            u_rows.append(int(uidx[s]))
+            c_rows.append(col_sel[s])
+        pair_idx[s] = k
+    if pad_to is not None:
+        if pad_to < len(u_rows):
+            raise ValueError(
+                f"pair pad {pad_to} is below the {len(u_rows)} distinct "
+                "(universe, col_sel) pairs this grid actually holds"
+            )
+        while len(u_rows) < pad_to:
+            u_rows.append(u_rows[0])
+            c_rows.append(c_rows[0])
+    return (np.asarray(u_rows, uidx.dtype), np.stack(c_rows), pair_idx)
+
+
 class SpecGramStats(NamedTuple):
     """Per-spec, per-month normal-equation sufficient statistics over the
     AUGMENTED, per-month CENTERED union design ``[1 | X_union − c]``
@@ -133,7 +223,8 @@ def auto_firm_chunk(t: int, n: int, q: int, itemsize: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("firm_chunk", "route", "precision", "block_n", "interpret"),
+    static_argnames=("firm_chunk", "route", "precision", "block_n",
+                     "interpret", "expect_shared_center"),
 )
 def contract_spec_grams(
     y: jnp.ndarray,
@@ -141,7 +232,7 @@ def contract_spec_grams(
     universes: jnp.ndarray,
     uidx: jnp.ndarray,
     col_sel: jnp.ndarray,
-    window: jnp.ndarray,
+    window: Optional[jnp.ndarray],
     firm_chunk: Optional[int] = None,
     center: Optional[jnp.ndarray] = None,
     row_weights: Optional[jnp.ndarray] = None,
@@ -149,6 +240,7 @@ def contract_spec_grams(
     precision: str = "highest",
     block_n: int = 512,
     interpret: bool = False,
+    expect_shared_center: bool = False,
 ) -> SpecGramStats:
     """Contract the (T, N, P) union panel into (S, T, Q, Q) Gram stats.
 
@@ -160,13 +252,21 @@ def contract_spec_grams(
     universes : (U, T, N) bool universe masks.
     uidx : (S,) int — each spec's universe row in ``universes``.
     col_sel : (S, P) bool — each spec's predictor columns.
-    window : (S, T) bool — each spec's sample-window months.
+    window : (S, T) bool — each spec's sample-window months, or ``None``
+        to drop the window term from validity entirely (the factorized
+        route: the month axis stays unwindowed and callers apply each
+        spec's window mask to the ADDITIVE per-month stats at the solve
+        stage — ``specgrid.solve.expand_window_stats`` — which is exact).
     firm_chunk : static chunk width; None → ``auto_firm_chunk``.
     center : (T, P) per-month column shifts; None computes the masked
-        per-month mean over every finite entry. ANY finite values are
-        algebraically valid (the intercept absorbs shifts; slopes and R²
-        are invariant) and shard-additivity holds for a FIXED center, so
-        sharded callers must share one.
+        per-month mean over every finite entry (``shared_center``). ANY
+        finite values are algebraically valid (the intercept absorbs
+        shifts; slopes and R² are invariant) and shard-additivity holds
+        for a FIXED center, so sharded callers must share one —
+        ``expect_shared_center=True`` (static) makes that contract
+        enforced rather than documentary: the call raises if ``center``
+        is None instead of silently computing a shard-LOCAL mean whose
+        partial Grams would not be mergeable.
     row_weights : optional (T, N) non-negative per-row weights multiplying
         each spec's 0/1 validity — the coreset route's importance weights
         (``specgrid.coreset``). ``n`` then accumulates Σw (the UNBIASED
@@ -195,6 +295,13 @@ def contract_spec_grams(
         raise ValueError(
             f"precision must be one of {GRAM_PRECISIONS}, got {precision!r}"
         )
+    if expect_shared_center and center is None:
+        raise ValueError(
+            "this contraction is one shard of a sharded merge: the caller "
+            "must pass the ONE globally-agreed center (grams.shared_center "
+            "over the full panel, psum'd/exchange-merged) — a shard-local "
+            "masked mean would break the Gram additivity the merge relies on"
+        )
     t, n_firms, p = x.shape
     q = p + 1
     dtype = x.dtype
@@ -202,11 +309,7 @@ def contract_spec_grams(
     chunk = firm_chunk or auto_firm_chunk(t, n_firms, q, dtype.itemsize)
 
     if center is None:
-        fin_all = jnp.isfinite(x)
-        center = (
-            jnp.where(fin_all, x, 0.0).sum(axis=1)
-            / jnp.maximum(fin_all.sum(axis=1), 1).astype(dtype)
-        )                                    # (T, P)
+        center = shared_center(x)            # (T, P)
     else:
         center = jnp.asarray(center, dtype)
 
@@ -235,7 +338,9 @@ def contract_spec_grams(
     if route == "pallas":
         from fm_returnprediction_tpu.ops.gram_pallas import gram_contract_pallas
 
-        valid_base = universes[uidx] & window[:, :, None]   # (S, T, N)
+        valid_base = universes[uidx]                        # (S, T, N)
+        if window is not None:
+            valid_base = valid_base & window[:, :, None]
         gram, moment, n_acc, ysum, yy = gram_contract_pallas(
             y, x, valid_base, col_sel, center,
             row_weights=row_weights, block_n=block_n, interpret=interpret,
@@ -260,12 +365,17 @@ def contract_spec_grams(
         yz = jnp.where(finy, yc, 0.0)
         # rows invalid for spec s: any selected column non-finite
         bad = ein("tnp,sp->stn", (~finx).astype(cdtype), sel_f)
-        valid = (
-            uni[:, :, sl]
-            & finy[None]
-            & (bad == 0)
-            & window[:, :, None]
-        )                                     # (S, T, c)
+        if window is not None:
+            valid = (
+                uni[:, :, sl]
+                & finy[None]
+                & (bad == 0)
+                & window[:, :, None]
+            )                                 # (S, T, c)
+        else:
+            # the factorized route: no window term — the month axis stays
+            # whole and the solve stage masks it per spec (exact)
+            valid = uni[:, :, sl] & finy[None] & (bad == 0)
         xa = jnp.concatenate([jnp.ones_like(yc)[..., None], xz], axis=-1)
 
         rw = None

@@ -102,6 +102,7 @@ def _mp_contract_fn(y, x, universes, uidx, col_sel, window, center,
     return contract_spec_grams(
         y, x, universes, uidx, col_sel, window,
         firm_chunk=firm_chunk, center=center,
+        expect_shared_center=True,
     )
 
 
@@ -116,6 +117,7 @@ def _mp_contract_rw_fn(y, x, universes, uidx, col_sel, window, center,
     return contract_spec_grams(
         y, x, universes, uidx, col_sel, window,
         firm_chunk=firm_chunk, center=center, row_weights=row_weights,
+        expect_shared_center=True,
     )
 
 

@@ -41,6 +41,8 @@ __all__ = [
     "winsor_variant",
     "scenario_grid",
     "run_scenarios",
+    "bank_for_scenarios",
+    "run_scenarios_banked",
 ]
 
 
@@ -197,6 +199,8 @@ def run_scenarios(
     return_stats: bool = False,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
+    factorize: Optional[str] = None,
+    boot_route: Optional[str] = None,
 ):
     """The scenario sweep: one tidy row per (cell, predictor).
 
@@ -251,7 +255,77 @@ def run_scenarios(
         referee=referee, mask=jnp.asarray(panel.mask), label_of=label_of,
         seed=seed, coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
         output_dir=output_dir, gram_route=gram_route, precision=precision,
+        factorize=factorize, boot_route=boot_route,
     )
     if return_stats:
         return frame, stats
     return frame
+
+
+def bank_for_scenarios(
+    panel,
+    subset_masks: Dict[str, object],
+    variables_dict: Dict[str, str],
+    models=None,
+    universes: Optional[Sequence[str]] = None,
+    subperiods: int = 2,
+    nw_lags: int = 4,
+    min_months: int = 10,
+    return_col: str = "retx",
+    fingerprint: str = "scenarios",
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
+):
+    """Contract the scenario sweep's panel ONCE into a month-addressed
+    :class:`~fm_returnprediction_tpu.specgrid.grambank.GramBank` — the
+    persistence leg of the factorized route. The bank holds one
+    unwindowed per-month Gram per (model, universe) pair; every later
+    window/bootstrap scenario query (``run_scenarios_banked``) answers
+    from it in O(T·Q²) without re-reading the (T, N, P) panel, and
+    ``grambank.ingest_month`` extends it as new months arrive. Month
+    labels are INDEX positions (0..T-1), matching
+    ``subperiod_windows``'s half-open ranges."""
+    from fm_returnprediction_tpu.models.lewellen import MODELS
+    from fm_returnprediction_tpu.specgrid.cellspace import scenario_space
+    from fm_returnprediction_tpu.specgrid.grambank import build_bank
+
+    models = models if models is not None else MODELS
+    universes = (list(universes) if universes is not None
+                 else list(subset_masks))
+    t = len(panel.months)
+    space = scenario_space(
+        variables_dict, universes, t, models=models, subperiods=subperiods,
+        nw_lags=nw_lags, min_months=min_months,
+    )
+    y = jnp.asarray(panel.var(return_col))
+    x = jnp.asarray(panel.select(list(space.union_predictors)))
+    return build_bank(
+        y, x, {n: subset_masks[n] for n in universes}, space,
+        fingerprint=fingerprint, gram_route=gram_route, precision=precision,
+    )
+
+
+def run_scenarios_banked(
+    bank,
+    windows: Optional[Dict[str, object]] = None,
+    bootstrap: int = 1,
+    seed: int = 0,
+    weights: Sequence[str] = ("reference",),
+    variables_dict: Optional[Dict[str, str]] = None,
+) -> pd.DataFrame:
+    """The scenarios path over BANKED stats: a tidy frame in the
+    ``run_scenarios`` row schema, answered entirely from the bank's
+    month-axis Grams — a new subperiod split or a new bootstrap depth
+    costs O(T·Q²) per pair, zero panel reads (ROADMAP item 5's
+    scenario-query latency leg). ``windows`` defaults to the full sample;
+    pass ``subperiod_windows(bank.n_months, pieces)`` for fresh splits.
+    No QR referee runs here (the panel is not read): ``refereed`` is
+    always False and ``suspect_months`` carries the disclosure."""
+    from fm_returnprediction_tpu.specgrid.grambank import scenario_query
+
+    label_of = ({col: label for label, col in variables_dict.items()}
+                if variables_dict else None)
+    return scenario_query(
+        bank, windows=windows, bootstrap=bootstrap, seed=seed,
+        weights=weights, label_of=label_of,
+    )

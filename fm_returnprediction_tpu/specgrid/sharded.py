@@ -119,6 +119,7 @@ def _contract_program(mesh, firm_chunk: int, has_rw: bool, dtype_key: str):
         stats = contract_spec_grams(
             y_l, x_l, uni_l, uidx, col_sel, window,
             firm_chunk=firm_chunk, center=center, row_weights=rw_l,
+            expect_shared_center=True,
         )
         gram, moment, n, ysum, yy = jax.lax.psum(
             (stats.gram, stats.moment, stats.n, stats.ysum, stats.yy), axis

@@ -60,20 +60,25 @@ from fm_returnprediction_tpu.ops.fama_macbeth import (
 )
 from fm_returnprediction_tpu.ops.ols import CSRegressionResult
 from fm_returnprediction_tpu.specgrid.grams import (
+    SpecGramStats,
     contract_spec_grams,
+    resolve_gram_factorize,
     resolve_gram_precision,
     resolve_gram_route,
+    unique_pairs,
 )
 from fm_returnprediction_tpu.specgrid.specs import SpecGrid
 
 __all__ = [
     "SpecSolve",
     "SpecGridResult",
+    "expand_window_stats",
     "solve_spec_stats",
     "run_spec_grid",
     "run_spec_grid_weights",
     "run_spec_grid_on_panel",
     "program_trace_counts",
+    "contraction_counts",
 ]
 
 _PRECISION = jax.lax.Precision.HIGHEST
@@ -87,6 +92,46 @@ PROGRAM_TRACES: collections.Counter = collections.Counter()
 def program_trace_counts() -> Dict[str, int]:
     """Snapshot of the specgrid jit-trace counters."""
     return dict(PROGRAM_TRACES)
+
+
+# contraction-work accounting (host-side, incremented per grid call, not
+# per trace): how many spec-rows the panel contraction actually ran vs how
+# many specs were solved. Under the factorized route a W-window sweep
+# contracts its unique (universe, col_sel) pairs once — ``pairs_unique``
+# (plus any inert ``pairs_padded`` repeats keeping one program signature
+# per sweep) — while ``specs_solved`` still counts S; the legacy route
+# contracts ``specs_contracted`` == ``specs_solved``. ``bench.py``'s
+# ``grid_factorized_*`` section reads the deltas as the acceptance
+# evidence that contraction count tracks pairs, not S.
+CONTRACTIONS: collections.Counter = collections.Counter()
+
+
+def contraction_counts() -> Dict[str, int]:
+    """Snapshot of the contraction-work counters."""
+    return dict(CONTRACTIONS)
+
+
+def expand_window_stats(stats, pair_idx, window):
+    """Per-spec WINDOWED stats from per-pair UNWINDOWED stats — the solve
+    side of the month-axis factorization (``grams.unique_pairs``).
+
+    Exact, not approximate: every per-month leaf of ``SpecGramStats`` is a
+    sum over that month's rows, and a sample window multiplies every row
+    weight of a month by the same 0/1 — so the windowed Gram is the
+    window-masked unwindowed Gram, bit-for-bit for finite stats (in-window
+    months are untouched, out-of-window months become the exact zeros the
+    legacy contraction produced). ``pair_idx`` (S,) gathers each spec's
+    pair row; ``window`` (S, T) bool is the spec's month mask."""
+    gram, moment, n, ysum, yy, center = stats
+    w = window.astype(gram.dtype)                       # (S, T)
+    return SpecGramStats(
+        gram[pair_idx] * w[:, :, None, None],
+        moment[pair_idx] * w[:, :, None],
+        n[pair_idx] * w,
+        ysum[pair_idx] * w,
+        yy[pair_idx] * w,
+        center,
+    )
 
 
 # AOT executable cache for the fused grid program, keyed by the same
@@ -103,24 +148,32 @@ _AOT_EXECUTABLES: Dict[str, object] = {}
 _AOT_LOCK = threading.Lock()
 
 
-def _compiled_grid_program(args, static_kwargs):
-    """The fused grid program's compiled executable for this signature
-    (compiling — and ledger-recording — it on first use)."""
+def _compiled_grid_program(args, static_kwargs, fn=None,
+                           program: str = "specgrid_program"):
+    """A fused grid program's compiled executable for this signature
+    (compiling — and ledger-recording — it on first use). ``fn`` defaults
+    to the legacy per-spec program; the factorized route passes its own
+    (``_spec_grid_program_fact``) under its own ledger name."""
     from fm_returnprediction_tpu.telemetry import perf as _perf
 
+    fn = fn if fn is not None else _spec_grid_program
     signature = _perf.arg_signature(args, static_kwargs)
+    # the registry already keys on (program, signature); the in-process
+    # slot must too, or two programs with coincident arg signatures would
+    # alias one executable
+    slot = f"{program};{signature}"
     with _AOT_LOCK:
-        exe = _AOT_EXECUTABLES.get(signature)
+        exe = _AOT_EXECUTABLES.get(slot)
     if exe is None:
         built = _perf.timed_aot_compile(
-            _spec_grid_program, *args,
-            program="specgrid_program", signature=signature,
+            fn, *args,
+            program=program, signature=signature,
             **static_kwargs,
         )
         with _AOT_LOCK:
             # a rare concurrent duplicate build is idempotent; first
             # publish wins (same idiom as the serving executor)
-            exe = _AOT_EXECUTABLES.setdefault(signature, built)
+            exe = _AOT_EXECUTABLES.setdefault(slot, built)
     return exe
 
 
@@ -369,6 +422,49 @@ def _spec_grid_program(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("nw_lags", "min_months", "weights", "firm_chunk",
+                     "guard", "gram_route", "precision"),
+)
+def _spec_grid_program_fact(
+    y, x, universes, uidx_u, col_sel_u, pair_idx, window, col_sel,
+    row_weights=None, *,
+    nw_lags: int, min_months: int, weights: Tuple[str, ...],
+    firm_chunk: Optional[int], guard: bool = False,
+    gram_route: str = "xla", precision: str = "highest",
+):
+    """The month-axis-FACTORIZED fused grid program: contract once per
+    unique (universe, col_sel) pair with the window term DROPPED from
+    validity (``contract_spec_grams(window=None)``), expand each spec's
+    windowed per-month stats by the additive window mask
+    (``expand_window_stats`` — exact), then the SAME padded solve + FM
+    tail as the legacy program. A W-window sweep pays K = S/W pair
+    contractions over the (T, N, P) panel instead of S; the O(S·T·Q²)
+    expand is the only extra work and never touches the firm axis.
+
+    ``uidx_u``/``col_sel_u`` are the deduped pair selectors
+    (``grams.unique_pairs``, computed OUTSIDE jit — the dedup is a
+    program-shape choice like the route knobs); ``pair_idx`` (S,) maps
+    each spec to its pair row and ``col_sel`` (S, P) still drives the
+    per-spec solve padding."""
+    PROGRAM_TRACES["specgrid_program_fact"] += 1  # trace-time side effect
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    record_trace("specgrid_program_fact")  # compile-event hook
+    pair_stats = contract_spec_grams(
+        y, x, universes, uidx_u, col_sel_u, None,
+        firm_chunk=firm_chunk, row_weights=row_weights,
+        route=gram_route, precision=precision,
+    )
+    stats = expand_window_stats(pair_stats, pair_idx, window)
+    return _solve_and_aggregate(
+        stats, col_sel, y.dtype,
+        nw_lags=nw_lags, min_months=min_months, weights=weights, guard=guard,
+        precision=precision,
+    )
+
+
 def _solve_and_aggregate(
     stats, col_sel, out_dtype, *,
     nw_lags: int, min_months: int, weights: Tuple[str, ...], guard: bool,
@@ -426,6 +522,7 @@ def run_spec_grid(
     row_weights=None,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
+    factorize: Optional[str] = None,
 ) -> SpecGridResult:
     """Solve a whole spec grid from raw panel tensors.
 
@@ -444,6 +541,7 @@ def run_spec_grid(
         y, x, universe_masks, grid, (grid.weight,),
         referee=referee, firm_chunk=firm_chunk, mesh=mesh, procs=procs,
         row_weights=row_weights, gram_route=gram_route, precision=precision,
+        factorize=factorize,
     )[grid.weight]
 
 
@@ -460,6 +558,8 @@ def run_spec_grid_weights(
     row_weights=None,
     gram_route: Optional[str] = None,
     precision: Optional[str] = None,
+    factorize: Optional[str] = None,
+    pair_pad: Optional[int] = None,
 ) -> Dict[str, SpecGridResult]:
     """``run_spec_grid`` for several NW weight schemes at once: the panel
     contraction and Gram solve run ONCE inside one program; each scheme
@@ -483,9 +583,24 @@ def run_spec_grid_weights(
     containing a month the bf16 Gram cannot defend are re-solved by the
     full-precision QR referee (promotion back to f32/f64), and
     ``suspect_months`` discloses the per-spec flagged-month count.
+
+    ``factorize`` (``grams.resolve_gram_factorize`` / the
+    ``FMRP_GRAM_FACTORIZE`` knob) selects the month-axis factorization:
+    ``"on"`` contracts once per unique (universe, col_sel) pair and
+    applies each spec's window mask to the additive per-month stats at
+    the solve stage (exact — ``expand_window_stats``); ``"auto"`` (the
+    default) factorizes only when the grid actually repeats pairs
+    (window sweeps) and keeps the legacy byte-pinned program otherwise;
+    ``"off"`` forces the legacy per-spec contraction. Single-device
+    only: the mesh and multi-process contraction programs predate the
+    knob, so an explicit ``"on"`` there raises and ``"auto"`` stays
+    off. ``pair_pad`` (the tile engine's per-sweep width) pads the pair
+    axis with inert repeats so a whole sweep keeps ONE factorized
+    program signature.
     """
     gram_route = resolve_gram_route(gram_route)
     precision = resolve_gram_precision(precision)
+    factorize = resolve_gram_factorize(factorize)
     from fm_returnprediction_tpu.specgrid.multiproc import (
         resolve_specgrid_procs,
     )
@@ -508,6 +623,13 @@ def run_spec_grid_weights(
             "merge of bf16-floored shard stats is not refereed yet (the "
             "mesh rule, one process boundary up)"
         )
+    if factorize == "on" and (mesh is not None or procs > 1):
+        raise ValueError(
+            "factorize='on' is a single-device route: the mesh and "
+            "multi-process contraction programs predate the month-axis "
+            "factorization (their window term stays in validity); "
+            "'auto' resolves to the legacy route there"
+        )
     names = list(universe_masks)
     # the multi-process route keys its persistent worker pool on the
     # CALLER'S array identities — captured before the jnp conversions
@@ -518,8 +640,10 @@ def run_spec_grid_weights(
     x = jnp.asarray(x)
     universes = _universe_stack(universe_masks, names)
     t = y.shape[0]
-    uidx = jnp.asarray(grid.universe_index(names))
-    col_sel = jnp.asarray(grid.column_selector())
+    uidx_np = grid.universe_index(names)
+    col_sel_np = grid.column_selector()
+    uidx = jnp.asarray(uidx_np)
+    col_sel = jnp.asarray(col_sel_np)
     window_np = grid.window_masks(t)
     if row_weights is not None:
         row_weights = jnp.asarray(row_weights, x.dtype)
@@ -567,9 +691,33 @@ def run_spec_grid_weights(
             procs=procs, row_weights=raw_rw, **mp_kwargs,
         )
     else:
-        program_args = (y, x, universes, uidx, col_sel, window_np,
-                        row_weights)
-        exe = _compiled_grid_program(program_args, static_kwargs)
+        s_specs = int(col_sel_np.shape[0])
+        use_fact = False
+        if factorize != "off":
+            k_unique = int(unique_pairs(uidx_np, col_sel_np)[0].shape[0])
+            # "auto" factorizes only when the grid actually repeats pairs
+            # (a window sweep); with every pair distinct the legacy
+            # byte-pinned program is the same work and stays the default
+            use_fact = factorize == "on" or k_unique < s_specs
+        CONTRACTIONS["specs_solved"] += s_specs
+        if use_fact:
+            uidx_u, col_sel_u, pair_idx = unique_pairs(
+                uidx_np, col_sel_np, pad_to=pair_pad
+            )
+            CONTRACTIONS["pairs_unique"] += k_unique
+            CONTRACTIONS["pairs_contracted"] += int(uidx_u.shape[0])
+            program_args = (y, x, universes, jnp.asarray(uidx_u),
+                            jnp.asarray(col_sel_u), jnp.asarray(pair_idx),
+                            window_np, col_sel, row_weights)
+            exe = _compiled_grid_program(
+                program_args, static_kwargs,
+                fn=_spec_grid_program_fact, program="specgrid_program_fact",
+            )
+        else:
+            CONTRACTIONS["specs_contracted"] += s_specs
+            program_args = (y, x, universes, uidx, col_sel, window_np,
+                            row_weights)
+            exe = _compiled_grid_program(program_args, static_kwargs)
         out = jax.device_get(exe(*program_args))
     if guard:
         cs, fms, suspect, guard_counters = out

@@ -1,0 +1,239 @@
+"""Device-batched bootstrap/rolling aggregation vs the retained host
+oracle (``specgrid.boot``).
+
+The ISSUE-14 part-(b) contracts:
+
+- the consolidated Newey-West home: ``ops.newey_west.nw_mean_se_np`` (the
+  host mirror that used to live as ``engine._nw_se_np``) is differentially
+  pinned against the jax kernel it mirrors, including the
+  negative-variance→NaN and n<2→NaN contracts;
+- the gathered device program reproduces the host per-draw loop on the
+  SAME archived draw seeds (``engine.block_bootstrap_months``) at f64
+  ≤ 1e-12, with exactly equal month counts;
+- ``resample_matrix`` rows are byte-identical to the per-draw generator —
+  the two routes never see different index rows;
+- Figure-1's rolling slope means through the gathered aggregator match the
+  incumbent fused-cumsum route (``ops.compaction.rolling_over_valid_rows``);
+- the tile engine's device route streams the same frame as its host route
+  on a bootstrapped CellSpace, and the route knob resolves with the repo's
+  arg > env > default discipline.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.compaction import rolling_over_valid_rows
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth_summary
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se, nw_mean_se_np
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+from fm_returnprediction_tpu.specgrid.boot import (
+    bootstrap_aggregate_device,
+    fm_aggregate_np,
+    resample_matrix,
+    resolve_boot_route,
+    rolling_fm_windows,
+)
+from fm_returnprediction_tpu.specgrid.engine import block_bootstrap_months
+
+pytestmark = pytest.mark.specgrid
+
+
+def _series(rng, t=60, p=3, nan_frac=0.1):
+    slopes = rng.standard_normal((t, p))
+    slopes[rng.random((t, p)) < nan_frac] = np.nan
+    r2 = rng.random(t)
+    n_obs = rng.integers(20, 200, t).astype(float)
+    month_valid = rng.random(t) > 0.15
+    return slopes, r2, n_obs, month_valid
+
+
+# -- the consolidated NW home ------------------------------------------------
+
+def test_nw_np_matches_jax_kernel():
+    rng = np.random.default_rng(0)
+    for n in (2, 3, 7, 30, 200):
+        for lags in (0, 1, 4, 12):
+            for weight in ("reference", "textbook"):
+                vals = rng.standard_normal(n)
+                got = nw_mean_se_np(vals, lags, weight)
+                ref = float(nw_mean_se(jnp.asarray(vals),
+                                       jnp.ones(n, bool),
+                                       lags=lags, weight=weight))
+                np.testing.assert_allclose(got, ref, rtol=1e-12,
+                                           err_msg=f"n={n} lags={lags}")
+
+
+def test_nw_np_contracts():
+    # fewer than 2 entries → NaN (both routes)
+    assert np.isnan(nw_mean_se_np(np.array([]), 4))
+    assert np.isnan(nw_mean_se_np(np.array([1.0]), 4))
+    # a strongly negative-autocorrelated series drives the small-sample
+    # HAC variance negative: legal, reads as NaN — the same contract as
+    # the jax path (guard/checks NW-tap note)
+    vals = np.array([1.0, -1.0] * 5)
+    assert np.isnan(nw_mean_se_np(vals, 1, "reference"))
+    assert np.isnan(float(nw_mean_se(jnp.asarray(vals),
+                                     jnp.ones(vals.size, bool),
+                                     lags=1, weight="reference")))
+    with pytest.raises(ValueError, match="weight"):
+        nw_mean_se_np(np.arange(5.0), 2, "parzen")
+
+
+def test_fm_aggregate_np_matches_device_summary():
+    # identity gather: the host oracle and the jitted FM summary agree on
+    # an unresampled series (the bootstrap parity's base case)
+    rng = np.random.default_rng(1)
+    slopes, r2, n_obs, month_valid = _series(rng)
+    coef, tstat, nw_se, mean_r2, mean_n, n_months = fm_aggregate_np(
+        slopes, r2, n_obs, month_valid, 4, 10, "reference"
+    )
+    cs = CSRegressionResult(
+        slopes=jnp.asarray(slopes),
+        intercept=jnp.zeros(slopes.shape[0]),
+        r2=jnp.asarray(r2), n_obs=jnp.asarray(n_obs),
+        month_valid=jnp.asarray(month_valid),
+    )
+    fm = fama_macbeth_summary(cs, nw_lags=4, min_months=10)
+    np.testing.assert_allclose(coef, np.asarray(fm.coef), atol=1e-13)
+    np.testing.assert_allclose(tstat, np.asarray(fm.tstat), atol=1e-11)
+    np.testing.assert_allclose(nw_se, np.asarray(fm.nw_se), atol=1e-13)
+    assert n_months == int(fm.n_months)
+
+
+# -- archived draw seeds -----------------------------------------------------
+
+def test_resample_matrix_matches_archived_per_draw_generator():
+    t, draws, seed = 47, 9, 5
+    mat = resample_matrix(t, draws, seed=seed)
+    assert mat.shape == (draws - 1, t)
+    for d in range(1, draws):
+        np.testing.assert_array_equal(
+            mat[d - 1], block_bootstrap_months(t, d, seed=seed)
+        )
+    # draw 0 is the point estimate: never resampled, never in the stack
+    assert resample_matrix(t, 1, seed=seed).shape == (0, t)
+
+
+@pytest.mark.parametrize("weight", ["reference", "textbook"])
+def test_bootstrap_device_matches_host_oracle(weight):
+    rng = np.random.default_rng(2)
+    slopes, r2, n_obs, month_valid = _series(rng, t=72, p=4)
+    idx = resample_matrix(72, 33, seed=7)
+    coef, tstat, nw_se, mean_r2, mean_n, n_months = (
+        bootstrap_aggregate_device(slopes, r2, n_obs, month_valid, idx,
+                                   4, 10, weight)
+    )
+    assert coef.shape == (32, 4)
+    for d in range(idx.shape[0]):
+        rows = idx[d]
+        ref = fm_aggregate_np(slopes[rows], r2[rows], n_obs[rows],
+                              month_valid[rows], 4, 10, weight)
+        np.testing.assert_allclose(coef[d], ref[0], atol=1e-12, err_msg=f"d={d}")
+        np.testing.assert_allclose(tstat[d], ref[1], atol=1e-9, err_msg=f"d={d}")
+        np.testing.assert_allclose(nw_se[d], ref[2], atol=1e-12, err_msg=f"d={d}")
+        np.testing.assert_allclose(mean_r2[d], ref[3], atol=1e-12)
+        np.testing.assert_allclose(mean_n[d], ref[4], atol=1e-12)
+        assert int(n_months[d]) == ref[5]
+
+
+# -- the rolling twin --------------------------------------------------------
+
+def test_rolling_fm_windows_matches_fused_route():
+    rng = np.random.default_rng(3)
+    t, p, window, min_periods = 90, 3, 24, 12
+    slopes = rng.standard_normal((t, p))
+    month_valid = rng.random(t) > 0.2
+    got = rolling_fm_windows(slopes, month_valid, window, min_periods)
+    ref = np.asarray(rolling_over_valid_rows(
+        jnp.asarray(slopes), jnp.asarray(month_valid), window, min_periods
+    ))
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+    # invalid calendar slots stay NaN in both routes
+    assert np.isnan(got[~month_valid]).all()
+
+
+def test_figure_rolling_slopes_device_route(monkeypatch):
+    # FMRP_BOOT_ROUTE=device routes the figure's host-side rolling means
+    # through the gathered aggregator; default stays the fused cumsum —
+    # and the two frames agree on the pinned parity surface
+    from types import SimpleNamespace
+
+    import pandas as pd
+
+    from fm_returnprediction_tpu.reporting.figure1 import FIGURE1_VARS
+    from fm_returnprediction_tpu.reporting.figure1 import rolling_slopes
+
+    rng = np.random.default_rng(6)
+    t, p = 48, len(FIGURE1_VARS)
+    cs = SimpleNamespace(
+        slopes=rng.standard_normal((t, p)),
+        month_valid=rng.random(t) > 0.2,
+    )
+    panel = SimpleNamespace(months=pd.date_range("1990-01-31", periods=t,
+                                                 freq="ME"))
+    monkeypatch.delenv("FMRP_BOOT_ROUTE", raising=False)
+    ref = rolling_slopes(panel, None, window=12, min_periods=6, cs=cs)
+    monkeypatch.setenv("FMRP_BOOT_ROUTE", "device")
+    dev = rolling_slopes(panel, None, window=12, min_periods=6, cs=cs)
+    pd.testing.assert_frame_equal(dev, ref, atol=1e-12, rtol=0,
+                                  check_exact=False)
+
+
+def test_rolling_fm_windows_empty_series():
+    out = rolling_fm_windows(np.zeros((5, 2)), np.zeros(5, bool), 3, 1)
+    assert np.isnan(out).all()
+
+
+# -- route knob --------------------------------------------------------------
+
+def test_boot_route_resolution(monkeypatch):
+    monkeypatch.delenv("FMRP_BOOT_ROUTE", raising=False)
+    assert resolve_boot_route() == "auto"
+    monkeypatch.setenv("FMRP_BOOT_ROUTE", "host")
+    assert resolve_boot_route() == "host"
+    assert resolve_boot_route("device") == "device"  # arg beats env
+    monkeypatch.setenv("FMRP_BOOT_ROUTE", "gpu")
+    with pytest.raises(ValueError, match="boot route"):
+        resolve_boot_route()
+
+
+# -- the tile engine's two routes -------------------------------------------
+
+def test_engine_device_route_matches_host_route():
+    from fm_returnprediction_tpu.specgrid import CellSpace, run_cellspace
+
+    rng = np.random.default_rng(4)
+    t, n, p = 36, 120, 4
+    x = rng.standard_normal((t, n, p))
+    x[rng.random(x.shape) < 0.05] = np.nan
+    y = rng.standard_normal((t, n))
+    y[rng.random(y.shape) < 0.1] = np.nan
+    masks = {"All": np.ones((t, n), bool)}
+    names = tuple(f"x{i}" for i in range(p))
+    space = CellSpace(
+        regressor_sets=(("m2", names[:2]), ("m4", names)),
+        universes=("All",),
+        windows=(("full", None), ("late", (18, 36))),
+        bootstrap=6,
+    )
+    frames = {}
+    for route in ("host", "device"):
+        frame, stats = run_cellspace(
+            y, x, masks, space, mask=masks["All"], seed=11,
+            boot_route=route,
+        )
+        assert stats["boot_route"] == route
+        frames[route] = frame.sort_values(
+            ["cell", "predictor"]
+        ).reset_index(drop=True)
+    h, d = frames["host"], frames["device"]
+    assert len(h) == len(d)
+    for col in ("cell", "model", "universe", "window", "predictor",
+                "draw", "n_months"):
+        assert (h[col] == d[col]).all(), col
+    for col in ("coef", "tstat", "nw_se", "mean_r2", "mean_n"):
+        pd.testing.assert_series_equal(h[col], d[col], atol=1e-9,
+                                       rtol=1e-9, check_exact=False)

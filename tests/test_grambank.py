@@ -1,0 +1,334 @@
+"""Registry Gram bank: banked month-axis stats answering window /
+bootstrap / scenario queries with zero panel reads (``specgrid.grambank``).
+
+The ISSUE-14 part-(c) contracts:
+
+- a window query over banked stats matches the full grid route (the
+  refereed engine) at f64 ≤ 1e-12 with exactly equal month counts;
+- a bootstrap query rides the device-batched aggregator on the SAME
+  archived draw seeds as the tile engine, pinned against the host oracle;
+- ``ingest_month`` extends every leaf by Gram additivity — the appended
+  bank matches a from-scratch contraction of the longer panel;
+- the registry roundtrip: content-addressed save/load, env-skew (x64)
+  reads as a warned miss, corruption degrades to a warned miss, no
+  registry means no banking (never an error);
+- the scenarios path: ``run_scenarios_banked`` reproduces
+  ``run_scenarios``'s numbers per (model, universe, window, predictor)
+  without touching the ``(T, N, P)`` panel (the contraction ledger stays
+  flat across queries).
+"""
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.specgrid.boot import fm_aggregate_np
+from fm_returnprediction_tpu.specgrid.cellspace import CellSpace
+from fm_returnprediction_tpu.specgrid.grambank import (
+    bank_key,
+    bootstrap_query,
+    build_bank,
+    ingest_month,
+    load_bank,
+    save_bank,
+    scenario_query,
+    window_query,
+)
+from fm_returnprediction_tpu.specgrid.solve import (
+    contraction_counts,
+    run_spec_grid,
+)
+from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+pytestmark = [pytest.mark.specgrid, pytest.mark.registry]
+
+
+def _panel(seed=0, t=30, n=140, p=4):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p))
+    x[rng.random(x.shape) < 0.06] = np.nan
+    beta = rng.standard_normal(p) * 0.1
+    y = np.nansum(x * beta, axis=-1) + 0.3 * rng.standard_normal((t, n))
+    y[rng.random(y.shape) < 0.1] = np.nan
+    masks = {
+        "All": np.ones((t, n), bool),
+        "Big": (rng.random(n) > 0.35)[None, :] & np.ones((t, n), bool),
+    }
+    return y, x, masks
+
+
+def _space(t, p=4, **kw):
+    names = tuple(f"c{i}" for i in range(p))
+    defaults = dict(
+        regressor_sets=(("m2", names[:2]), ("mfull", names)),
+        universes=("All", "Big"),
+        windows=(("full", None), ("half1", (0, t // 2)),
+                 ("half2", (t // 2, t))),
+        nw_lags=4, min_months=8,
+    )
+    defaults.update(kw)
+    return CellSpace(**defaults)
+
+
+@pytest.fixture()
+def bank():
+    y, x, masks = _panel()
+    space = _space(y.shape[0])
+    return build_bank(y, x, masks, space, fingerprint="test-bank"), \
+        (y, x, masks, space)
+
+
+# -- window queries ----------------------------------------------------------
+
+def test_window_query_matches_grid_route(bank):
+    bk, (y, x, masks, space) = bank
+    assert bk.n_pairs == 4  # 2 sets × 2 universes
+    names = tuple(space.union_predictors)
+    for window, win_arg in ((None, None), ((5, 25), (5, 25))):
+        specs = tuple(
+            Spec(f"{s}_{u}", cols, u, window=window)
+            for s, cols in space.regressor_sets for u in space.universes
+        )
+        grid = SpecGrid(specs, nw_lags=space.nw_lags,
+                        min_months=space.min_months, union=names)
+        ref = run_spec_grid(y, x, masks, grid)
+        got = window_query(bk, win_arg)
+        np.testing.assert_array_equal(got.n_months, ref.n_months)
+        np.testing.assert_array_equal(got.month_valid, ref.month_valid)
+        for f in ("coef", "nw_se", "mean_r2", "mean_n", "slopes", "r2"):
+            a = np.asarray(getattr(ref, f), float)
+            b = np.asarray(getattr(got, f), float)
+            np.testing.assert_array_equal(np.isnan(a), np.isnan(b),
+                                          err_msg=f)
+            np.testing.assert_allclose(b, a, atol=1e-12, equal_nan=True,
+                                       err_msg=f)
+        np.testing.assert_allclose(got.tstat, ref.tstat, atol=1e-10,
+                                   equal_nan=True)
+
+
+def test_window_query_mask_and_bounds(bank):
+    bk, _ = bank
+    t = bk.n_months
+    mask = np.zeros(t, bool)
+    mask[::2] = True
+    got = window_query(bk, mask)
+    assert (got.n_months <= mask.sum()).all()
+    with pytest.raises(ValueError, match="window mask"):
+        window_query(bk, np.ones(t + 1, bool))
+    # a (lo, hi) range matching NO banked labels fails loudly — the
+    # label/position confusion a calendar-labelled bank invites
+    with pytest.raises(ValueError, match="month LABELS"):
+        window_query(bk, (10 * t, 20 * t))
+
+
+# -- bootstrap queries -------------------------------------------------------
+
+def test_bootstrap_query_matches_host_oracle(bank):
+    bk, (y, x, masks, space) = bank
+    from fm_returnprediction_tpu.specgrid.boot import resample_matrix
+
+    draws, seed = 8, 3
+    point, stacks = bootstrap_query(bk, draws, window=None, seed=seed)
+    idx = resample_matrix(bk.n_months, draws, seed=seed)
+    assert len(stacks) == bk.n_pairs
+    for k in range(bk.n_pairs):
+        coef_d, tstat_d, nw_d, r2_d, n_d, m_d = stacks[k]
+        assert coef_d.shape == (draws - 1, len(bk.union))
+        for d in range(draws - 1):
+            rows = idx[d]
+            ref = fm_aggregate_np(
+                point.slopes[k][rows], point.r2[k][rows],
+                point.n_obs[k][rows], point.month_valid[k][rows],
+                space.nw_lags, space.min_months, "reference",
+            )
+            np.testing.assert_allclose(coef_d[d], ref[0], atol=1e-12,
+                                       equal_nan=True)
+            np.testing.assert_allclose(nw_d[d], ref[2], atol=1e-12,
+                                       equal_nan=True)
+            assert int(m_d[d]) == ref[5]
+    with pytest.raises(ValueError, match="draws"):
+        bootstrap_query(bk, 0)
+
+
+# -- ingest ------------------------------------------------------------------
+
+def test_ingest_month_additivity(bank):
+    bk_full, (y, x, masks, space) = bank
+    t = y.shape[0]
+    head = build_bank(y[: t - 1], x[: t - 1],
+                      {k: v[: t - 1] for k, v in masks.items()},
+                      _space(t, p=x.shape[2]), fingerprint="test-bank")
+    grown = ingest_month(
+        head, y[t - 1], x[t - 1],
+        {k: v[t - 1] for k, v in masks.items()}, month=t - 1,
+    )
+    assert grown.n_months == t
+    for f in ("gram", "moment", "n", "ysum", "yy", "center"):
+        a = np.asarray(getattr(bk_full, f))
+        np.testing.assert_allclose(np.asarray(getattr(grown, f)), a,
+                                   atol=1e-13 * max(np.nanmax(np.abs(a)), 1),
+                                   err_msg=f)
+    np.testing.assert_array_equal(grown.months, bk_full.months)
+    # and the grown bank answers queries like the from-scratch one
+    np.testing.assert_allclose(
+        window_query(grown).coef, window_query(bk_full).coef,
+        atol=1e-11, equal_nan=True,
+    )
+    with pytest.raises(ValueError, match="already banked"):
+        ingest_month(grown, y[t - 1], x[t - 1],
+                     {k: v[t - 1] for k, v in masks.items()}, month=t - 1)
+    with pytest.raises(ValueError, match="union"):
+        ingest_month(grown, y[t - 1], x[t - 1][:, :2],
+                     {k: v[t - 1] for k, v in masks.items()}, month=t)
+
+
+# -- registry roundtrip ------------------------------------------------------
+
+def test_save_load_roundtrip(bank, tmp_path, monkeypatch):
+    bk, _ = bank
+    monkeypatch.setenv("FMRP_REGISTRY_DIR", str(tmp_path / "reg"))
+    entry = save_bank(bk)
+    assert entry is not None and (entry / "bank.npz").exists()
+    got = load_bank("test-bank", bk.union, bk.universes, bk.uidx,
+                    bk.col_sel, bk.dtype, bk.months)
+    assert got is not None
+    for f in ("gram", "moment", "n", "ysum", "yy", "center", "months",
+              "uidx", "col_sel"):
+        np.testing.assert_array_equal(getattr(got, f), getattr(bk, f),
+                                      err_msg=f)
+    assert got.union == bk.union and got.pair_labels == bk.pair_labels
+    # a different fingerprint is a different address: miss
+    assert load_bank("other", bk.union, bk.universes, bk.uidx,
+                     bk.col_sel, bk.dtype, bk.months) is None
+    # a grown month axis is a different address too — an ingest-grown
+    # bank can never silently REPLACE its parent entry
+    assert load_bank("test-bank", bk.union, bk.universes, bk.uidx,
+                     bk.col_sel, bk.dtype,
+                     np.arange(bk.n_months + 1)) is None
+
+
+def test_registry_off_means_no_banking(bank, monkeypatch):
+    bk, _ = bank
+    monkeypatch.delenv("FMRP_REGISTRY_DIR", raising=False)
+    assert save_bank(bk) is None
+    assert load_bank("test-bank", bk.union, bk.universes, bk.uidx,
+                     bk.col_sel, bk.dtype, bk.months) is None
+
+
+def test_load_miss_on_env_skew_and_corruption(bank, tmp_path, monkeypatch):
+    import json
+
+    bk, _ = bank
+    monkeypatch.setenv("FMRP_REGISTRY_DIR", str(tmp_path / "reg"))
+    entry = save_bank(bk)
+    meta_path = entry / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["x64"] = not meta["x64"]
+    meta_path.write_text(json.dumps(meta))
+    with pytest.warns(UserWarning, match="skewed"):
+        assert load_bank("test-bank", bk.union, bk.universes, bk.uidx,
+                         bk.col_sel, bk.dtype, bk.months) is None
+    meta["x64"] = not meta["x64"]
+    meta_path.write_text(json.dumps(meta))
+    # corrupt the payload: the manifest check trips and degrades to a miss
+    (entry / "bank.npz").write_bytes(b"not an npz")
+    with pytest.warns(UserWarning, match="unreadable|re-contracting"):
+        assert load_bank("test-bank", bk.union, bk.universes, bk.uidx,
+                         bk.col_sel, bk.dtype, bk.months) is None
+
+
+def test_bank_key_sensitivity(bank):
+    bk, _ = bank
+    m = bk.months
+    base = bank_key("fp", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                    "float64", m, "xla", "highest")
+    assert base == bank_key("fp", bk.union, bk.universes, bk.uidx,
+                            bk.col_sel, "float64", m, "xla", "highest")
+    others = [
+        bank_key("fp2", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                 "float64", m, "xla", "highest"),
+        bank_key("fp", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                 "float32", m, "xla", "highest"),
+        bank_key("fp", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                 "float64", m, "pallas", "highest"),
+        bank_key("fp", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                 "float64", m, "xla", "bf16"),
+        bank_key("fp", bk.union, bk.universes, bk.uidx[::-1].copy(),
+                 bk.col_sel, "float64", m, "xla", "highest"),
+        bank_key("fp", bk.union, bk.universes, bk.uidx, bk.col_sel,
+                 "float64", np.concatenate([m, [m[-1] + 1]]), "xla",
+                 "highest"),
+    ]
+    assert len({base, *others}) == len(others) + 1
+
+
+# -- the scenarios path ------------------------------------------------------
+
+def test_scenario_query_schema_and_zero_panel_reads(bank):
+    bk, _ = bank
+    before = contraction_counts()
+    frame = scenario_query(
+        bk, windows={"full": None, "late": (20, 30)}, bootstrap=3,
+        label_of={"c0": "Beta"},
+    )
+    after = contraction_counts()
+    # zero panel reads: the contraction-work ledger did not move
+    assert before == after
+    expected = {"model", "universe", "window", "nw_weight", "predictor",
+                "coef", "tstat", "nw_se", "mean_r2", "mean_n", "n_months",
+                "refereed", "suspect_months", "source", "draw"}
+    assert expected <= set(frame.columns)
+    assert (frame["source"] == "bank").all()
+    assert (~frame["refereed"]).all()
+    assert set(frame["window"]) == {"full", "late"}
+    assert set(frame["draw"]) == {0, 1, 2}
+    assert "Beta" in set(frame["predictor"])
+    # rows: windows × pairs × draws × selected predictors
+    n_sel = int(bk.col_sel.sum())
+    assert len(frame) == 2 * 3 * n_sel
+
+
+def test_run_scenarios_banked_matches_run_scenarios():
+    from fm_returnprediction_tpu.models.lewellen import ModelSpec
+    from fm_returnprediction_tpu.specgrid.scenarios import (
+        bank_for_scenarios,
+        run_scenarios,
+        run_scenarios_banked,
+        subperiod_windows,
+    )
+
+    y, x, masks = _panel(seed=21, t=36, n=80, p=3)
+    names = ["c0", "c1", "c2"]
+
+    class _MiniPanel:
+        def __init__(self):
+            self.mask = masks["All"]
+            self.months = np.arange(y.shape[0])
+
+        def var(self, name):
+            assert name == "retx"
+            return y
+
+        def select(self, cols):
+            return x[:, :, [names.index(c) for c in cols]]
+
+    panel = _MiniPanel()
+    variables = {"V0": "c0", "V1": "c1", "V2": "c2"}
+    models = [ModelSpec("Model A", ["V0", "V1"]),
+              ModelSpec("Model B", ["V0", "V1", "V2"])]
+    ref = run_scenarios(panel, masks, variables, models=models,
+                        subperiods=2, min_months=8)
+    bk = bank_for_scenarios(panel, masks, variables, models=models,
+                            min_months=8)
+    got = run_scenarios_banked(
+        bk, windows=subperiod_windows(bk.n_months, 2),
+        variables_dict=variables,
+    )
+    keys = ["model", "universe", "window", "predictor"]
+    merged = ref.merge(got, on=keys, suffixes=("_ref", "_bank"))
+    assert len(merged) == len(ref) == len(got)
+    for f in ("coef", "tstat", "nw_se", "mean_r2", "mean_n"):
+        np.testing.assert_allclose(
+            merged[f"{f}_bank"], merged[f"{f}_ref"], atol=1e-9,
+            equal_nan=True, err_msg=f,
+        )
+    assert (merged["n_months_bank"] == merged["n_months_ref"]).all()

@@ -1,0 +1,310 @@
+"""Month-axis Gram factorization vs the legacy per-window contraction.
+
+The ISSUE-14 part-(a) contracts:
+
+- ``unique_pairs`` collapses the spec axis to distinct (universe,
+  col_sel) pairs with a faithful inverse map, and its ``pad_to``
+  signature-pad repeats are inert;
+- stats-level exactness: ``contract_spec_grams(window=None)`` +
+  ``expand_window_stats`` reproduces the windowed contraction — counts
+  EXACTLY, moments at f64 ≤ 1e-13·scale (both XLA and pallas routes) —
+  across thin months, all-NaN columns, mask edges and coreset row
+  weights;
+- end-to-end differential: ``run_spec_grid(factorize="on")`` ==
+  ``factorize="off"`` (the byte-pinned legacy oracle) at f64 ≤ 1e-13 and
+  f32 1e-6 relative, NaN patterns identical;
+- the contraction-work ledger tracks PAIRS, not S, under the factorized
+  route, and "auto" resolves on exactly for window sweeps;
+- the knob's guardrails: env resolution, invalid values, and the
+  single-device-only rule (mesh / procs reject ``"on"``).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.specgrid.grams import (
+    contract_spec_grams,
+    resolve_gram_factorize,
+    shared_center,
+    unique_pairs,
+)
+from fm_returnprediction_tpu.specgrid.solve import (
+    contraction_counts,
+    expand_window_stats,
+    run_spec_grid,
+)
+from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+pytestmark = pytest.mark.specgrid
+
+
+def _panel(seed=0, t=18, n=160, p=5, dtype=np.float64):
+    """A panel exercising every parity edge at once: NaN sprinkle, an
+    all-NaN firm column, a y-less firm, a thin month (nearly-empty
+    universe), and window masks hitting both calendar edges."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(dtype)
+    x[rng.random(x.shape) < 0.08] = np.nan
+    x[:, 7, 2] = np.nan                       # an all-NaN firm column
+    y = rng.standard_normal((t, n)).astype(dtype)
+    y[rng.random(y.shape) < 0.12] = np.nan
+    y[:, 11] = np.nan                         # a y-less firm
+    uni_all = np.ones((t, n), bool)
+    uni_thin = rng.random((t, n)) > 0.4
+    uni_thin[3, 6:] = False                   # thin month: 6 firms survive
+    return y, x, {"All": uni_all, "Thin": uni_thin}
+
+
+def _window_sweep_grid(t, p, names=None):
+    """4 windows × 2 universes × 2 sets = 16 specs over 4 unique pairs;
+    windows hit both calendar edges (the mask-edge case)."""
+    names = names or tuple(f"c{i}" for i in range(p))
+    windows = (None, (0, t // 3), (t - t // 3, t), (t // 4, 3 * t // 4))
+    specs = tuple(
+        Spec(f"{set_name}_{uni}_{w}", cols, uni, window=w)
+        for set_name, cols in (("m2", names[:2]), ("m5", names))
+        for uni in ("All", "Thin")
+        for w in windows
+    )
+    return SpecGrid(specs, union=names)
+
+
+def _grid_tensors(grid, masks, t):
+    names = list(masks)
+    universes = jnp.asarray(np.stack([masks[u] for u in names]))
+    uidx = grid.universe_index(names)
+    col_sel = grid.column_selector()
+    window = grid.window_masks(t)
+    return universes, uidx, col_sel, window
+
+
+# -- unique_pairs ------------------------------------------------------------
+
+def test_unique_pairs_dedup_and_inverse():
+    rng = np.random.default_rng(1)
+    base_sel = rng.random((3, 6)) > 0.5
+    uidx = np.array([0, 0, 1, 0, 1, 0, 0, 1], np.int64)
+    col_sel = base_sel[[0, 1, 0, 0, 0, 1, 2, 0]]
+    u_u, c_u, pidx = unique_pairs(uidx, col_sel)
+    # the inverse map reconstructs every spec's pair exactly
+    np.testing.assert_array_equal(u_u[pidx], uidx)
+    np.testing.assert_array_equal(c_u[pidx], col_sel)
+    # distinctness: no two kept rows agree on (universe, columns)
+    keys = {(int(u), c.tobytes()) for u, c in zip(u_u, c_u)}
+    assert len(keys) == u_u.shape[0] < uidx.shape[0]
+
+
+def test_unique_pairs_padding_is_inert():
+    uidx = np.array([0, 1, 0], np.int64)
+    col_sel = np.array([[1, 0], [1, 0], [1, 0]], bool)
+    u_u, c_u, pidx = unique_pairs(uidx, col_sel, pad_to=5)
+    assert u_u.shape == (5,) and c_u.shape == (5, 2)
+    # pads repeat pair 0 and pair_idx never points at them
+    np.testing.assert_array_equal(u_u[2:], [u_u[0]] * 3)
+    assert pidx.max() <= 1
+    with pytest.raises(ValueError, match="below"):
+        unique_pairs(uidx, col_sel, pad_to=1)
+
+
+# -- stats-level exactness ---------------------------------------------------
+
+@pytest.mark.parametrize("route", ["xla", "pallas"])
+def test_window_none_plus_expand_matches_windowed_contraction(route):
+    y, x, masks = _panel()
+    t = y.shape[0]
+    grid = _window_sweep_grid(t, x.shape[2])
+    universes, uidx, col_sel, window = _grid_tensors(grid, masks, t)
+    kw = ({"route": "pallas", "block_n": 64, "interpret": True}
+          if route == "pallas" else {})
+    ref = contract_spec_grams(jnp.asarray(y), jnp.asarray(x), universes,
+                              jnp.asarray(uidx), jnp.asarray(col_sel),
+                              jnp.asarray(window), **kw)
+    u_u, c_u, pidx = unique_pairs(uidx, col_sel)
+    assert u_u.shape[0] == 4  # 2 sets × 2 universes, windows collapsed
+    pair = contract_spec_grams(jnp.asarray(y), jnp.asarray(x), universes,
+                               jnp.asarray(u_u), jnp.asarray(c_u), None,
+                               **kw)
+    got = expand_window_stats(pair, jnp.asarray(pidx), jnp.asarray(window))
+    for name in ("gram", "moment", "n", "ysum", "yy", "center"):
+        a = np.asarray(getattr(ref, name))
+        b = np.asarray(getattr(got, name))
+        if name == "n":
+            np.testing.assert_array_equal(a, b, err_msg=name)
+        else:
+            scale = max(np.max(np.abs(a)), 1.0)
+            np.testing.assert_allclose(b, a, rtol=0, atol=1e-13 * scale,
+                                       err_msg=name)
+
+
+def test_expand_exact_under_coreset_row_weights():
+    y, x, masks = _panel(seed=3)
+    t, n = y.shape
+    rng = np.random.default_rng(9)
+    rw = jnp.asarray(rng.random((t, n)) * 2.0)  # importance weights
+    grid = _window_sweep_grid(t, x.shape[2])
+    universes, uidx, col_sel, window = _grid_tensors(grid, masks, t)
+    ref = contract_spec_grams(jnp.asarray(y), jnp.asarray(x), universes,
+                              jnp.asarray(uidx), jnp.asarray(col_sel),
+                              jnp.asarray(window), row_weights=rw)
+    u_u, c_u, pidx = unique_pairs(uidx, col_sel)
+    pair = contract_spec_grams(jnp.asarray(y), jnp.asarray(x), universes,
+                               jnp.asarray(u_u), jnp.asarray(c_u), None,
+                               row_weights=rw)
+    got = expand_window_stats(pair, jnp.asarray(pidx), jnp.asarray(window))
+    for name in ("gram", "moment", "n", "ysum", "yy"):
+        a = np.asarray(getattr(ref, name))
+        scale = max(np.max(np.abs(a)), 1.0)
+        np.testing.assert_allclose(np.asarray(getattr(got, name)), a,
+                                   rtol=0, atol=1e-13 * scale, err_msg=name)
+
+
+# -- end-to-end differential -------------------------------------------------
+
+def _assert_grid_parity(off, on, atol, tstat_atol):
+    for f in ("slopes", "r2", "coef", "nw_se", "mean_r2", "mean_n"):
+        a = np.asarray(getattr(off, f), float)
+        b = np.asarray(getattr(on, f), float)
+        np.testing.assert_array_equal(np.isnan(a), np.isnan(b), err_msg=f)
+        scale = max(np.nanmax(np.abs(a), initial=0.0), 1.0)
+        np.testing.assert_allclose(b, a, rtol=0, atol=atol * scale,
+                                   equal_nan=True, err_msg=f)
+    a, b = np.asarray(off.tstat, float), np.asarray(on.tstat, float)
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b), err_msg="tstat")
+    scale = max(np.nanmax(np.abs(a), initial=0.0), 1.0)
+    np.testing.assert_allclose(b, a, rtol=0, atol=tstat_atol * scale,
+                               equal_nan=True, err_msg="tstat")
+    # month counts are EXACTLY equal — zeroed out-of-window months are
+    # the same zeros the legacy contraction produced
+    np.testing.assert_array_equal(off.n_months, on.n_months)
+    np.testing.assert_array_equal(off.month_valid, on.month_valid)
+    np.testing.assert_array_equal(off.n_obs, on.n_obs)
+
+
+def test_factorized_grid_parity_f64():
+    y, x, masks = _panel()
+    grid = _window_sweep_grid(y.shape[0], x.shape[2])
+    off = run_spec_grid(y, x, masks, grid, factorize="off")
+    on = run_spec_grid(y, x, masks, grid, factorize="on")
+    _assert_grid_parity(off, on, atol=1e-13, tstat_atol=1e-11)
+
+
+def test_factorized_grid_parity_f32():
+    y, x, masks = _panel(seed=7, dtype=np.float32)
+    grid = _window_sweep_grid(y.shape[0], x.shape[2])
+    off = run_spec_grid(y, x, masks, grid, factorize="off")
+    on = run_spec_grid(y, x, masks, grid, factorize="on")
+    # f32: 1e-6 RELATIVE (absolute diffs scale with the Gram entries);
+    # the t-stat divides two near-equal roundings, so it gets headroom
+    _assert_grid_parity(off, on, atol=1e-6, tstat_atol=1e-4)
+
+
+def test_factorized_grid_parity_coreset_weights():
+    y, x, masks = _panel(seed=5)
+    t, n = y.shape
+    rw = np.random.default_rng(4).random((t, n)) * 3.0
+    grid = _window_sweep_grid(t, x.shape[2])
+    off = run_spec_grid(y, x, masks, grid, row_weights=rw, referee=False,
+                        factorize="off")
+    on = run_spec_grid(y, x, masks, grid, row_weights=rw, referee=False,
+                       factorize="on")
+    _assert_grid_parity(off, on, atol=1e-13, tstat_atol=1e-11)
+
+
+# -- contraction-work ledger -------------------------------------------------
+
+def test_contraction_counts_track_pairs_not_specs():
+    y, x, masks = _panel(seed=11)
+    grid = _window_sweep_grid(y.shape[0], x.shape[2])
+    s = len(grid)
+    before = contraction_counts()
+    run_spec_grid(y, x, masks, grid, factorize="on")
+    after = contraction_counts()
+    d = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    assert d.get("specs_solved") == s
+    assert d.get("pairs_unique") == 4
+    assert d.get("pairs_contracted") == 4 < s
+    assert d.get("specs_contracted", 0) == 0
+    before = contraction_counts()
+    run_spec_grid(y, x, masks, grid, factorize="off")
+    after = contraction_counts()
+    assert after.get("specs_contracted", 0) - before.get(
+        "specs_contracted", 0) == s
+
+
+def test_auto_factorizes_window_sweeps_only():
+    # the per-CALL contraction ledger (not the per-trace counter — jit
+    # caching makes traces shape-dependent across the test session)
+    y, x, masks = _panel(seed=13)
+    t, p = y.shape[0], x.shape[2]
+    names = tuple(f"c{i}" for i in range(p))
+    sweep = _window_sweep_grid(t, p)
+    before = contraction_counts()
+    run_spec_grid(y, x, masks, sweep)     # factorize defaults to "auto"
+    after = contraction_counts()
+    assert after.get("pairs_contracted", 0) > before.get(
+        "pairs_contracted", 0)
+    # every pair distinct → auto keeps the legacy byte-pinned program
+    flat = SpecGrid(
+        (Spec("a", names[:2], "All"), Spec("b", names[:3], "Thin")),
+        union=names,
+    )
+    before = contraction_counts()
+    run_spec_grid(y, x, masks, flat)
+    after = contraction_counts()
+    assert after.get("pairs_contracted", 0) == before.get(
+        "pairs_contracted", 0)
+    assert after.get("specs_contracted", 0) - before.get(
+        "specs_contracted", 0) == len(flat)
+
+
+# -- knob guardrails ---------------------------------------------------------
+
+def test_factorize_resolution(monkeypatch):
+    monkeypatch.delenv("FMRP_GRAM_FACTORIZE", raising=False)
+    assert resolve_gram_factorize() == "auto"
+    monkeypatch.setenv("FMRP_GRAM_FACTORIZE", "on")
+    assert resolve_gram_factorize() == "on"
+    assert resolve_gram_factorize("off") == "off"  # arg beats env
+    monkeypatch.setenv("FMRP_GRAM_FACTORIZE", "sometimes")
+    with pytest.raises(ValueError, match="factorize"):
+        resolve_gram_factorize()
+
+
+def test_factorize_on_rejected_on_mesh_and_procs():
+    names = ("c0",)
+    grid = SpecGrid((Spec("m", names, "all"),), union=names)
+    y = np.zeros((3, 8))
+    x = np.zeros((3, 8, 1))
+    masks = {"all": np.ones((3, 8), bool)}
+    with pytest.raises(ValueError, match="single-device"):
+        run_spec_grid(y, x, masks, grid, mesh=object(), factorize="on")
+    with pytest.raises(ValueError, match="single-device"):
+        run_spec_grid(y, x, masks, grid, procs=2, factorize="on")
+
+
+def test_shared_center_matches_default_contraction_center():
+    y, x, masks = _panel(seed=17)
+    grid = _window_sweep_grid(y.shape[0], x.shape[2])
+    universes, uidx, col_sel, window = _grid_tensors(grid, masks, y.shape[0])
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), universes, jnp.asarray(uidx),
+        jnp.asarray(col_sel), jnp.asarray(window),
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.center),
+        np.asarray(shared_center(jnp.asarray(x))), atol=0,
+    )
+
+
+def test_sharded_callers_must_pass_shared_center():
+    y, x, masks = _panel(seed=19)
+    grid = _window_sweep_grid(y.shape[0], x.shape[2])
+    universes, uidx, col_sel, window = _grid_tensors(grid, masks, y.shape[0])
+    with pytest.raises(ValueError, match="shard"):
+        contract_spec_grams(
+            jnp.asarray(y), jnp.asarray(x), universes, jnp.asarray(uidx),
+            jnp.asarray(col_sel), jnp.asarray(window),
+            expect_shared_center=True,
+        )
