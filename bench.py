@@ -896,6 +896,15 @@ import sys
 import numpy as np
 import jax, jax.numpy as jnp
 n = int(sys.argv[1])
+expected = sys.argv[2] if len(sys.argv) > 2 else ""
+platform = jax.devices()[0].platform
+if expected == "tpu" and platform != "tpu":
+    # a silent CPU fallback in the child would chart XLA:CPU compile
+    # cost as the TPU fusion boundary and corrupt the
+    # FMRP_FUSE_SUBSETS_MB calibration evidence — fail LOUDLY with a
+    # distinct marker the parent records as invalid, never "ok"
+    print("FUSEPROBE_WRONG_BACKEND " + platform)
+    sys.exit(3)
 t, p = 600, 14
 rng = np.random.default_rng(0)
 x_all = jnp.asarray(rng.standard_normal((t, n, p)).astype(np.float32))
@@ -951,23 +960,49 @@ def _bench_fuseprobe(fast: bool):
     results = {}
     probe_s = {}
     t_start = time.perf_counter()
+    global _CHILD_PROC
+    wrong_backend = False
     for n in ladder:
         if time.perf_counter() - t_start > budget - per_probe:
             results[str(n)] = "budget-exhausted"
             break
         try:
             t0 = time.perf_counter()
-            proc = subprocess.run(
-                [sys.executable, "-c", _FUSEPROBE_CHILD, str(n)],
-                timeout=per_probe, capture_output=True, text=True,
+            # Popen + _CHILD_PROC (the _real_cpu_rescue/_bench_mesh8
+            # discipline): the global-deadline watchdog's os._exit must
+            # be able to kill a live compile child — subprocess.run
+            # would orphan it to burn the host for up to per_probe
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _FUSEPROBE_CHILD, str(n),
+                 "tpu" if on_tpu else "cpu"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 cwd=repo_root, env=None if on_tpu else _child_env(repo_root),
             )
+            _CHILD_PROC = proc
+            try:
+                stdout, stderr = proc.communicate(timeout=per_probe)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+                results[str(n)] = f"timeout>{per_probe:.0f}s"
+                break  # larger shapes only get worse; save the window
+            finally:
+                _CHILD_PROC = None
             probe_s[str(n)] = round(time.perf_counter() - t0, 2)
-            ok = proc.returncode == 0 and "FUSEPROBE_OK" in proc.stdout
+            if "FUSEPROBE_WRONG_BACKEND" in stdout:
+                # the probe ran, but not on the backend it claims to
+                # calibrate — record INVALID (a distinct verdict the
+                # ladder's "ok" consumers can never mistake), and stop:
+                # every later rung would be equally invalid
+                results[str(n)] = "invalid: wrong-backend (" + \
+                    stdout.split("FUSEPROBE_WRONG_BACKEND", 1)[1].strip()[:40] + ")"
+                wrong_backend = True
+                break
+            ok = proc.returncode == 0 and "FUSEPROBE_OK" in stdout
             results[str(n)] = "ok" if ok else (
-                "fail: " + (proc.stderr or proc.stdout)[-150:])
-        except subprocess.TimeoutExpired:
-            results[str(n)] = f"timeout>{per_probe:.0f}s"
+                "fail: " + (stderr or stdout)[-150:])
+        except Exception as exc:  # noqa: BLE001 — a probe is best-effort
+            results[str(n)] = f"spawn-error: {exc!r}"[:160]
         if results[str(n)] != "ok":
             break  # larger shapes only get worse; save the window
     from fm_returnprediction_tpu.reporting.fusion import stacked_design_bytes
@@ -978,6 +1013,7 @@ def _bench_fuseprobe(fast: bool):
         "fuseprobe_probe_s": probe_s,
         "fuseprobe_device": "tpu" if on_tpu else "cpu",
         "fuseprobe_scale": "real" if on_tpu else "small",
+        "fuseprobe_backend_valid": not wrong_backend,
         "fuseprobe_largest_ok_mb": (
             round(stacked_design_bytes(3, 600, max(ok_ns), 14, 4) / 2**20)
             if ok_ns else 0
@@ -1195,7 +1231,31 @@ def _bench_multiproc(fast: bool):
                 out[f"multiproc_merge_s_p{procs}"] = round(
                     pool.last_merge_s, 4
                 )
+                out["multiproc_grid_transport"] = pool.transport
+                if pool.transport == "shm":
+                    # mapped-segment bytes are disclosed SEPARATELY from
+                    # exchange bytes: the stats still move (one memcpy
+                    # into the segment, summed in place by the parent),
+                    # they just never ride a pickle frame
+                    out[f"multiproc_shm_mapped_bytes_per_grid_p{procs}"] \
+                        = int(pool.last_shm_bytes)
         finally:
+            multiproc._close_cached_pool()
+    # the frames ORACLE at p4, one grid: what the same contraction costs
+    # in exchange bytes without the mapped segments — the denominator of
+    # the ISSUE-15 "≥10× down" claim (skipped in fast mode: it spawns a
+    # second 4-worker pool purely for a byte measurement)
+    if not fast and os.environ.get("FMRP_GRID_TRANSPORT", "") == "":
+        os.environ["FMRP_GRID_TRANSPORT"] = "frames"
+        try:
+            _mp_grid_run(specgrid, y, x, masks, grid, 4, cpw)
+            if multiproc._POOL_CACHE is not None:
+                pool = multiproc._POOL_CACHE[2]
+                out["multiproc_transport_bytes_per_grid_p4_frames"] = int(
+                    pool.last_merge_bytes
+                )
+        finally:
+            os.environ.pop("FMRP_GRID_TRANSPORT", None)
             multiproc._close_cached_pool()
     if 1 in coef_by_procs and 4 in coef_by_procs:
         a, b = coef_by_procs[1], coef_by_procs[4]
@@ -1209,11 +1269,59 @@ def _bench_multiproc(fast: bool):
             out["multiproc_specgrid_speedup_p4"] = round(p4 / p1, 2)
 
     # -- fleet: thread vs process replica boundary -------------------------
-    from fm_returnprediction_tpu.serving import (
-        ServingFleet,
-        build_serving_state,
-        replay_journal,
+    # NB: the process fleet runs on the DEFAULT transport (shm since
+    # ISSUE 15, disclosed in multiproc_fleet_transport) — this series is
+    # "the process fleet as deployed", so the auto-default improvement
+    # lands here like specgrid_scale did under PR 14's new defaults; the
+    # per-transport split (socket oracle included) lives in the
+    # transport_* section
+    from fm_returnprediction_tpu.serving import ServingFleet, replay_journal
+
+    state, have, (_, _, pf), per_mode, n_workers = _fleet_bench_fixture(
+        fast, "FMRP_BENCH_MULTIPROC_QUERIES"
     )
+    rngq = np.random.default_rng(2017)
+    with tempfile.TemporaryDirectory() as root:
+        for mode in ("thread", "process"):
+            journal = os.path.join(root, f"journal_{mode}.jsonl")
+            fleet = ServingFleet(
+                state, 2, replica_mode=mode, max_batch=64,
+                max_latency_ms=1.0, journal=journal,
+            )
+            try:
+                mon = have[rngq.integers(0, len(have), per_mode)]
+                rows = rngq.standard_normal(
+                    (per_mode, pf)
+                ).astype(np.float32)
+                # warm the path before timing (first queries pay dispatch
+                # warm-up either side of the boundary)
+                fleet.query(int(mon[0]), rows[0])
+                rps, errors = _drive_fleet_blocking(
+                    fleet, mon, rows, n_workers
+                )
+                fleet.drain()
+                out[f"multiproc_fleet_rows_per_s_{mode}"] = round(rps, 1)
+                out[f"multiproc_fleet_query_errors_{mode}"] = len(errors)
+            finally:
+                fleet.close()
+            replay = replay_journal(journal)
+            out[f"multiproc_fleet_journal_clean_{mode}"] = bool(replay.clean)
+    thr = out.get("multiproc_fleet_rows_per_s_thread")
+    prc = out.get("multiproc_fleet_rows_per_s_process")
+    if thr and prc:
+        out["multiproc_fleet_process_over_thread"] = round(prc / thr, 3)
+    from fm_returnprediction_tpu.serving.shm import resolve_fleet_transport
+
+    out["multiproc_fleet_transport"] = resolve_fleet_transport()
+    return out
+
+
+def _fleet_bench_fixture(fast: bool, queries_env: str):
+    """The ONE fleet bench shape (the r08 series' fixture), shared by
+    the multiproc and transport sections so the comparable series can
+    never drift apart: returns (state, quotable months, (T, N, P),
+    per_mode, n_workers)."""
+    from fm_returnprediction_tpu.serving import build_serving_state
 
     tf, nf, pf = (60, 200, 5) if fast else (120, 600, 5)
     rngf = np.random.default_rng(2016)
@@ -1228,65 +1336,41 @@ def _bench_multiproc(fast: bool):
         yf, xf, maskf, window=min(60, tf // 2), min_periods=min(24, tf // 4)
     )
     per_mode = int(os.environ.get(
-        "FMRP_BENCH_MULTIPROC_QUERIES", 400 if fast else 2000
+        queries_env, 400 if fast else 2000
     ))
-    n_workers = 8
     have = np.nonzero(state.have_coef())[0]
-    with tempfile.TemporaryDirectory() as root:
-        for mode in ("thread", "process"):
-            journal = os.path.join(root, f"journal_{mode}.jsonl")
-            fleet = ServingFleet(
-                state, 2, replica_mode=mode, max_batch=64,
-                max_latency_ms=1.0, journal=journal,
-            )
+    return state, have, (tf, nf, pf), per_mode, 8
+
+
+def _drive_fleet_blocking(fleet, mon, rows, n_workers: int):
+    """The blocking 8-worker drive both fleet sections time: each worker
+    issues its chunk of synchronous queries; returns (rows/s, errors)."""
+    import threading as _threading
+
+    per = len(mon)
+    errors = []
+    t0 = time.perf_counter()
+
+    def worker(k0, k1):
+        for k in range(k0, k1):
             try:
-                mon = have[rngf.integers(0, len(have), per_mode)]
-                rows = rngf.standard_normal(
-                    (per_mode, pf)
-                ).astype(np.float32)
-                # warm the path before timing (first queries pay dispatch
-                # warm-up either side of the boundary)
-                fleet.query(int(mon[0]), rows[0])
-                errors = []
-                t0 = time.perf_counter()
+                fleet.query(int(mon[k]), rows[k])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
 
-                def worker(k0, k1, mon=mon, rows=rows, fleet=fleet,
-                           errors=errors):
-                    for k in range(k0, k1):
-                        try:
-                            fleet.query(int(mon[k]), rows[k])
-                        except Exception as exc:  # noqa: BLE001
-                            errors.append(repr(exc))
-
-                chunk = per_mode // n_workers
-                threads = [
-                    _threading.Thread(
-                        target=worker,
-                        args=(w * chunk,
-                              per_mode if w == n_workers - 1
-                              else (w + 1) * chunk),
-                    )
-                    for w in range(n_workers)
-                ]
-                for th in threads:
-                    th.start()
-                for th in threads:
-                    th.join()
-                wall = time.perf_counter() - t0
-                fleet.drain()
-                out[f"multiproc_fleet_rows_per_s_{mode}"] = round(
-                    per_mode / wall, 1
-                )
-                out[f"multiproc_fleet_query_errors_{mode}"] = len(errors)
-            finally:
-                fleet.close()
-            replay = replay_journal(journal)
-            out[f"multiproc_fleet_journal_clean_{mode}"] = bool(replay.clean)
-    thr = out.get("multiproc_fleet_rows_per_s_thread")
-    prc = out.get("multiproc_fleet_rows_per_s_process")
-    if thr and prc:
-        out["multiproc_fleet_process_over_thread"] = round(prc / thr, 3)
-    return out
+    chunk = per // n_workers
+    threads = [
+        _threading.Thread(
+            target=worker,
+            args=(w * chunk, per if w == n_workers - 1 else (w + 1) * chunk),
+        )
+        for w in range(n_workers)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return per / (time.perf_counter() - t0), errors
 
 
 def _mp_grid_run(specgrid, y, x, masks, grid, procs, cpw):
@@ -1301,6 +1385,279 @@ def _mp_grid_run(specgrid, y, x, masks, grid, procs, cpw):
             os.environ.pop("FMRP_SPECGRID_CPUS_PER_PROC", None)
         else:
             os.environ["FMRP_SPECGRID_CPUS_PER_PROC"] = prev
+
+
+def _transport_counter_delta(before: dict, after: dict, transport: str
+                             ) -> dict:
+    """Sum the ``fmrp_transport_*`` counter families for one transport
+    label across replicas, as after−before deltas (bytes by direction,
+    frames, ring-full stalls, batch-occupancy mean)."""
+    def total(metrics, name, must=()):
+        tot = 0.0
+        for k, v in metrics.items():
+            if not k.startswith(name):
+                continue
+            if f"transport={transport}" not in k:
+                continue
+            if any(m not in k for m in must):
+                continue
+            if isinstance(v, dict):
+                continue
+            tot += float(v)
+        return tot
+
+    def occupancy(metrics):
+        s = c = 0.0
+        for k, v in metrics.items():
+            if (k.startswith("fmrp_transport_batch_rows")
+                    and f"transport={transport}" in k
+                    and isinstance(v, dict)):
+                s += float(v.get("sum", 0.0))
+                c += float(v.get("count", 0.0))
+        return s, c
+
+    d = {
+        "bytes_sent": total(after, "fmrp_transport_bytes_total",
+                            ("direction=sent",))
+        - total(before, "fmrp_transport_bytes_total", ("direction=sent",)),
+        "bytes_received": total(after, "fmrp_transport_bytes_total",
+                                ("direction=received",))
+        - total(before, "fmrp_transport_bytes_total",
+                ("direction=received",)),
+        "frames": total(after, "fmrp_transport_frames_total")
+        - total(before, "fmrp_transport_frames_total"),
+        "ring_full_stalls": total(
+            after, "fmrp_transport_ring_full_stalls_total")
+        - total(before, "fmrp_transport_ring_full_stalls_total"),
+    }
+    s1, c1 = occupancy(after)
+    s0, c0 = occupancy(before)
+    d["batch_rows_mean"] = (
+        round((s1 - s0) / (c1 - c0), 2) if c1 > c0 else None
+    )
+    return d
+
+
+def _bench_transport(fast: bool):
+    """The process fleet's data plane, socket vs shared-memory rings
+    (ISSUE 15): the same blocking 8-worker drive as the
+    ``multiproc_fleet_*`` series (the BENCH_r08 fleet shape) through
+
+    - ``transport_fleet_rows_per_s_{thread,socket,shm}`` — thread
+      replicas (the incumbent ceiling), process replicas over the
+      pickle socket (the ISSUE-13 transport, kept as the differential
+      oracle), and process replicas over the shm rings;
+    - ``fleet_process_over_thread`` — shm-process over thread, THE
+      regress-gated series (≥1.0 = the process boundary no longer
+      taxes the data plane; r08's socket measured 0.643);
+    - ``transport_{socket,shm}_*`` — per-mode byte/frame/stall counter
+      deltas and the shm batch-occupancy mean (how many rows each ring
+      frame coalesced);
+    - ``transport_{thread,shm}_pipelined_rows_per_s`` — a bounded
+      64-deep submit pipeline per worker: the throughput-oriented
+      drive. DISCLOSED asymmetry: the shm path stays router-GIL-bound
+      here (every result crosses one reader thread); the blocking
+      drive above is the gated series;
+    - a mid-load ``hard_crash`` on the SHM path whose journal, after
+      ``ServingFleet.recover``, replays CLEAN — 0 dropped / 0
+      duplicated (``transport_crash_*``) — the exactly-once proof
+      composed with the zero-copy data plane.
+
+    FMRP_BENCH_TRANSPORT=0 skips; _TRANSPORT_QUERIES resizes."""
+    if os.environ.get("FMRP_BENCH_TRANSPORT", "1") == "0":
+        return {}
+    import tempfile
+    import threading as _threading
+
+    from fm_returnprediction_tpu.serving import ServingFleet, replay_journal
+    from fm_returnprediction_tpu.telemetry.export import flat_metrics
+
+    state, have, shape, per_mode, n_workers = _fleet_bench_fixture(
+        fast, "FMRP_BENCH_TRANSPORT_QUERIES"
+    )
+    tf, nf, pf = shape
+    rngq = np.random.default_rng(2016)
+    mon = have[rngq.integers(0, len(have), per_mode)]
+    rows = rngq.standard_normal((per_mode, pf)).astype(np.float32)
+    out = {
+        "transport_shape": (
+            f"T{tf}_N{nf}_P{pf}_q{per_mode}_w{n_workers}"
+        ),
+    }
+
+    def drive_blocking(fleet):
+        return _drive_fleet_blocking(fleet, mon, rows, n_workers)
+
+    def drive_pipelined(fleet):
+        t0 = time.perf_counter()
+
+        def worker(k0, k1):
+            futs = []
+            for k in range(k0, k1):
+                try:
+                    futs.append(fleet.submit(int(mon[k]), rows[k]))
+                except Exception:  # noqa: BLE001 — sheds don't stall it
+                    pass
+                if len(futs) >= 64:
+                    for f in futs:
+                        try:
+                            f.result(timeout=30)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    futs = []
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        chunk = per_mode // n_workers
+        threads = [
+            _threading.Thread(
+                target=worker,
+                args=(w * chunk,
+                      per_mode if w == n_workers - 1 else (w + 1) * chunk),
+            )
+            for w in range(n_workers)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return per_mode / (time.perf_counter() - t0)
+
+    modes = (
+        ("thread", "thread", None),
+        ("socket", "process", "socket"),
+        ("shm", "process", "shm"),
+    )
+    with tempfile.TemporaryDirectory() as root:
+        for label, rmode, transport in modes:
+            journal = os.path.join(root, f"journal_{label}.jsonl")
+            before = mid = flat_metrics()
+            fleet = ServingFleet(
+                state, 2, replica_mode=rmode, transport=transport,
+                max_batch=64, max_latency_ms=1.0, journal=journal,
+            )
+            try:
+                fleet.query(int(mon[0]), rows[0])  # warm the path
+                rps, errors = drive_blocking(fleet)
+                # counter window closes HERE: the per-query byte/frame
+                # deltas must cover exactly the blocking drive every
+                # mode runs, not the extra pipelined drive below (which
+                # only thread/shm run — including it would double shm's
+                # bytes-per-query against socket's)
+                mid = flat_metrics()
+                out[f"transport_fleet_rows_per_s_{label}"] = round(rps, 1)
+                out[f"transport_fleet_query_errors_{label}"] = len(errors)
+                if label in ("thread", "shm"):
+                    out[f"transport_{label}_pipelined_rows_per_s"] = round(
+                        drive_pipelined(fleet), 1
+                    )
+                fleet.drain()
+            finally:
+                fleet.close()
+            out[f"transport_fleet_journal_clean_{label}"] = bool(
+                replay_journal(journal).clean
+            )
+            if transport is not None:
+                delta = _transport_counter_delta(before, mid, transport)
+                out[f"transport_{label}_bytes_per_query"] = round(
+                    (delta["bytes_sent"] + delta["bytes_received"])
+                    / max(per_mode, 1), 1
+                )
+                out[f"transport_{label}_frames"] = int(delta["frames"])
+                if transport == "shm":
+                    out["transport_shm_ring_full_stalls"] = int(
+                        delta["ring_full_stalls"]
+                    )
+                    out["transport_shm_batch_rows_mean"] = (
+                        delta["batch_rows_mean"]
+                    )
+
+        thr = out.get("transport_fleet_rows_per_s_thread")
+        shm = out.get("transport_fleet_rows_per_s_shm")
+        sock = out.get("transport_fleet_rows_per_s_socket")
+        if thr and shm:
+            out["fleet_process_over_thread"] = round(shm / thr, 3)
+        if thr and sock:
+            out["transport_socket_over_thread"] = round(sock / thr, 3)
+
+        # -- replica-count ladder on the shm path --------------------------
+        ladder = (1, 2) if fast else (1, 2, 4)
+        for r in ladder:
+            fleet = ServingFleet(
+                state, r, replica_mode="process", transport="shm",
+                max_batch=64, max_latency_ms=1.0,
+            )
+            try:
+                fleet.query(int(mon[0]), rows[0])
+                rps, _ = drive_blocking(fleet)
+                out[f"transport_shm_r{r}_rows_per_s"] = round(rps, 1)
+            finally:
+                fleet.close()
+
+        # -- mid-load hard crash on the shm path ---------------------------
+        journal = os.path.join(root, "journal_crash.jsonl")
+        fleet = ServingFleet(
+            state, 2, replica_mode="process", transport="shm",
+            max_batch=64, max_latency_ms=1.0, journal=journal,
+        )
+        crash_at = per_mode // 3
+
+        def crash_worker(k0, k1):
+            for k in range(k0, k1):
+                try:
+                    fleet.query(int(mon[k]), rows[k])
+                except Exception:  # noqa: BLE001 — post-crash submits fail
+                    pass
+
+        fleet.query(int(mon[0]), rows[0])
+        chunk = per_mode // n_workers
+        threads = [
+            _threading.Thread(target=crash_worker,
+                              args=(w * chunk, (w + 1) * chunk))
+            for w in range(n_workers)
+        ]
+        for th in threads:
+            th.start()
+        # crash mid-load: wait until roughly a third of the queries are
+        # journaled, then die the way a SIGKILLed router dies
+        deadline = time.perf_counter() + 30.0
+        while (fleet.journal is not None
+               and fleet._req_counter < crash_at
+               and time.perf_counter() < deadline):
+            time.sleep(0.002)
+        fleet.hard_crash()
+        for th in threads:
+            th.join()
+        # the crashed session is dirty by construction; recovery must
+        # close out every in-flight request and replay CLEAN
+        recovered, report = ServingFleet.recover(
+            journal, state=state, replica_mode="thread",
+            max_batch=64, max_latency_ms=1.0,
+        )
+        try:
+            final = replay_journal(journal)
+            rotated = (replay_journal(report.rotated_to)
+                       if report.rotated_to is not None else None)
+            out["transport_crash_journal_clean"] = bool(
+                report.journal.replay_clean
+                and final.clean
+                and (rotated is None or rotated.clean)
+            )
+            out["transport_crash_closed_out"] = len(
+                report.journal.recovered
+            )
+            out["transport_crash_dropped"] = (
+                len(rotated.dropped) if rotated is not None else 0
+            )
+            out["transport_crash_duplicated"] = (
+                len(rotated.duplicated) if rotated is not None else 0
+            )
+        finally:
+            recovered.close()
+    return out
 
 
 def _bench_specgrid_scale(fast: bool):
@@ -2884,6 +3241,7 @@ def main() -> None:
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_grid_factorized)  # _GRID_FACTORIZED=0 in-section
     sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
+    sections.append(_bench_transport)  # _TRANSPORT=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_obs)  # _OBS=0 handled in-section

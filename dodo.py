@@ -184,3 +184,32 @@ def task_multiprocess_smoke():
         "verbosity": 2,
         "uptodate": [False],  # test-suite target: always re-run
     }
+
+
+def task_transport_parity():
+    """The shm data plane's differential suite as one named exit-1 gate
+    (``tests/test_transport.py``): ring seq/commit protocol (torn frame
+    = absent), frame-grammar round-trips incl. the DegradedQuote
+    columns, shm-vs-socket-vs-thread bit-identical fleet quotes,
+    ring-full backpressure as the typed retriable overload, the
+    hard-crash journal replay on the shm path, and the multiproc grid's
+    mapped-segment stats against the pickled-frames oracle — the
+    pre-merge gate for anything touching ``parallel/shm.py``,
+    ``serving/shm.py``, or the replica/grid transports. Sits alongside
+    ``grid_parity`` (Gram routes) and ``multiprocess_smoke``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m transport -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "transport marker differential suite (shm ring protocol, "
+               "fleet shm-vs-socket, grid shm-vs-frames) — exit-1 on "
+               "any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }

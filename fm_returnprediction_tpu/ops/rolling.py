@@ -144,14 +144,20 @@ def resolve_rolling_route(x=None, route: str | None = None) -> str:
         return "pallas" if on else "xla"
     import jax
 
-    devices = None
+    platform = None
     if x is not None:
         sharding = getattr(x, "sharding", None)  # absent on tracers/numpy
         if sharding is not None:
-            devices = getattr(sharding, "_device_assignment", None)
-    if devices:
-        platform = devices[0].platform
-    else:
+            # PUBLIC device API (jax.sharding.Sharding.device_set) — the
+            # previous private ``_device_assignment`` read degraded to a
+            # silent None on a jax rename, which would have disarmed
+            # exactly the protection this exists for (a CPU-committed
+            # array dispatching the TPU-only kernel in a TPU-default
+            # process)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set:
+                platform = next(iter(device_set)).platform
+    if platform is None:
         platform = jax.devices()[0].platform
     return "pallas" if platform == "tpu" else "xla"
 

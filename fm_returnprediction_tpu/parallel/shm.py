@@ -1,0 +1,454 @@
+"""Zero-copy shared-memory primitives: SPSC frame rings + mapped segments.
+
+``parallel.distributed`` gave the repo ONE wire format — length-prefixed
+pickle frames over TCP. That is the right shape for control-plane verbs
+(rare, structured, trusted) and the WRONG shape for the data plane:
+BENCH_r08 measured the process fleet at 0.643× the thread fleet and the
+multiproc spec-grid shipping 8.6 MB of pickle per grid at p4 — the
+transport, not the solve, is the bottleneck (the PAPERS.md out-of-core
+regression result: at scale the algorithm is data movement). This module
+is the data plane's home:
+
+:class:`ShmRing`
+    A fixed-slot single-producer/single-consumer frame ring over ONE
+    ``multiprocessing.shared_memory`` segment, crossing exactly one
+    process boundary. Fixed-width binary frames, no pickle on the hot
+    path, and a sequence/commit protocol that makes a torn frame read
+    as ABSENT (the crash-safety contract the fleet journal's
+    exactly-once proof leans on):
+
+    - every slot carries a ``commit`` word holding the GLOBAL sequence
+      number of the frame it contains; the writer copies payload bytes
+      and the length FIRST and writes ``commit`` LAST, so a writer that
+      dies mid-frame leaves ``commit`` at the previous lap's value and
+      the reader simply never observes the frame;
+    - the reader acknowledges consumption by publishing its cumulative
+      ``tail`` sequence; the writer refuses to lap it, so a slot is
+      never overwritten before its bytes were copied out;
+    - ring-full is BACKPRESSURE, not corruption: the writer stalls
+      (counted, ``fmrp_transport_ring_full_stalls_total``) and raises
+      typed :class:`RingFullError` past its deadline — the serving
+      layer maps that to the retriable ``ServiceOverloadError``.
+
+:class:`ShmArraySpec` / :func:`publish_array` / :func:`attach_array`
+    Numpy arrays published once into a named segment and MAPPED by the
+    consumer — the multiproc spec-grid's panel and Gram-stats path: a
+    worker maps the (T,N,P) panel instead of receiving panel bytes in
+    frames, and returns its additive Gram stats as a raw buffer the
+    parent sums in place.
+
+Python-3.10 wart, handled here once: attaching to an existing segment
+registers it with the ATTACHING process's ``resource_tracker``, whose
+exit would unlink a segment it does not own (bpo-38119). ``attach_*``
+therefore unregisters immediately — the CREATOR owns the name and
+unlinks it; everyone else is a guest.
+
+Atomicity note: the commit word is an aligned 8-byte store written by
+one thread after the payload stores. CPython's GIL hand-offs and the
+x86 TSO store order make "commit visible ⇒ payload visible" hold in
+practice; a torn commit read can only misread as NOT-committed (the
+reader retries), never as a committed frame with torn payload, because
+all differing low bytes of the new value land before any byte of the
+commit word is observed equal to the expected sequence.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import select
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Optional, Tuple
+
+__all__ = [
+    "RingFullError",
+    "ShmArraySpec",
+    "ShmRing",
+    "attach_array",
+    "attach_ring",
+    "publish_array",
+    "shm_available",
+    "transport_instruments",
+]
+
+_MAGIC = 0x464D5250_53484D31  # "FMRPSHM1"
+# magic, nslots, slot_bytes, tail, want_bell (reader-blocked flag), pad
+_HDR = struct.Struct("<QQQQQ3Q")
+_SLOT_HDR = struct.Struct("<QI4x")      # commit seq, payload length, pad
+HEADER_BYTES = _HDR.size
+SLOT_HEADER_BYTES = _SLOT_HDR.size
+_TAIL_OFF = 24                          # offset of the tail word in _HDR
+_WANT_BELL_OFF = 32                     # reader sets 1 before blocking
+
+
+class RingFullError(RuntimeError):
+    """The writer could not place a frame before its deadline: the
+    reader has not released enough slots (transport backpressure). The
+    serving layer translates this into the typed retriable
+    ``ServiceOverloadError`` — a ring-full data plane is an overloaded
+    replica, not a protocol failure."""
+
+    def __init__(self, message: str, stalled_s: float = 0.0):
+        super().__init__(message)
+        self.stalled_s = float(stalled_s)
+
+
+def shm_available() -> bool:
+    """Whether POSIX shared memory is usable here (the transport
+    resolvers' capability probe — e.g. a read-only /dev/shm would make
+    ``shm`` resolution fall back to the socket/frames oracle)."""
+    try:
+        seg = shared_memory.SharedMemory(create=True, size=16)
+    except (OSError, ValueError):
+        return False
+    seg.close()
+    seg.unlink()
+    return True
+
+
+def _unregister(name: str) -> None:
+    """Drop a segment from THIS process's resource tracker (attach-side
+    only — see the module docstring's bpo-38119 note)."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:  # noqa: BLE001 — tracker variance across minors
+        pass
+
+
+def transport_instruments(transport: str, replica: str = "") -> dict:
+    """The transport observability contract in ONE place: byte/frame/
+    stall counters and the batch-occupancy histogram, labelled by
+    transport (``shm``/``socket``/``grid_shm``/``grid_frames``) and
+    replica/rank. Both the shm rings and the socket replica transport
+    report through these, so the bench's socket-vs-shm comparison reads
+    one family."""
+    from fm_returnprediction_tpu import telemetry
+
+    reg = telemetry.registry()
+    labels = {"transport": transport}
+    if replica:
+        labels["replica"] = replica
+    return {
+        "bytes_out": reg.counter(
+            "fmrp_transport_bytes_total",
+            help="data-plane payload bytes by transport and direction",
+            direction="sent", **labels,
+        ),
+        "bytes_in": reg.counter(
+            "fmrp_transport_bytes_total",
+            help="data-plane payload bytes by transport and direction",
+            direction="received", **labels,
+        ),
+        "frames": reg.counter(
+            "fmrp_transport_frames_total",
+            help="data-plane frames by transport",
+            **labels,
+        ),
+        "stalls": reg.counter(
+            "fmrp_transport_ring_full_stalls_total",
+            help="writer stalls waiting on a full ring (backpressure)",
+            **labels,
+        ),
+        "batch_rows": reg.histogram(
+            "fmrp_transport_batch_rows",
+            help="rows coalesced per data-plane frame",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+            **labels,
+        ),
+    }
+
+
+class ShmRing:
+    """One direction of a data plane: a fixed-slot SPSC frame ring.
+
+    Exactly one WRITER process and one READER process (each side may
+    serialize its own threads through the internal lock). Created by
+    the owner (``create=True``), attached by the guest via
+    :func:`attach_ring`; the owner unlinks.
+    """
+
+    def __init__(self, name: Optional[str] = None, *, slots: int = 64,
+                 slot_bytes: int = 65536, create: bool = False,
+                 instruments: Optional[dict] = None,
+                 doorbell_fd: Optional[int] = None):
+        if create:
+            if slots < 2 or slot_bytes <= SLOT_HEADER_BYTES:
+                raise ValueError("ring needs ≥2 slots and room for payload")
+            name = name or f"fmrp{os.getpid():x}{secrets.token_hex(4)}"
+            size = HEADER_BYTES + slots * slot_bytes
+            self._seg = shared_memory.SharedMemory(
+                name=name, create=True, size=size
+            )
+            _HDR.pack_into(self._seg.buf, 0, _MAGIC, slots, slot_bytes,
+                           0, 0, 0, 0, 0)
+        else:
+            if name is None:
+                raise ValueError("attaching needs the ring's name")
+            self._seg = shared_memory.SharedMemory(name=name)
+            _unregister(self._seg.name)
+            magic, slots, slot_bytes = _HDR.unpack_from(
+                self._seg.buf, 0)[:3]
+            if magic != _MAGIC:
+                self._seg.close()
+                raise ValueError(f"segment {name!r} is not an fmrp ring")
+        self.name = self._seg.name
+        self.owner = bool(create)
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self.payload_capacity = self.slot_bytes - SLOT_HEADER_BYTES
+        self._buf = self._seg.buf
+        self._lock = threading.Lock()
+        self._wseq = 0   # last committed write sequence (writer side)
+        self._rseq = 0   # last consumed sequence (reader side)
+        self._closed = False
+        self._inst = instruments or {}
+        # doorbell: an (inherited) eventfd the writer rings after every
+        # commit and the reader blocks on — boundary-crossing latency is
+        # then one kernel wakeup (~10 µs) instead of a sleep-poll tick.
+        # None (no eventfd on this platform / not wired) = poll fallback.
+        self._bell = doorbell_fd
+
+    # -- header words ------------------------------------------------------
+
+    def _tail(self) -> int:
+        return struct.unpack_from("<Q", self._buf, _TAIL_OFF)[0]
+
+    def _set_tail(self, seq: int) -> None:
+        struct.pack_into("<Q", self._buf, _TAIL_OFF, seq)
+
+    def _want_bell(self) -> int:
+        return struct.unpack_from("<Q", self._buf, _WANT_BELL_OFF)[0]
+
+    def _set_want_bell(self, v: int) -> None:
+        struct.pack_into("<Q", self._buf, _WANT_BELL_OFF, v)
+
+    def _slot_off(self, seq: int) -> int:
+        return HEADER_BYTES + ((seq - 1) % self.slots) * self.slot_bytes
+
+    # -- writer ------------------------------------------------------------
+
+    def send(self, payload: bytes, timeout_s: float = 5.0) -> None:
+        """Place one frame; raises :class:`RingFullError` if the reader
+        does not release a slot within ``timeout_s``. The commit word is
+        written LAST — a writer death anywhere before that line leaves a
+        frame that reads as absent."""
+        n = len(payload)
+        if n > self.payload_capacity:
+            raise ValueError(
+                f"frame of {n} B exceeds slot payload capacity "
+                f"{self.payload_capacity} B"
+            )
+        with self._lock:
+            if self._closed:
+                raise RingFullError("ring is closed")
+            seq = self._wseq + 1
+            if seq - self._tail() > self.slots:
+                # backpressure: stall (counted once per episode), then
+                # typed failure past the deadline
+                inst = self._inst.get("stalls")
+                if inst is not None:
+                    inst.inc()
+                deadline = time.monotonic() + timeout_s
+                while seq - self._tail() > self.slots:
+                    if self._closed:
+                        raise RingFullError("ring closed while stalled")
+                    now = time.monotonic()
+                    if now >= deadline:
+                        raise RingFullError(
+                            f"ring {self.name} full for {timeout_s:.3f}s "
+                            f"(reader at {self._tail()}, writer at {seq})",
+                            stalled_s=timeout_s,
+                        )
+                    time.sleep(1e-4)  # stall is the rare path: plain poll
+            off = self._slot_off(seq)
+            data_off = off + SLOT_HEADER_BYTES
+            self._buf[data_off:data_off + n] = payload
+            struct.pack_into("<I", self._buf, off + 8, n)
+            # commit LAST: the frame exists only once this word reads seq
+            struct.pack_into("<Q", self._buf, off, seq)
+            self._wseq = seq
+            # ring the doorbell only when the reader says it is blocked
+            # (want_bell, set before it enters select and re-checks the
+            # commit word — the flag protocol can delay a wakeup to the
+            # bounded select timeout only if the flag store itself loses
+            # the race, which the reader's re-check closes). An awake
+            # reader in its greedy drain sees the commit without a
+            # syscall; the eventfd write is ~35 µs when it wakes a
+            # blocked peer, the dominant cost of a per-frame bell.
+            if self._bell is not None and self._want_bell():
+                try:
+                    os.eventfd_write(self._bell, 1)
+                except OSError:
+                    pass  # reader gone; its own death path owns cleanup
+        bo = self._inst.get("bytes_out")
+        if bo is not None:
+            bo.inc(n)
+        fr = self._inst.get("frames")
+        if fr is not None:
+            fr.inc()
+
+    # -- reader ------------------------------------------------------------
+
+    def recv(self, timeout_s: float = 0.2,
+             spin_s: float = 0.0) -> Optional[bytes]:
+        """The next frame's payload (copied out), or None when no frame
+        commits within ``timeout_s`` — which is also exactly what a torn
+        frame looks like: its commit word never reaches the expected
+        sequence, so the reader simply keeps not seeing it.
+
+        ``spin_s``: busy-poll the commit word that long before blocking
+        on the doorbell — for readers whose CPU is otherwise idle (the
+        replica child), a short spin catches the next frame without
+        costing the WRITER an eventfd wakeup syscall."""
+        with self._lock:
+            seq = self._rseq + 1
+            off = self._slot_off(seq)
+            deadline = time.monotonic() + timeout_s
+            spin_until = time.monotonic() + spin_s if spin_s else 0.0
+            delay = 2e-5
+            while True:
+                if self._closed:
+                    return None
+                (commit,) = struct.unpack_from("<Q", self._buf, off)
+                if commit == seq:
+                    break
+                now = time.monotonic()
+                if now >= deadline:
+                    return None
+                if now < spin_until:
+                    continue  # hot spin: idle-CPU readers only
+                if self._bell is not None:
+                    # flag → re-check → block: the writer rings only for
+                    # a reader that declared itself blocked, and the
+                    # re-check closes the flag/commit race (a frame
+                    # committed before the flag store is seen here, not
+                    # slept through)
+                    try:
+                        self._set_want_bell(1)
+                        (commit,) = struct.unpack_from(
+                            "<Q", self._buf, off)
+                        if commit == seq:
+                            self._set_want_bell(0)
+                            break
+                        r, _, _ = select.select(
+                            [self._bell], [], [],
+                            min(deadline - now, 0.05),
+                        )
+                        self._set_want_bell(0)
+                        if r:
+                            os.read(self._bell, 8)
+                    except (OSError, ValueError):
+                        return None  # fd closed under us: ring is down
+                else:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2e-4)
+            (n,) = struct.unpack_from("<I", self._buf, off + 8)
+            data_off = off + SLOT_HEADER_BYTES
+            out = bytes(self._buf[data_off:data_off + n])
+            # release the slot only after the copy-out
+            self._set_tail(seq)
+            self._rseq = seq
+        bi = self._inst.get("bytes_in")
+        if bi is not None:
+            bi.inc(len(out))
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        # the flag is set BEFORE taking the lock: a sender stalled in
+        # its ring-full loop (or a reader polling) holds the lock for up
+        # to its full timeout, checks ``_closed`` every iteration, and
+        # must observe the close promptly — waiting for the lock here
+        # would serialize teardown behind the very stall being torn down
+        self._closed = True
+        with self._lock:
+            self._buf = None
+        try:
+            self._seg.close()
+        except (OSError, BufferError):
+            pass
+        if self.owner:
+            try:
+                self._seg.unlink()
+            except OSError:
+                # already gone (a crashed peer's resource tracker beat
+                # us to it) — still drop OUR tracker entry, or it warns
+                # about a "leaked" segment at interpreter exit
+                _unregister(self._seg.name)
+
+    def __del__(self):  # best-effort: rings must not outlive the session
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def attach_ring(name: str, instruments: Optional[dict] = None,
+                doorbell_fd: Optional[int] = None) -> ShmRing:
+    """Guest-side handle on an existing ring (geometry read from the
+    segment header; never unlinks). ``doorbell_fd`` is the creator's
+    inherited eventfd number (``pass_fds``), or None for poll mode."""
+    return ShmRing(name, create=False, instruments=instruments,
+                   doorbell_fd=doorbell_fd)
+
+
+# -- mapped numpy segments ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShmArraySpec:
+    """What a consumer needs to map a published array: segment name +
+    layout. Serializes as a plain dict (the job-frame control plane)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def to_meta(self) -> dict:
+        return {"name": self.name, "shape": list(self.shape),
+                "dtype": self.dtype}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "ShmArraySpec":
+        return cls(name=str(meta["name"]),
+                   shape=tuple(int(s) for s in meta["shape"]),
+                   dtype=str(meta["dtype"]))
+
+
+def publish_array(arr, name: Optional[str] = None
+                  ) -> Tuple[shared_memory.SharedMemory, ShmArraySpec]:
+    """Copy ``arr`` once into a named segment; the caller owns the
+    handle (keep it referenced, ``close()+unlink()`` when done)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    name = name or f"fmrp{os.getpid():x}{secrets.token_hex(4)}"
+    seg = shared_memory.SharedMemory(
+        name=name, create=True, size=max(int(arr.nbytes), 1)
+    )
+    view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)
+    view[...] = arr
+    del view
+    return seg, ShmArraySpec(seg.name, tuple(arr.shape), str(arr.dtype))
+
+
+def attach_array(spec: ShmArraySpec
+                 ) -> Tuple[shared_memory.SharedMemory, "object"]:
+    """Map a published array in place (zero copy). Returns the segment
+    handle (hold it as long as the view lives, ``close()`` after —
+    never unlink: the publisher owns the name) and the numpy view."""
+    import numpy as np
+
+    seg = shared_memory.SharedMemory(name=spec.name)
+    _unregister(seg.name)
+    view = np.ndarray(spec.shape, dtype=np.dtype(spec.dtype), buffer=seg.buf)
+    return seg, view

@@ -225,6 +225,70 @@ class MicroBatcher:
         )
         return fut
 
+    def submit_many(self, reqs) -> list:
+        """Bulk enqueue: ``reqs`` = [(month_idx, x), ...] → one result
+        per request, either ``("ok", Future)`` or ``("err", exception)``
+        — the EXACT per-row semantics of :meth:`submit` (malformed row /
+        queue-full / closed fail alone), paid under ONE lock acquisition
+        and one flusher notify. The process replica's shm serve loop
+        absorbs whole ring strips through this: per-row locking there
+        let the dispatch threads starve the loop into tiny batches."""
+        now = time.perf_counter()
+        out: list = []
+        rejected = 0
+        with self._cv:
+            depth = len(self._pending)
+            for month_idx, x in reqs:
+                if self._closed:
+                    out.append(("err", RuntimeError("batcher is closed")))
+                    continue
+                try:
+                    x = np.asarray(x)
+                except Exception as exc:  # noqa: BLE001 — a ragged/
+                    # unconvertible row fails ALONE (the submit()
+                    # contract); letting numpy's ValueError escape here
+                    # would kill the shm serve thread that feeds us
+                    out.append(("err", ValueError(
+                        f"feature row is not array-like: {exc!r}"[:300])))
+                    continue
+                if x.ndim != 1:
+                    out.append(("err", ValueError(
+                        f"feature row must be 1-D (P,), got {x.shape}")))
+                    continue
+                if (
+                    self._n_predictors is not None
+                    and x.shape[0] != self._n_predictors
+                ):
+                    out.append(("err", ValueError(
+                        f"feature row must have shape "
+                        f"({self._n_predictors},), got {x.shape}")))
+                    continue
+                if depth >= self.max_queue:
+                    self._m_rejected.inc()
+                    rejected += 1
+                    out.append(("err", QueueFullError(
+                        f"serving queue full ({depth} pending of "
+                        f"{self.max_queue} ceiling); shed load or retry",
+                        queue_depth=depth, max_queue=self.max_queue,
+                    )))
+                    continue
+                fut: Future = Future()
+                self._pending.append(
+                    _Pending(int(month_idx), x, fut, now)
+                )
+                depth += 1
+                out.append(("ok", fut))
+            if depth:
+                self._cv.notify_all()
+        n_ok = sum(1 for kind, _ in out if kind == "ok")
+        telemetry.event(
+            "serving.submit_many", cat="serving", rows=n_ok,
+            rejected=rejected, queue_depth=depth,
+        )
+        for _ in range(rejected):  # SLO burn counts each reject
+            self._notify(None, False, depth)
+        return out
+
     # -- consumer side -----------------------------------------------------
 
     def flush(self) -> int:

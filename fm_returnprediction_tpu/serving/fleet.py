@@ -137,8 +137,15 @@ __all__ = [
 # inner-future failures the fleet requeues on another replica: each means
 # "this replica failed the request", never "the request is malformed" —
 # requeueing a poison-pill request would just serially crash the fleet,
-# so ValueError/KeyError/... deliberately are NOT here
-_REQUEUEABLE = (ReplicaDeadError, DispatchTimeoutError, InjectedFault)
+# so ValueError/KeyError/... deliberately are NOT here. QueueFullError
+# joins the set for the shm transport's optimistic-accept path: a
+# replica-side backpressure reject arrives on the FUTURE there (thread
+# and socket modes raise it synchronously at submit, where
+# _route_and_submit already reroutes — it can never reach a thread-mode
+# inner future), and routing it to another replica is exactly what the
+# synchronous path would have done.
+_REQUEUEABLE = (ReplicaDeadError, DispatchTimeoutError, InjectedFault,
+                QueueFullError)
 
 
 # -- admission control -------------------------------------------------------
@@ -317,6 +324,7 @@ class ServingFleet:
         probe_interval_s: Optional[float] = None,
         admission_clock=time.monotonic,
         replica_mode: Optional[str] = None,
+        transport: Optional[str] = None,
         **service_kwargs,
     ):
         if n_replicas is None:
@@ -337,6 +345,10 @@ class ServingFleet:
                 f"replica_mode {replica_mode!r} is not 'thread'|'process'"
             )
         self.replica_mode = replica_mode
+        # process-replica data plane: "shm" rings or the "socket"
+        # oracle (serving.shm.resolve_fleet_transport; resolved per
+        # spawn so the env knob stays live). Irrelevant in thread mode.
+        self._transport = transport
         self._proc_scratch = None
         if replica_mode == "process":
             import tempfile
@@ -509,6 +521,7 @@ class ServingFleet:
                 rid, state, scratch=self._proc_scratch,
                 service_kwargs=self._service_kwargs,
                 registry_dir=reg_dir,
+                transport=self._transport,
             )
             if service.warm_report is not None:
                 self.warm_reports[rid] = service.warm_report
@@ -1181,6 +1194,7 @@ class ServingFleet:
                 "degraded": s["degraded"],
                 "dispatch_timeouts": s["dispatch_timeouts"],
                 "slo_state": s.get("slo_state"),
+                "transport": s.get("transport"),
                 "reasons": list(rep.reasons),
             }
             for k in ("n_done", "n_rejected", "n_failed", "queue_depth",
@@ -1199,8 +1213,15 @@ class ServingFleet:
             max(slo_states, key=lambda s: slo_order.get(s, 0))
             if slo_states else None
         )
+        transports = {
+            d.get("transport") for d in per_replica.values()
+            if d.get("transport")
+        }
         return {
             "fleet_size": len(reps),
+            "replica_mode": self.replica_mode,
+            "transport": (sorted(transports)[0] if len(transports) == 1
+                          else sorted(transports) or None),
             "slo_state": worst_slo,
             "brownout_level": (
                 self.brownout.level if self.brownout is not None else None

@@ -33,6 +33,19 @@ Protocol (every request frame carries a correlation ``id``):
 ``close``   graceful shutdown (drain, close, exit 0).
 ========== ================================================================
 
+Transports (``FMRP_FLEET_TRANSPORT=shm|socket``, default auto = shm
+where POSIX shared memory works): the table above is the CONTROL plane
+and always rides the socket. In ``socket`` mode the data plane (submit/
+accept/reject/result) rides it too — one pickled message per row, the
+ISSUE-13 shape, retained as the differential oracle. In ``shm`` mode
+the data plane moves to a pair of shared-memory frame rings
+(``serving.shm``): submits coalesce into one contiguous strip per ring
+slot, replicas answer with result columns (including ``DegradedQuote``
+disclosure columns, the wire capability for replica-side degraded
+routes — the brownout ladder itself answers router-side today), and
+ring-full backpressure surfaces as the typed retriable
+``ServiceOverloadError(reason="transport_ring_full")``.
+
 The WAL journal stays in the ROUTER: admits/routes/requeues/terminals are
 journaled parent-side exactly as before, so ``replay_journal``'s
 exactly-once proof now covers a replica PROCESS kill — a SIGKILLed child
@@ -139,19 +152,46 @@ class ProcessReplica:
                  service_kwargs: Optional[dict] = None,
                  registry_dir=None,
                  spawn_timeout_s: float = 180.0,
-                 call_timeout_s: float = 120.0):
+                 call_timeout_s: float = 120.0,
+                 transport: Optional[str] = None):
+        from fm_returnprediction_tpu.parallel.shm import (
+            transport_instruments,
+        )
+        from fm_returnprediction_tpu.serving.shm import (
+            resolve_fleet_transport,
+        )
+
         self.replica_id = rid
+        self.transport = resolve_fleet_transport(transport)
         self._call_timeout_s = float(call_timeout_s)
         self._dead: Optional[str] = None
         self._wlock = threading.Lock()
         self._idlock = threading.Lock()
         self._next_id = 0
+        self._n_inflight = 0  # submit entries in _pending (O(1) reads)
         # id → {"kind": "call"|"submit", "future": Future, "accept": Future}
         self._pending: Dict[int, dict] = {}
         kwargs = dict(service_kwargs or {})
         kwargs.pop("metric_labels", None)  # the child stamps its own
         max_queue = int(kwargs.get("max_queue", 1024))
         self.batcher = _RemoteBatcher(self, max_queue)
+        # the SOCKET is always the control plane (and, in socket mode,
+        # the data plane too) — its bytes count under transport=socket;
+        # the shm rings carry their own transport=shm instruments, so
+        # the bench's socket-vs-shm comparison reads clean labels
+        self._inst = transport_instruments("socket", rid)
+        self._channel = None
+        if self.transport == "shm":
+            from fm_returnprediction_tpu.serving.shm import (
+                ShmReplicaChannel,
+            )
+
+            self._channel = ShmReplicaChannel(
+                on_ack=self._deliver_ack,
+                on_results=self._deliver_results,
+                on_dead=self._mark_dead,
+                replica_id=rid,
+            )
         scratch = Path(scratch)
         state_path = _ship_state(state, scratch)
         listener = socket.create_server(("127.0.0.1", 0))
@@ -163,6 +203,8 @@ class ProcessReplica:
             "state_path": str(state_path),
             "registry_dir": str(registry_dir) if registry_dir else None,
             "service_kwargs": kwargs,
+            "shm": (self._channel.describe()
+                    if self._channel is not None else None),
         }
         fd, cfg_path = tempfile.mkstemp(suffix=".pkl", prefix=f"{rid}_cfg_",
                                         dir=str(scratch))
@@ -194,6 +236,8 @@ class ProcessReplica:
             [sys.executable, "-m",
              "fm_returnprediction_tpu.serving.replica_worker", cfg_path],
             env=env, stdout=self._log_fh, stderr=subprocess.STDOUT,
+            pass_fds=(self._channel.pass_fds()
+                      if self._channel is not None else ()),
         )
         try:
             conn, _ = listener.accept()
@@ -202,6 +246,7 @@ class ProcessReplica:
             hello = pickle.loads(recv_frame(conn))
         except (socket.timeout, OSError, EOFError) as exc:
             self.proc.kill()
+            self._stop_channel()
             raise ReplicaSpawnError(
                 f"replica {rid} never said hello within {spawn_timeout_s}s "
                 f"({exc!r}); log: {self._log_tail()}"
@@ -210,6 +255,7 @@ class ProcessReplica:
             listener.close()
         if not hello.get("ok"):
             self.proc.kill()
+            self._stop_channel()
             raise ReplicaSpawnError(
                 f"replica {rid} failed to start: {hello.get('error')}; "
                 f"log: {self._log_tail()}"
@@ -238,26 +284,48 @@ class ProcessReplica:
 
     @property
     def inflight(self) -> int:
-        with self._idlock:
-            return sum(1 for e in self._pending.values()
-                       if e["kind"] == "submit")
+        return self._n_inflight  # int read: atomic enough for a gauge
+
+    def _stop_channel(self) -> None:
+        if self._channel is not None:
+            try:
+                self._channel.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
 
     def _send(self, msg: dict) -> None:
         if self._dead is not None:
             raise ReplicaDeadError(self._dead)
         try:
-            send_frame(self._sock, pickle.dumps(msg), self._wlock)
+            payload = pickle.dumps(msg)
+            send_frame(self._sock, payload, self._wlock)
+            self._inst["bytes_out"].inc(len(payload))
+            self._inst["frames"].inc()
         except OSError as exc:
             self._mark_dead(f"replica {self.replica_id} socket write "
                             f"failed: {exc!r}")
             raise ReplicaDeadError(self._dead) from exc
 
-    def _register(self, kind: str) -> dict:
+    def _register(self, kind: str, accept: bool = True) -> dict:
+        # accept=False (the shm data plane): admission is optimistic, so
+        # no second Future is ever waited on — skipping it saves a
+        # threading.Condition construction per request on the hot path
         with self._idlock:
             self._next_id += 1
             entry = {"id": self._next_id, "kind": kind,
-                     "future": Future(), "accept": Future()}
+                     "future": Future(),
+                     "accept": Future() if accept else None}
             self._pending[self._next_id] = entry
+            if kind == "submit":
+                self._n_inflight += 1
+            return entry
+
+    def _pop(self, req_id: int) -> Optional[dict]:
+        """Remove one pending entry (inflight accounting in one place)."""
+        with self._idlock:
+            entry = self._pending.pop(req_id, None)
+            if entry is not None and entry["kind"] == "submit":
+                self._n_inflight -= 1
             return entry
 
     def _mark_dead(self, why: str) -> None:
@@ -267,8 +335,11 @@ class ProcessReplica:
             self._dead = why
             pending = list(self._pending.values())
             self._pending.clear()
+            self._n_inflight = 0
+        self._stop_channel()
         try:
-            self._sock.close()
+            if getattr(self, "_sock", None) is not None:
+                self._sock.close()
         except OSError:
             pass
         try:
@@ -280,7 +351,7 @@ class ProcessReplica:
         # submit on another replica
         exc = ReplicaDeadError(why)
         for e in pending:
-            if not e["accept"].done():
+            if e["accept"] is not None and not e["accept"].done():
                 e["accept"].set_exception(exc)
             if not e["future"].done():
                 e["future"].set_exception(exc)
@@ -288,7 +359,9 @@ class ProcessReplica:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg = pickle.loads(recv_frame(self._sock))
+                raw = recv_frame(self._sock)
+                self._inst["bytes_in"].inc(len(raw))
+                msg = pickle.loads(raw)
                 op = msg.get("op")
                 with self._idlock:
                     entry = self._pending.get(msg.get("id"))
@@ -297,12 +370,10 @@ class ProcessReplica:
                 if op == "accept":
                     entry["accept"].set_result(None)
                 elif op == "reject":
-                    with self._idlock:
-                        self._pending.pop(entry["id"], None)
+                    self._pop(entry["id"])
                     entry["accept"].set_exception(self._reject_exc(msg))
                 elif op == "result":
-                    with self._idlock:
-                        self._pending.pop(entry["id"], None)
+                    self._pop(entry["id"])
                     if not entry["accept"].done():
                         entry["accept"].set_result(None)
                     if msg.get("ok"):
@@ -342,6 +413,68 @@ class ProcessReplica:
                 pass
         return RuntimeError(msg.get("error", "replica-side failure"))
 
+    # -- shm data-plane delivery (the channel's reader thread) --------------
+
+    def _deliver_ack(self, req_id: int, status: int,
+                     evidence: Optional[dict]) -> None:
+        """A replica-side REJECT (or a parent-side transport failure):
+        under the optimistic-accept protocol these are the rare path —
+        the error lands on the request's FUTURE (the accept resolved at
+        submit time), which is where the fleet's done-callback picks
+        request-shaped failures up."""
+        from fm_returnprediction_tpu.serving import shm as _shm
+
+        entry = self._pop(req_id)
+        if entry is None:
+            return
+        ev = evidence or {}
+        if status == _shm.STATUS_QUEUE_FULL:
+            # requeueable on the future path (fleet._REQUEUEABLE): the
+            # synchronous submit already admitted optimistically, so a
+            # child-side backpressure disagreement reroutes like the
+            # socket mode's sync QueueFullError would have
+            exc: BaseException = QueueFullError(
+                ev.get("message", "replica queue full"),
+                queue_depth=ev.get("queue_depth"),
+                max_queue=ev.get("max_queue"),
+            )
+        elif status == _shm.STATUS_CLOSED:
+            # a closed child batcher means the replica is going away —
+            # ReplicaDeadError is the fleet's requeue-and-replace signal
+            # (the socket mode's sync RuntimeError path reroutes too)
+            exc = ReplicaDeadError(
+                ev.get("message", "replica batcher is closed"))
+        elif "overload" in ev:
+            exc = ev["overload"]  # parent-side ring-full: typed 429
+        else:
+            exc = self._unpickle_exc(ev)
+        accept = entry["accept"]
+        if accept is not None and not accept.done():
+            accept.set_exception(exc)
+        elif not entry["future"].done():
+            entry["future"].set_exception(exc)
+
+    def _deliver_results(self, rows) -> None:
+        """One RESULT frame's rows → resolved futures (entries popped
+        under a single lock acquisition; future resolution outside it —
+        done-callbacks re-enter the fleet)."""
+        with self._idlock:
+            entries = []
+            for rid, ok, value in rows:
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
+                    if entry["kind"] == "submit":
+                        self._n_inflight -= 1
+                    entries.append((entry, ok, value))
+        for entry, ok, value in entries:
+            accept = entry["accept"]
+            if accept is not None and not accept.done():
+                accept.set_result(None)
+            if ok:
+                entry["future"].set_result(value)
+            else:
+                entry["future"].set_exception(self._unpickle_exc(value))
+
     # -- the ERService mirror ----------------------------------------------
 
     def submit(self, month, x) -> Future:
@@ -353,18 +486,42 @@ class ProcessReplica:
         ``RuntimeError`` (the fleet's replica_closed requeue signal)."""
         if self._dead is not None:
             raise RuntimeError(f"replica process is dead: {self._dead}")
+        if self._channel is not None:
+            # shm data plane, optimistic accept: the parent enforces the
+            # SAME max_queue ceiling the child batcher would (sync
+            # QueueFullError → the fleet tries another replica), then
+            # the row joins the pending strip and the caller gets its
+            # future without a boundary round trip. A child-side
+            # disagreement (racing swap, malformed row) comes back as an
+            # ACK-reject and lands on the future — request-shaped, the
+            # fleet's done-callback semantics.
+            if self._n_inflight >= self.batcher.max_queue:
+                raise QueueFullError(
+                    f"replica {self.replica_id} transport window full "
+                    f"({self._n_inflight}/{self.batcher.max_queue})",
+                    queue_depth=self._n_inflight,
+                    max_queue=self.batcher.max_queue,
+                )
+            entry = self._register("submit", accept=False)
+            try:
+                self._channel.submit_row(entry["id"], month, x)
+            except BaseException as exc:
+                self._pop(entry["id"])
+                if isinstance(exc, RuntimeError):
+                    raise
+                raise RuntimeError(
+                    f"replica process is dead: {exc}") from exc
+            return entry["future"]
         entry = self._register("submit")
         try:
             self._send({"op": "submit", "id": entry["id"],
                         "month": month, "x": x})
             entry["accept"].result(timeout=self._call_timeout_s)
         except ReplicaDeadError as exc:
-            with self._idlock:
-                self._pending.pop(entry["id"], None)
+            self._pop(entry["id"])
             raise RuntimeError(f"replica process is dead: {exc}") from exc
         except BaseException:
-            with self._idlock:
-                self._pending.pop(entry["id"], None)
+            self._pop(entry["id"])
             raise
         return entry["future"]
 
@@ -380,13 +537,13 @@ class ProcessReplica:
                 else self._call_timeout_s
             )
         finally:
-            with self._idlock:
-                self._pending.pop(entry["id"], None)
+            self._pop(entry["id"])
 
     def stats(self) -> dict:
         out = dict(self._call("stats"))
         out["proc_pid"] = self.pid
         out["proc_inflight"] = self.inflight
+        out["transport"] = self.transport
         return out
 
     def prepare_state(self, new_state):

@@ -48,6 +48,15 @@ def _serve(cfg: dict) -> None:
         kwargs = dict(cfg.get("service_kwargs") or {})
         kwargs["metric_labels"] = {"replica": rid}
         kwargs["replica_id"] = rid
+        if cfg.get("shm"):
+            # the shm data plane already coalesced rows into strips —
+            # the batcher's max_latency window would tax every query a
+            # second batching wait for batches the transport has formed.
+            # Flush immediately: batches = the strips (plus whatever
+            # queued during the previous dispatch), latency drops by the
+            # window. FMRP_FLEET_SHM_CHILD_LATENCY_MS restores a window.
+            kwargs["max_latency_ms"] = float(os.environ.get(
+                "FMRP_FLEET_SHM_CHILD_LATENCY_MS", "0") or 0)
         reg_dir = cfg.get("registry_dir")
         warm = None
         if reg_dir:
@@ -67,6 +76,29 @@ def _serve(cfg: dict) -> None:
         raise
     send({"op": "hello", "ok": True, "rid": rid, "pid": os.getpid(),
           "warm": warm})
+
+    # shm data plane (FMRP_FLEET_TRANSPORT=shm): submits/results ride
+    # the rings the parent created; this socket keeps the control verbs
+    shm_stop = None
+    shm_rings = []
+    if cfg.get("shm"):
+        from fm_returnprediction_tpu.parallel.shm import attach_ring
+        from fm_returnprediction_tpu.serving.shm import serve_data_plane
+
+        req_ring = attach_ring(cfg["shm"]["req"],
+                               doorbell_fd=cfg["shm"].get("req_bell"))
+        resp_ring = attach_ring(cfg["shm"]["resp"],
+                                doorbell_fd=cfg["shm"].get("resp_bell"))
+        shm_rings = [req_ring, resp_ring]
+        shm_stop = threading.Event()
+        send_timeout_s = float(os.environ.get(
+            "FMRP_FLEET_SHM_SEND_TIMEOUT_S", "5.0"
+        ))
+        threading.Thread(
+            target=serve_data_plane,
+            args=(service, req_ring, resp_ring, shm_stop, send_timeout_s),
+            name=f"fmrp-shm-serve-{rid}", daemon=True,
+        ).start()
 
     prepared = {}  # one slot: the fleet serializes rollovers
 
@@ -151,6 +183,10 @@ def _serve(cfg: dict) -> None:
                 blob = None
             send({"op": "result", "id": req_id, "ok": False,
                   "exc": blob, "error": repr(exc)[:300]})
+    if shm_stop is not None:
+        shm_stop.set()
+        for ring in shm_rings:
+            ring.close()
     try:
         sock.close()
     except OSError:

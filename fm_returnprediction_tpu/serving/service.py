@@ -173,6 +173,30 @@ class ERService:
         backpressure."""
         return self.batcher.submit(self.state.month_index(month), x)
 
+    def submit_many(self, reqs) -> list:
+        """Bulk :meth:`submit`: ``reqs`` = [(month, x), ...] → one
+        ``("ok", Future)`` / ``("err", exception)`` per request (unknown
+        months land as ``("err", KeyError)``), the queue enqueue paid
+        under one batcher lock (``MicroBatcher.submit_many``). The
+        process replica's shm serve loop rides this to absorb whole
+        request strips without per-row lock traffic."""
+        resolved = []
+        errs: dict = {}
+        for i, (month, x) in enumerate(reqs):
+            try:
+                resolved.append((self.state.month_index(month), x))
+            except Exception as exc:  # noqa: BLE001 — per-row semantics
+                errs[i] = exc
+        batched = self.batcher.submit_many(resolved)
+        out: list = []
+        it = iter(batched)
+        for i in range(len(reqs)):
+            if i in errs:
+                out.append(("err", errs[i]))
+            else:
+                out.append(next(it))
+        return out
+
     def query(self, month, x, timeout: Optional[float] = 30.0) -> float:
         """Blocking single query → E[r] (NaN when unavailable: incomplete
         predictors or a month with no lagged coefficient mean)."""

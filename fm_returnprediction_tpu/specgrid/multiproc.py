@@ -67,13 +67,66 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = [
+    "GRID_TRANSPORTS",
     "SpecGridWorkerPool",
     "multiproc_grid_parts",
+    "resolve_grid_transport",
     "resolve_specgrid_procs",
     "worker_main",
 ]
 
 _PROGRAM = "specgrid_mp_contract"
+
+GRID_TRANSPORTS = ("shm", "frames")
+
+
+def resolve_grid_transport(transport: Optional[str] = None) -> str:
+    """The pool's data plane: explicit argument > ``FMRP_GRID_TRANSPORT``
+    > ``auto`` (= shm where POSIX shared memory works, else the pickled
+    exchange frames — retained as the differential oracle and the
+    non-shm-capable fallback). ``shm`` maps the panel from published
+    segments and returns Gram stats as raw buffers the parent sums in
+    place, so the exchange carries control frames only."""
+    if transport is None:
+        transport = os.environ.get(
+            "FMRP_GRID_TRANSPORT", ""
+        ).strip().lower() or "auto"
+    if transport in GRID_TRANSPORTS:
+        return transport
+    if transport != "auto":
+        raise ValueError(
+            f"grid transport must be one of {('auto',) + GRID_TRANSPORTS},"
+            f" got {transport!r}"
+        )
+    from fm_returnprediction_tpu.parallel.shm import shm_available
+
+    return "shm" if shm_available() else "frames"
+
+
+def _stats_leaf_shapes(s_specs: int, t: int, q: int):
+    """The five additive ``SpecGramStats`` leaves a worker returns, in
+    wire order: gram, moment, n, Σy, Σy² (one definition shared by the
+    segment sizing, the worker's write, and the parent's read)."""
+    return (
+        (s_specs, t, q, q),
+        (s_specs, t, q),
+        (s_specs, t),
+        (s_specs, t),
+        (s_specs, t),
+    )
+
+
+def _stats_leaf_views(flat, shapes):
+    """Slice a flat segment view into the per-leaf views — the ONE home
+    for the wire layout, used by the worker's write and the parent's
+    read (a one-sided change here cannot desynchronize them)."""
+    views = []
+    off = 0
+    for s in shapes:
+        size = int(np.prod(s))
+        views.append(flat[off:off + size].reshape(s))
+        off += size
+    return views
 
 
 def resolve_specgrid_procs(procs: Optional[int] = None) -> int:
@@ -130,30 +183,58 @@ class _WorkerState:
         meta = json.loads((paneldir / "meta.json").read_text())
         self.t = int(meta["t"])
         self.p = int(meta["p"])
+        self.transport = meta.get("transport", "frames")
         n_pad = int(meta["n_pad"])
         n_local = n_pad // procs
         k = rank - 1  # contraction ranks are 1..procs
         sl = slice(k * n_local, (k + 1) * n_local)
-        # mmap then materialize the contiguous shard once — the worker
-        # owns 1/procs of the panel, never the whole tensor
-        self.y = np.ascontiguousarray(
-            np.load(paneldir / "y.npy", mmap_mode="r")[:, sl]
-        )
-        self.x = np.ascontiguousarray(
-            np.load(paneldir / "x.npy", mmap_mode="r")[:, sl]
-        )
-        self.universes = np.ascontiguousarray(
-            np.load(paneldir / "universes.npy", mmap_mode="r")[:, :, sl]
-        )
-        rw_path = paneldir / "row_weights.npy"
-        self.row_weights = (
-            np.ascontiguousarray(
-                np.load(rw_path, mmap_mode="r")[:, sl]
-            ) if rw_path.exists() else None
-        )
+        if self.transport == "shm":
+            # MAPPED panel: the published segments are the panel — the
+            # worker views them in place and materializes only its own
+            # contiguous firm shard (1/procs of the tensor), no panel
+            # bytes on disk and none in exchange frames
+            from fm_returnprediction_tpu.parallel.shm import (
+                ShmArraySpec,
+                attach_array,
+            )
+
+            def shard(key, slicer):
+                spec_meta = meta["panel"].get(key)
+                if spec_meta is None:
+                    return None
+                seg, view = attach_array(ShmArraySpec.from_meta(spec_meta))
+                out = np.ascontiguousarray(view[slicer])
+                del view
+                seg.close()
+                return out
+
+            self.y = shard("y", np.s_[:, sl])
+            self.x = shard("x", np.s_[:, sl, :])
+            self.universes = shard("universes", np.s_[:, :, sl])
+            self.row_weights = shard("row_weights", np.s_[:, sl])
+        else:
+            # mmap then materialize the contiguous shard once — the
+            # worker owns 1/procs of the panel, never the whole tensor
+            self.y = np.ascontiguousarray(
+                np.load(paneldir / "y.npy", mmap_mode="r")[:, sl]
+            )
+            self.x = np.ascontiguousarray(
+                np.load(paneldir / "x.npy", mmap_mode="r")[:, sl]
+            )
+            self.universes = np.ascontiguousarray(
+                np.load(paneldir / "universes.npy", mmap_mode="r")[:, :, sl]
+            )
+            rw_path = paneldir / "row_weights.npy"
+            self.row_weights = (
+                np.ascontiguousarray(
+                    np.load(rw_path, mmap_mode="r")[:, sl]
+                ) if rw_path.exists() else None
+            )
         self.n_local = n_local
         self.dtype = self.x.dtype
         self._exes: Dict[str, object] = {}
+        self._stats_segs: Dict[str, tuple] = {}  # name → (seg, views)
+        self._center: Optional[np.ndarray] = None
         # per-shard center partials are job-independent: compute once
         fin = np.isfinite(self.x)
         self.center_sum = np.where(fin, self.x, 0.0).sum(axis=1).astype(
@@ -202,6 +283,33 @@ class _WorkerState:
 
         return jax.device_get(stats)
 
+    def write_stats(self, name: str, shapes, stats) -> int:
+        """Write the five additive leaves into this worker's mapped
+        response segment (one memcpy per leaf — the parent sums them in
+        place; no stats bytes ever enter an exchange frame). Segment
+        attachments are cached by name: the parent reuses one segment
+        per (worker, S-signature) across grid calls."""
+        from fm_returnprediction_tpu.parallel.shm import (
+            ShmArraySpec,
+            attach_array,
+        )
+
+        cached = self._stats_segs.get(name)
+        if cached is None:
+            n_items = sum(int(np.prod(s)) for s in shapes)
+            seg, flat = attach_array(ShmArraySpec(
+                name, (n_items,), str(self.dtype)
+            ))
+            cached = (seg, _stats_leaf_views(flat, shapes))
+            self._stats_segs[name] = cached
+        _, views = cached
+        total = 0
+        for view, leaf in zip(views, stats[:5]):
+            arr = np.asarray(leaf, dtype=self.dtype)
+            view[...] = arr
+            total += arr.nbytes
+        return total
+
     def provenance_report(self, rank: int) -> dict:
         """This worker's compile-vs-fetch evidence for the contraction
         program (the "only one process compiles fresh" claim, per
@@ -234,19 +342,45 @@ def worker_main(paneldir: str) -> None:
     assert ex is not None and rank >= 1, "worker ranks start at 1"
     state = _WorkerState(Path(paneldir), rank, world - 1)
 
+    from fm_returnprediction_tpu.parallel.shm import transport_instruments
+
+    inst = transport_instruments(
+        f"grid_{state.transport}", f"rank{rank}"
+    )
+
     def handle(job: dict) -> None:
-        s, c = ex.sum_tree((state.center_sum, state.center_count))
-        center = (s / np.maximum(c, 1)).astype(state.dtype)
+        # the global center is PANEL state, not job state: one sum_tree
+        # round when the parent asks (the pool's first grid), cached
+        # after — both transports, same rank-ordered fold, identical
+        # values. STRICTLY follow the job flag: a one-sided round would
+        # deadlock the seq protocol, never "helpfully" recompute.
+        if job.get("center_round"):
+            s, c = ex.sum_tree((state.center_sum, state.center_count))
+            state._center = (s / np.maximum(c, 1)).astype(state.dtype)
+        center = state._center
         if job.get("stagger") and rank != 1:
             # worker 1 compiles + stores first; everyone else fetches
             ex.barrier("mp_warm")
         stats = state.contract(job, center)
         if job.get("stagger") and rank == 1:
             ex.barrier("mp_warm")
-        # GATHER, not allgather: only rank 0 solves, so only rank 0 pays
-        # the stats fan-in bandwidth (the broker acks everyone else)
-        ex.gather_obj(tuple(np.asarray(leaf) for leaf in stats[:5]),
-                      root=0)
+        stats_shm = job.get("stats_shm")
+        if stats_shm is not None:
+            # mapped return: leaves land in this worker's shm segment
+            # (the parent sums raw buffers); the exchange carries a
+            # 2-byte completion ack instead of megabytes of pickle
+            wrote = state.write_stats(
+                stats_shm["names"][rank - 1],
+                [tuple(s) for s in stats_shm["shapes"]], stats,
+            )
+            inst["bytes_out"].inc(wrote)
+            inst["frames"].inc()
+            ex.gather_obj("ok", root=0)
+        else:
+            # GATHER, not allgather: only rank 0 solves, so only rank 0
+            # pays the stats fan-in bandwidth (the broker acks the rest)
+            ex.gather_obj(tuple(np.asarray(leaf) for leaf in stats[:5]),
+                          root=0)
         if job.get("report"):
             ex.allgather_obj(state.provenance_report(rank))
 
@@ -270,7 +404,8 @@ class SpecGridWorkerPool:
 
     def __init__(self, procs: int, y, x, universes, row_weights=None,
                  child_env: Optional[dict] = None,
-                 cpus_per_worker: Optional[int] = None):
+                 cpus_per_worker: Optional[int] = None,
+                 transport: Optional[str] = None):
         from fm_returnprediction_tpu.parallel.distributed import (
             DistConfig,
             HostExchange,
@@ -318,16 +453,35 @@ class SpecGridWorkerPool:
                      np.zeros((t, pad), np.asarray(row_weights).dtype)],
                     axis=1,
                 )
+        self.transport = resolve_grid_transport(transport)
         self.paneldir = Path(tempfile.mkdtemp(prefix="fmrp_mpgrid_"))
-        np.save(self.paneldir / "y.npy", y)
-        np.save(self.paneldir / "x.npy", x)
-        np.save(self.paneldir / "universes.npy", universes)
-        if row_weights is not None:
-            np.save(self.paneldir / "row_weights.npy",
-                    np.asarray(row_weights))
-        (self.paneldir / "meta.json").write_text(json.dumps({
-            "t": t, "p": p, "n_pad": int(y.shape[1]), "procs": self.procs,
-        }))
+        self._panel_segs: List = []   # published panel segments (owner)
+        self._stats_segs: Dict = {}   # S-signature → per-worker segments
+        self._center: Optional[np.ndarray] = None
+        meta = {"t": t, "p": p, "n_pad": int(y.shape[1]),
+                "procs": self.procs, "transport": self.transport}
+        if self.transport == "shm":
+            # publish the panel ONCE into named segments; workers map
+            # them directly — zero panel bytes on disk, zero in frames
+            from fm_returnprediction_tpu.parallel.shm import publish_array
+
+            panel_meta = {}
+            for key, arr in (("y", y), ("x", x), ("universes", universes),
+                             ("row_weights", row_weights)):
+                if arr is None:
+                    continue
+                seg, spec = publish_array(np.asarray(arr))
+                self._panel_segs.append(seg)
+                panel_meta[key] = spec.to_meta()
+            meta["panel"] = panel_meta
+        else:
+            np.save(self.paneldir / "y.npy", y)
+            np.save(self.paneldir / "x.npy", x)
+            np.save(self.paneldir / "universes.npy", universes)
+            if row_weights is not None:
+                np.save(self.paneldir / "row_weights.npy",
+                        np.asarray(row_weights))
+        (self.paneldir / "meta.json").write_text(json.dumps(meta))
 
         import jax
 
@@ -386,6 +540,13 @@ class SpecGridWorkerPool:
         self.last_reports: List[dict] = []
         self.last_merge_s = 0.0
         self.last_merge_bytes = 0
+        self.last_shm_bytes = 0
+        from fm_returnprediction_tpu.parallel.shm import (
+            transport_instruments,
+        )
+
+        self._inst = transport_instruments(f"grid_{self.transport}",
+                                           "pool")
         # parent-side zero partials (exact identities under the merge)
         self._zero_center = (
             np.zeros((t, p), self.dtype), np.zeros((t, p), np.int64)
@@ -413,35 +574,65 @@ class SpecGridWorkerPool:
                        and sig not in self._warmed_signatures)
             self._warmed_signatures.add(sig)
             ex = self.exchange
+            center_round = self._center is None
+            shapes = _stats_leaf_shapes(s_specs, self.t, q)
+            stats_shm = None
+            if self.transport == "shm":
+                stats_shm = {
+                    "names": [seg.name for seg, _ in
+                              self._stats_segments(s_specs, shapes)],
+                    "shapes": [list(s) for s in shapes],
+                }
             job = {
                 "op": "contract", "uidx": uidx, "col_sel": col_sel,
                 "window": window, "firm_chunk": firm_chunk,
                 "stagger": stagger, "report": report,
+                "center_round": center_round, "stats_shm": stats_shm,
             }
             t0 = time.perf_counter()
             bytes0 = self._transport_bytes()
             ex.broadcast_obj(job, root=0)
-            s, c = ex.sum_tree(self._zero_center)
-            center = (s / np.maximum(c, 1)).astype(self.dtype)
+            if center_round:
+                # the center is panel state: ONE exchange round per pool
+                # (cached both sides), not one per grid — the additivity
+                # precondition's cost leaves the per-grid critical path
+                s, c = ex.sum_tree(self._zero_center)
+                self._center = (s / np.maximum(c, 1)).astype(self.dtype)
+            center = self._center
             if stagger:
                 ex.barrier("mp_warm")
-            # gather the per-shard stats to THIS rank only and fold in
-            # rank order (deterministic; the parent contributes nothing —
-            # an exact identity under the sum)
-            parts = [p for p in ex.gather_obj(None, root=0)
-                     if p is not None]
             zero = lambda *shape: np.zeros(shape, self.dtype)  # noqa: E731
             gram, moment, n_acc, ysum, yy = (
                 zero(s_specs, self.t, q, q), zero(s_specs, self.t, q),
                 zero(s_specs, self.t), zero(s_specs, self.t),
                 zero(s_specs, self.t),
             )
-            for part in parts:
-                gram = np.add(gram, part[0])
-                moment = np.add(moment, part[1])
-                n_acc = np.add(n_acc, part[2])
-                ysum = np.add(ysum, part[3])
-                yy = np.add(yy, part[4])
+            if stats_shm is not None:
+                # completion acks only; the stats live in the mapped
+                # segments, summed here IN RANK ORDER (the same fold the
+                # frames route computes, so the routes agree bit-for-bit)
+                ex.gather_obj(None, root=0)
+                shm_bytes = 0
+                for seg, views in self._stats_segments(s_specs, shapes):
+                    for total, view in zip(
+                            (gram, moment, n_acc, ysum, yy), views):
+                        np.add(total, view, out=total)
+                        shm_bytes += view.nbytes
+                self.last_shm_bytes = shm_bytes
+                self._inst["bytes_in"].inc(shm_bytes)
+            else:
+                # gather the per-shard stats to THIS rank only and fold
+                # in rank order (deterministic; the parent contributes
+                # nothing — an exact identity under the sum)
+                parts = [p for p in ex.gather_obj(None, root=0)
+                         if p is not None]
+                for part in parts:
+                    np.add(gram, part[0], out=gram)
+                    np.add(moment, part[1], out=moment)
+                    np.add(n_acc, part[2], out=n_acc)
+                    np.add(ysum, part[3], out=ysum)
+                    np.add(yy, part[4], out=yy)
+                self.last_shm_bytes = 0
             if report:
                 self.last_reports = [
                     r for r in ex.allgather_obj(None) if r is not None
@@ -449,6 +640,25 @@ class SpecGridWorkerPool:
             self.last_merge_s = time.perf_counter() - t0
             self.last_merge_bytes = self._transport_bytes() - bytes0
         return SpecGramStats(gram, moment, n_acc, ysum, yy, center)
+
+    def _stats_segments(self, s_specs: int, shapes):
+        """Per-worker mapped response segments for this S-signature,
+        created once and reused across grid calls (the tile engine's
+        repeated same-shape contracts). Returns [(segment, leaf views),
+        ...] in WORKER RANK ORDER — the fold order of the merge."""
+        from fm_returnprediction_tpu.parallel.shm import publish_array
+
+        cached = self._stats_segs.get(s_specs)
+        if cached is not None:
+            return cached
+        n_items = sum(int(np.prod(s)) for s in shapes)
+        entries = []
+        for _ in range(self.procs):
+            seg, _spec = publish_array(np.zeros(n_items, self.dtype))
+            flat = np.ndarray((n_items,), dtype=self.dtype, buffer=seg.buf)
+            entries.append((seg, _stats_leaf_views(flat, shapes)))
+        self._stats_segs[s_specs] = entries
+        return entries
 
     def _transport_bytes(self) -> int:
         return (self.exchange._m_bytes_out.value
@@ -469,7 +679,28 @@ class SpecGridWorkerPool:
                 w.communicate(timeout=10)
             except subprocess.TimeoutExpired:
                 w.kill()
+        # release the mapped planes AFTER the workers exited (their
+        # views die with them; the pool owns every name)
+        for entries in self._stats_segs.values():
+            for seg, views in entries:
+                del views
+                self._release_segment(seg)
+        self._stats_segs.clear()
+        for seg in self._panel_segs:
+            self._release_segment(seg)
+        self._panel_segs.clear()
         shutil.rmtree(self.paneldir, ignore_errors=True)
+
+    @staticmethod
+    def _release_segment(seg) -> None:
+        try:
+            seg.close()
+        except (OSError, BufferError):
+            pass
+        try:
+            seg.unlink()
+        except OSError:
+            pass
 
     def __enter__(self) -> "SpecGridWorkerPool":
         return self
@@ -491,7 +722,8 @@ _POOL_CACHE: Optional[tuple] = None
 def _get_pool(procs: int, y, x, universe_arrays, row_weights
               ) -> SpecGridWorkerPool:
     global _POOL_CACHE
-    key = (procs, id(y), id(x), tuple(id(u) for u in universe_arrays),
+    key = (procs, resolve_grid_transport(),
+           id(y), id(x), tuple(id(u) for u in universe_arrays),
            id(row_weights) if row_weights is not None else None)
     cached = _POOL_CACHE
     if cached is not None and cached[0] == key:

@@ -463,13 +463,18 @@ def jax_cache_stats(cache_dir=None) -> dict:
 
             cache_dir = os.path.join(str(config("BASE_DIR")), "_cache", "jax")
     try:
-        names = os.listdir(cache_dir)
-        total = sum(
-            os.path.getsize(os.path.join(cache_dir, f))
-            for f in names
-            if os.path.isfile(os.path.join(cache_dir, f))
-        )
-        return {"entries": len(names), "bytes": total}
+        # filter to files ONCE and use that list for BOTH the count and
+        # the byte sum — counting directories (or a transient non-file)
+        # in `entries` but not `bytes` made "entries grew, bytes didn't"
+        # read as zero-size cache entries and muddied the cross-process
+        # compile-reuse evidence
+        files = [
+            p for p in (os.path.join(cache_dir, f)
+                        for f in os.listdir(cache_dir))
+            if os.path.isfile(p)
+        ]
+        return {"entries": len(files),
+                "bytes": sum(os.path.getsize(p) for p in files)}
     except OSError:
         return {"entries": 0, "bytes": 0}
 

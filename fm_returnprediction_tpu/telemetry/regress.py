@@ -148,6 +148,7 @@ def direction(key: str) -> Optional[str]:
         or "speedup" in leaf
         or leaf.endswith("_per_s")  # rows_per_s, cells_per_s, ... throughput
         or leaf.endswith("_utilization")  # roofline gauges (kernels ladder)
+        or leaf.endswith("_over_thread")  # fleet process/thread ratio
         or leaf == "vs_baseline"
     ):
         return "higher"

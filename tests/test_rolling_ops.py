@@ -172,3 +172,30 @@ def test_rolling_prod_nan_propagates_like_numpy_prod():
         pd.Series(x).rolling(3, min_periods=2).apply(np.prod, raw=True).to_numpy()
     )
     np.testing.assert_allclose(got, want)
+
+
+def test_rolling_route_honors_committed_placement(monkeypatch):
+    """A CPU-committed array must route XLA even when the process's
+    DEFAULT backend is TPU (simulated): the committed placement is read
+    through the PUBLIC ``sharding.device_set`` API — a silent-None
+    fallback (what the old private ``_device_assignment`` read would
+    degrade to on a jax rename) would dispatch the TPU-only pallas
+    kernel on a host-placed array."""
+    import jax
+
+    from fm_returnprediction_tpu.ops import rolling
+
+    monkeypatch.delenv("FMRP_ROLLING_ROUTE", raising=False)
+    monkeypatch.delenv("FMRP_PALLAS", raising=False)
+
+    class _FakeTpu:
+        platform = "tpu"
+
+    x = jnp.ones((4, 4), jnp.float32)  # committed to this process's CPU
+    assert rolling.resolve_rolling_route(x) == "xla"
+    monkeypatch.setattr(jax, "devices", lambda *a: [_FakeTpu()])
+    # default backend claims TPU, but the ARRAY is CPU-committed: the
+    # placement must win (route stays xla)
+    assert rolling.resolve_rolling_route(x) == "xla"
+    # no committed placement (bare numpy): the default backend decides
+    assert rolling.resolve_rolling_route(np.ones((4, 4))) == "pallas"

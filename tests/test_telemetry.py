@@ -624,3 +624,20 @@ def test_jsonl_meta_and_chrome_trace_carry_identity(tmp_path, monkeypatch):
             assert name_on == "fmrp-host[p5]"
         finally:
             identity.set_process_index(None)
+
+
+def test_jax_cache_stats_counts_files_only(tmp_path):
+    """``entries`` and ``bytes`` must read the SAME isfile-filtered
+    list: a subdirectory (or transient non-file) counted in entries but
+    not bytes made entry-growth-with-zero-byte-growth look like the
+    compile cache gaining empty entries."""
+    from fm_returnprediction_tpu.telemetry import jax_cache_stats
+
+    (tmp_path / "a.bin").write_bytes(b"x" * 10)
+    (tmp_path / "b.bin").write_bytes(b"y" * 5)
+    (tmp_path / "subdir").mkdir()
+    got = jax_cache_stats(str(tmp_path))
+    assert got == {"entries": 2, "bytes": 15}
+    assert jax_cache_stats(str(tmp_path / "missing")) == {
+        "entries": 0, "bytes": 0,
+    }

@@ -1,0 +1,523 @@
+"""Zero-copy shared-memory data plane (ISSUE 15).
+
+Four layers of evidence, cheapest first:
+
+- the RING itself: seq/commit protocol (order, wraparound), torn frames
+  reading as ABSENT, ring-full backpressure as a typed stall→error;
+- the FRAME grammar: submit/ack/result round-trips, including the cold
+  paths (non-int months, pickled rows, exception tails) and the
+  ``DegradedQuote`` disclosure columns;
+- the FLEET data plane: shm-vs-socket-vs-thread bit-identical quotes
+  (fleet-of-1 and fleet-of-N), ring-full surfacing as the retriable
+  ``ServiceOverloadError``, and the journal replaying CLEAN through a
+  mid-load ``hard_crash`` on the shm path;
+- the MULTIPROC GRID plane: mapped-segment stats return bit-identical
+  to the pickled-frames oracle, with the exchange byte bill collapsed.
+"""
+
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu.parallel.shm import (
+    HEADER_BYTES,
+    RingFullError,
+    ShmRing,
+    attach_ring,
+    shm_available,
+    transport_instruments,
+)
+from fm_returnprediction_tpu.serving import shm as fleet_shm
+
+pytestmark = [
+    pytest.mark.transport,
+    pytest.mark.skipif(not shm_available(),
+                       reason="POSIX shared memory unavailable here"),
+]
+
+
+# -- the ring ---------------------------------------------------------------
+
+
+def test_ring_roundtrip_order_and_wraparound():
+    ring = ShmRing(create=True, slots=8, slot_bytes=256)
+    try:
+        reader = attach_ring(ring.name)
+        payloads = [f"frame-{i}".encode() * (i % 3 + 1) for i in range(13)]
+        # more frames than slots: the ring must wrap and stay ordered
+        got = []
+        for i, p in enumerate(payloads):
+            ring.send(p, timeout_s=1.0)
+            if i % 2:  # drain irregularly to exercise partial occupancy
+                got.append(reader.recv(timeout_s=1.0))
+        while len(got) < len(payloads):
+            got.append(reader.recv(timeout_s=1.0))
+        assert got == payloads
+        reader.close()
+    finally:
+        ring.close()
+
+
+def test_torn_frame_reads_as_absent_until_committed():
+    """A writer that dies mid-frame leaves the commit word stale — the
+    reader must see NOTHING (not a garbage frame), which is what lets
+    journal recovery treat in-flight requests as cleanly absent."""
+    ring = ShmRing(create=True, slots=4, slot_bytes=256)
+    try:
+        reader = attach_ring(ring.name)
+        # white-box torn write: payload + length land, commit does NOT
+        # (the exact state a crash between those stores leaves behind)
+        payload = b"half-written"
+        off = HEADER_BYTES  # slot 0 = seq 1
+        ring._buf[off + 16:off + 16 + len(payload)] = payload
+        struct.pack_into("<I", ring._buf, off + 8, len(payload))
+        assert reader.recv(timeout_s=0.05) is None  # absent, not torn
+        # the commit store is what makes the frame exist
+        struct.pack_into("<Q", ring._buf, off, 1)
+        assert reader.recv(timeout_s=1.0) == payload
+        reader.close()
+    finally:
+        ring.close()
+
+
+def test_ring_full_stalls_then_raises_typed():
+    inst = transport_instruments("shm", "ringtest")
+    stalls0 = inst["stalls"].value
+    ring = ShmRing(create=True, slots=2, slot_bytes=128,
+                   instruments=inst)
+    try:
+        ring.send(b"a", timeout_s=0.2)
+        ring.send(b"b", timeout_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(RingFullError):
+            ring.send(b"c", timeout_s=0.08)  # no reader: must stall+raise
+        assert time.monotonic() - t0 >= 0.07
+        assert inst["stalls"].value == stalls0 + 1
+    finally:
+        ring.close()
+
+
+def test_oversized_frame_rejected():
+    ring = ShmRing(create=True, slots=2, slot_bytes=64)
+    try:
+        with pytest.raises(ValueError):
+            ring.send(b"x" * 256, timeout_s=0.1)
+    finally:
+        ring.close()
+
+
+# -- the frame grammar ------------------------------------------------------
+
+
+def test_submit_frame_roundtrip_hot_and_cold_paths():
+    rows = [
+        (1, 7, np.arange(4, dtype=np.float32)),            # hot f32
+        (2, 9, np.arange(3, dtype=np.float64) * 1.5),      # f64 column
+        (3, "2001-01", np.ones(2, dtype=np.float32)),      # month tail
+        (4, 11, [1.0, 2.0]),                               # pickled row
+    ]
+    kind, back = fleet_shm.unpack_frame(fleet_shm.pack_submit(rows))
+    assert kind == fleet_shm.KIND_SUBMIT
+    for (rid, month, x), (rid2, month2, x2) in zip(rows, back):
+        assert rid2 == rid and month2 == month
+        if isinstance(x, np.ndarray):
+            assert x2.dtype == x.dtype
+            assert np.array_equal(x2, x)
+        else:
+            assert x2 == x
+
+
+def test_submit_frame_single_row_fast_path_matches_layout():
+    row = np.arange(5, dtype=np.float32)
+    frame = fleet_shm.pack_submit([(42, 13, row)])
+    kind, back = fleet_shm.unpack_frame(frame)
+    assert kind == fleet_shm.KIND_SUBMIT
+    (rid, month, x), = back
+    assert (rid, month) == (42, 13)
+    assert x.dtype == np.float32 and np.array_equal(x, row)
+
+
+def test_ack_and_result_frame_roundtrip_with_degraded_columns():
+    from fm_returnprediction_tpu.serving.brownout import DegradedQuote
+
+    ack = fleet_shm.pack_ack(
+        [5, 6], [fleet_shm.STATUS_QUEUE_FULL, fleet_shm.STATUS_ERROR],
+        {0: {"message": "full", "queue_depth": 3, "max_queue": 4},
+         1: {"exc": None, "error": "KeyError(99)"}},
+    )
+    kind, rows = fleet_shm.unpack_frame(ack)
+    assert kind == fleet_shm.KIND_ACK
+    assert rows[0][:2] == (5, fleet_shm.STATUS_QUEUE_FULL)
+    assert rows[0][2]["queue_depth"] == 3
+    assert rows[1][2]["error"] == "KeyError(99)"
+
+    dq = DegradedQuote(0.25, route="coreset", precision="f32",
+                       m=8, err_bound=0.125)
+    res = fleet_shm.pack_results([
+        (7, True, 0.5),
+        (8, True, dq),
+        (9, False, KeyError(123)),
+    ])
+    kind, rows = fleet_shm.unpack_frame(res)
+    assert kind == fleet_shm.KIND_RESULT
+    assert rows[0] == (7, True, 0.5)
+    rid, ok, val = rows[1]
+    assert ok and float(val) == 0.25
+    # the disclosure the socket mode's float() coercion used to strip
+    assert isinstance(val, DegradedQuote)
+    assert (val.route, val.precision, val.m, val.err_bound) == (
+        "coreset", "f32", 8, 0.125
+    )
+    rid, ok, payload = rows[2]
+    assert not ok and "KeyError" in payload["error"]
+
+
+def test_result_frame_all_ok_fast_path():
+    res = fleet_shm.pack_results([(i, True, float(i) / 7) for i in range(9)])
+    kind, rows = fleet_shm.unpack_frame(res)
+    assert rows == [(i, True, float(i) / 7) for i in range(9)]
+
+
+# -- channel backpressure ---------------------------------------------------
+
+
+def test_channel_ring_full_surfaces_typed_retriable_overload():
+    from fm_returnprediction_tpu.resilience.errors import (
+        ServiceOverloadError,
+    )
+
+    acks = []
+    inst = transport_instruments("shm", "chantest")
+    stalls0 = inst["stalls"].value
+    chan = fleet_shm.ShmReplicaChannel(
+        on_ack=lambda rid, st, ev: acks.append((rid, st, ev)),
+        on_results=lambda rows: None,
+        on_dead=lambda why: None,
+        replica_id="chantest", slots=2, slot_bytes=2048,
+        send_timeout_s=0.05, instruments=inst,
+    )
+    try:
+        row = np.ones(4, dtype=np.float32)
+        # no consumer on the request ring: the first sends fill it, the
+        # next stalls past its deadline and every row of that strip must
+        # come back as the fleet's typed retriable 429
+        for i in range(3):
+            chan.submit_row(i, 0, row)
+        assert len(acks) >= 1
+        rid, st, ev = acks[-1]
+        exc = ev["overload"]
+        assert isinstance(exc, ServiceOverloadError)
+        assert exc.reason == "transport_ring_full"
+        assert exc.retry_after_s > 0
+        assert inst["stalls"].value > stalls0
+    finally:
+        chan.stop()
+
+
+# -- in-process data-plane serve loop ---------------------------------------
+
+
+def _tiny_state(t=36, n=80, p=4, seed=3):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=t // 2,
+                                min_periods=t // 4)
+    months = np.nonzero(state.have_coef())[0]
+    return state, months, rng
+
+
+def test_serve_data_plane_in_process_and_torn_strip_ignored():
+    from fm_returnprediction_tpu.serving.service import ERService
+
+    state, months, rng = _tiny_state()
+    service = ERService(state, max_batch=8, max_latency_ms=0.5)
+    req = ShmRing(create=True, slots=8, slot_bytes=4096)
+    resp = ShmRing(create=True, slots=8, slot_bytes=4096)
+    stop = threading.Event()
+    th = threading.Thread(
+        target=fleet_shm.serve_data_plane,
+        args=(service, attach_ring(req.name), attach_ring(resp.name), stop),
+        daemon=True,
+    )
+    th.start()
+    try:
+        month = int(months[0])
+        row = rng.standard_normal(4).astype(np.float32)
+        want = service.query(month, row)
+        req.send(fleet_shm.pack_submit([(1, month, row)]), timeout_s=1.0)
+        frame = resp.recv(timeout_s=5.0)
+        assert frame is not None
+        kind, rows = fleet_shm.unpack_frame(frame)
+        assert kind == fleet_shm.KIND_RESULT
+        assert rows[0][0] == 1 and rows[0][1] is True
+        assert rows[0][2] == want  # same service, same bits
+        # a POISON row (ragged list — np.asarray raises) must fail
+        # ALONE: its strip-mate still gets its quote, the ragged row an
+        # ACK-reject, and the serve thread survives (an unguarded
+        # asarray would kill it and blackhole the replica)
+        req.send(fleet_shm.pack_submit([
+            (2, month, [[1.0, 2.0], [3.0]]),
+            (3, month, row),
+        ]), timeout_s=1.0)
+        got = {}
+        while len(got) < 2:
+            frame = resp.recv(timeout_s=5.0)
+            assert frame is not None
+            kind, frame_rows = fleet_shm.unpack_frame(frame)
+            if kind == fleet_shm.KIND_RESULT:
+                for rid, ok, val in frame_rows:
+                    got[rid] = (kind, ok, val)
+            else:
+                for rid, status, ev in frame_rows:
+                    got[rid] = (kind, status, ev)
+        assert got[3] == (fleet_shm.KIND_RESULT, True, want)
+        kind2, status2, ev2 = got[2]
+        assert kind2 == fleet_shm.KIND_ACK
+        assert status2 == fleet_shm.STATUS_ERROR
+        assert "array-like" in ev2["error"] or "1-D" in ev2["error"]
+        # a torn strip (commit word never written) must be ABSENT: no
+        # response, no crash, the loop stays alive for the stop event
+        payload = fleet_shm.pack_submit([(4, month, row)])
+        seq = req._wseq + 1
+        off = req._slot_off(seq)
+        req._buf[off + 16:off + 16 + len(payload)] = payload
+        struct.pack_into("<I", req._buf, off + 8, len(payload))
+        assert resp.recv(timeout_s=0.3) is None
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        assert not th.is_alive()
+        service.close()
+        req.close()
+        resp.close()
+
+
+# -- batch submit (the serve loop's absorption path) -------------------------
+
+
+def test_batcher_submit_many_matches_submit_semantics():
+    from fm_returnprediction_tpu.serving.batcher import (
+        MicroBatcher,
+        QueueFullError,
+    )
+
+    done = []
+    b = MicroBatcher(lambda m, x, v: np.asarray(m, np.float64),
+                     max_batch=4, max_latency_ms=50.0, max_queue=3,
+                     auto_flush=False, n_predictors=3)
+    rows = [
+        (0, np.ones(3, np.float32)),
+        (1, np.ones(2, np.float32)),   # wrong width: fails alone
+        (2, np.ones(3, np.float32)),
+        (3, np.ones(3, np.float32)),
+        (4, np.ones(3, np.float32)),   # queue (3) full by now
+    ]
+    out = b.submit_many(rows)
+    kinds = [k for k, _ in out]
+    assert kinds == ["ok", "err", "ok", "ok", "err"]
+    assert isinstance(out[1][1], ValueError)
+    assert isinstance(out[4][1], QueueFullError)
+    assert out[4][1].max_queue == 3
+    b.drain()
+    assert [out[i][1].result(timeout=5) for i in (0, 2, 3)] == [0, 2, 3]
+    b.close()
+    assert done == []
+
+
+def test_service_submit_many_unknown_month_fails_alone():
+    from fm_returnprediction_tpu.serving.service import ERService
+
+    state, months, rng = _tiny_state()
+    service = ERService(state, max_batch=8, auto_flush=False)
+    try:
+        row = rng.standard_normal(4).astype(np.float32)
+        out = service.submit_many([
+            (int(months[0]), row),
+            (10 ** 9, row),             # unknown month → KeyError slot
+            (int(months[-1]), row),
+        ])
+        assert [k for k, _ in out] == ["ok", "err", "ok"]
+        assert isinstance(out[1][1], KeyError)
+        service.batcher.drain()
+        assert np.isfinite(out[0][1].result(timeout=5))
+        assert np.isfinite(out[2][1].result(timeout=5))
+    finally:
+        service.close()
+
+
+# -- the process fleet over both transports ---------------------------------
+
+
+def _fleet_quotes(fleet, months, rows):
+    return np.asarray([
+        fleet.query(int(m), r) for m, r in zip(months, rows)
+    ])
+
+
+@pytest.mark.fleet
+def test_fleet_quotes_bit_identical_thread_socket_shm(tmp_path):
+    """THE transport differential: the same queries through thread
+    replicas, a socket process fleet (fleet-of-1), and shm process
+    fleets of 1 and 2 — every float bit-identical, every journal
+    replaying clean."""
+    from fm_returnprediction_tpu.serving import ServingFleet, replay_journal
+
+    state, months, rng = _tiny_state(t=48, n=120, p=4)
+    k = 24
+    qm = months[rng.integers(0, len(months), k)]
+    qx = rng.standard_normal((k, 4)).astype(np.float32)
+
+    fleets = (
+        ("thread", dict(replica_mode="thread")),
+        ("socket1", dict(replica_mode="process", transport="socket")),
+        ("shm1", dict(replica_mode="process", transport="shm")),
+        ("shm2", dict(replica_mode="process", transport="shm")),
+    )
+    vals = {}
+    for name, kw in fleets:
+        n_rep = 2 if name.endswith("2") else 1
+        journal = tmp_path / f"{name}.jsonl"
+        fleet = ServingFleet(state, n_rep, max_batch=16,
+                             max_latency_ms=1.0, journal=journal, **kw)
+        try:
+            if kw["replica_mode"] == "process":
+                st = fleet.stats()
+                assert st["transport"] in (kw.get("transport"),)
+            vals[name] = _fleet_quotes(fleet, qm, qx)
+        finally:
+            fleet.close()
+        assert replay_journal(journal).clean, name
+    base = vals["thread"]
+    assert np.isfinite(base).all()
+    for name in ("socket1", "shm1", "shm2"):
+        assert np.array_equal(base, vals[name]), name
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_shm_fleet_hard_crash_journal_replays_clean(tmp_path):
+    """The acceptance composition: requests in flight on the shm rings,
+    the router hard-crashes (journal abandoned, children killed, any
+    mid-send frame left torn-by-construction), and recovery closes the
+    session out to a CLEAN replay — zero dropped, zero duplicated."""
+    from fm_returnprediction_tpu.serving import ServingFleet, replay_journal
+
+    state, months, rng = _tiny_state(t=48, n=120, p=4)
+    journal = tmp_path / "crash.jsonl"
+    fleet = ServingFleet(state, 2, replica_mode="process", transport="shm",
+                         max_batch=16, max_latency_ms=5.0, journal=journal)
+    qx = rng.standard_normal((40, 4)).astype(np.float32)
+    # warm, then pile submits on the rings and crash with them in flight
+    fleet.query(int(months[0]), qx[0])
+    futs = [fleet.submit(int(months[i % len(months)]), qx[i])
+            for i in range(40)]
+    fleet.hard_crash()
+    del futs
+    dirty = replay_journal(journal)
+    assert not dirty.clean  # admitted-no-terminal requests dangle
+    recovered, report = ServingFleet.recover(
+        journal, state=state, replica_mode="thread",
+        max_batch=16, auto_flush=False,
+    )
+    try:
+        assert report.journal.replay_clean
+        assert len(report.journal.recovered) > 0  # real in-flight closed out
+        final = replay_journal(journal)
+        assert final.clean
+        assert report.rotated_to is not None
+        rotated = replay_journal(report.rotated_to)
+        assert rotated.clean
+        assert len(rotated.dropped) == 0 and len(rotated.duplicated) == 0
+        # and the recovered fleet quotes
+        f = recovered.submit(int(months[0]), qx[0])
+        recovered.flush_all()
+        assert np.isfinite(f.result(timeout=5))
+    finally:
+        recovered.close()
+
+
+# -- the multiproc grid over both transports --------------------------------
+
+
+@pytest.mark.multiprocess
+def test_multiproc_grid_shm_vs_frames_bit_identical():
+    """Leg (b): mapped-segment stats return must equal the pickled
+    frames oracle (same rank-ordered fold → bit-identical, stronger
+    than the PR-14 parity tolerances it is allowed), with the exchange
+    byte bill collapsed to control frames."""
+    from fm_returnprediction_tpu.specgrid.multiproc import (
+        SpecGridWorkerPool,
+    )
+
+    rng = np.random.default_rng(7)
+    t, n, p = 24, 64, 6
+    y = np.where(rng.random((t, n)) > 0.2,
+                 rng.standard_normal((t, n)), np.nan).astype(np.float32)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    x[rng.random((t, n, p)) < 0.05] = np.nan
+    universes = rng.random((2, t, n)) > 0.3
+    uidx = np.array([0, 1, 0])
+    col_sel = np.zeros((3, p), bool)
+    col_sel[0, :3] = True
+    col_sel[1, :5] = True
+    col_sel[2, :] = True
+    window = np.ones((3, t), bool)
+
+    stats, merge_bytes = {}, {}
+    for transport in ("frames", "shm"):
+        pool = SpecGridWorkerPool(2, y, x, universes, transport=transport)
+        try:
+            s1 = pool.contract(uidx, col_sel, window)
+            s2 = pool.contract(uidx, col_sel, window)  # warm: cached
+            #                               center + reused segments
+            for a, b in zip(s1[:5], s2[:5]):
+                assert np.array_equal(np.asarray(a), np.asarray(b),
+                                      equal_nan=True)
+            stats[transport] = s1
+            merge_bytes[transport] = pool.last_merge_bytes
+            if transport == "shm":
+                assert pool.last_shm_bytes > 0
+        finally:
+            pool.close()
+    for a, b in zip(stats["frames"][:6], stats["shm"][:6]):
+        assert np.array_equal(np.asarray(a), np.asarray(b),
+                              equal_nan=True)
+    # the whole point: stats leave the exchange (≥5× here; ≥10× at
+    # bench shape where the gram payload dominates the fixed overhead)
+    assert merge_bytes["shm"] * 5 <= merge_bytes["frames"]
+
+
+# -- knob resolution --------------------------------------------------------
+
+
+def test_transport_resolution_knobs(monkeypatch):
+    from fm_returnprediction_tpu.specgrid.multiproc import (
+        resolve_grid_transport,
+    )
+
+    monkeypatch.delenv("FMRP_FLEET_TRANSPORT", raising=False)
+    monkeypatch.delenv("FMRP_GRID_TRANSPORT", raising=False)
+    assert fleet_shm.resolve_fleet_transport() == "shm"  # auto, shm works
+    assert fleet_shm.resolve_fleet_transport("socket") == "socket"
+    assert resolve_grid_transport() == "shm"
+    assert resolve_grid_transport("frames") == "frames"
+    monkeypatch.setenv("FMRP_FLEET_TRANSPORT", "socket")
+    monkeypatch.setenv("FMRP_GRID_TRANSPORT", "frames")
+    assert fleet_shm.resolve_fleet_transport() == "socket"
+    assert resolve_grid_transport() == "frames"
+    assert fleet_shm.resolve_fleet_transport("shm") == "shm"  # arg wins
+    assert resolve_grid_transport("shm") == "shm"
+    with pytest.raises(ValueError):
+        fleet_shm.resolve_fleet_transport("carrier-pigeon")
+    with pytest.raises(ValueError):
+        resolve_grid_transport("carrier-pigeon")
