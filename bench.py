@@ -70,6 +70,7 @@ import json
 import os
 import threading
 import time
+from contextlib import nullcontext
 
 import numpy as np
 
@@ -1930,6 +1931,104 @@ def _bench_grid_factorized(fast: bool):
     return out
 
 
+def _bench_estimators(fast: bool):
+    """Estimator subsystem ladder (the ISSUE-16 acceptance evidence):
+    the SAME cell space runs warm under each estimator kind — OLS (the
+    incumbent tile route), FWL partialling-out (Schur complement on the
+    per-month Grams), absorbed FE (alternating projections on per-month
+    cell stats), and IV/2SLS (two Gram solves) — so the per-kind
+    ``estimators_*_cells_per_s`` series price exactly the transform each
+    kind adds on top of the shared contraction. The FWL warm repeat runs
+    under ``recompile_watch``: every estimator rides the one jitted
+    estimator program, so a warm re-sweep must compile nothing.
+
+    The bank leg times ``grambank.estimator_query`` answering an FWL
+    cell from banked stats; the contraction ledger staying flat across
+    the query pins the zero-panel-reads acceptance criterion. Series are
+    shape-qualified via ``estimators_shape`` (device-dependent walls).
+    FMRP_BENCH_ESTIMATORS=0 skips."""
+    if os.environ.get("FMRP_BENCH_ESTIMATORS", "1") == "0":
+        return {}
+    from fm_returnprediction_tpu.specgrid import CellSpace, run_cellspace
+    from fm_returnprediction_tpu.specgrid.estimators import (
+        EST_OLS,
+        Estimator,
+    )
+    from fm_returnprediction_tpu.specgrid.grambank import (
+        build_bank,
+        estimator_query,
+    )
+    from fm_returnprediction_tpu.specgrid.solve import contraction_counts
+    from fm_returnprediction_tpu.telemetry import recompile_watch
+
+    t = int(os.environ.get("FMRP_BENCH_ESTIMATORS_MONTHS", 48))
+    n = int(os.environ.get("FMRP_BENCH_ESTIMATORS_FIRMS",
+                           300 if fast else 4000))
+    p = 6
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "All-but-tiny", "Large"), subsets))
+    names = [f"x{i:02d}" for i in range(p)]
+    rng = np.random.default_rng(2016)
+    fe_codes = {"ind": rng.integers(0, 12, size=(t, n))}
+    # focal sets over the first 5 columns; the 6th is the estimator's
+    # auxiliary column (FWL control / excluded instrument), appended to
+    # the union by the estimator dimension itself
+    sets = tuple(
+        (f"s{k}", tuple(names[:2 + k])) for k in range(2 if fast else 4)
+    )
+    windows = (("full", None), ("late", (t // 2, t)))
+    ladder = {
+        "ols": EST_OLS,
+        "fwl": Estimator(kind="fwl", controls=(names[-1],)),
+        "absorb": Estimator(kind="absorb", absorb=("ind",)),
+        "iv": Estimator(kind="iv", endog=(names[1],),
+                        instruments=(names[-1],)),
+    }
+    out = {"estimators_shape": f"T{t}_N{n}_P{p}_S{len(sets)}"}
+    warm = {}
+    for label, est in ladder.items():
+        space = CellSpace(regressor_sets=sets, universes=tuple(masks),
+                          windows=windows, estimators=(est,))
+        # the union is the focal sets plus the estimator's aux columns —
+        # slice the panel tensor into space.union_predictors order
+        xs = x[:, :, [names.index(c) for c in space.union_predictors]]
+        kw = dict(fe_codes=fe_codes) if label == "absorb" else {}
+        run_cellspace(y, xs, masks, space, **kw)  # compile
+        ctx = (recompile_watch("estimators_fwl_warm", warm=True)
+               if label == "fwl" else nullcontext())
+        with ctx as delta, _timed(f"bench.estimators_{label}_warm") as w:
+            run_cellspace(y, xs, masks, space, **kw)
+        warm[label] = w.s
+        out[f"estimators_{label}_warm_s"] = round(w.s, 4)
+        out[f"estimators_{label}_cells_per_s"] = round(len(space) / w.s, 1)
+        if label == "fwl":
+            out["estimators_fwl_warm_cache_growth"] = (
+                delta.entries_after - delta.entries_before)
+    for label in ("fwl", "absorb", "iv"):
+        # the transform tax relative to the shared-contraction OLS floor
+        out[f"estimators_{label}_vs_ols"] = round(
+            warm[label] / warm["ols"], 2)
+
+    # bank leg: one contraction, then FWL cells answered from the bank
+    bank_space = CellSpace(regressor_sets=(("full", tuple(names)),),
+                           universes=tuple(masks), windows=(("full", None),))
+    with _timed("bench.estimators_bank_build") as build_t:
+        bank = build_bank(y, x, masks, bank_space)
+    estimator_query(bank, f"fwl:{names[-1]}")  # compile the query program
+    reps = 5
+    before = contraction_counts()
+    with _timed("bench.estimators_bank_query") as q:
+        for _ in range(reps):
+            estimator_query(bank, f"fwl:{names[-1]}")
+    out["estimators_bank_build_s"] = round(build_t.s, 4)
+    out["estimators_bank_query_ms"] = round(q.s / reps * 1e3, 2)
+    out["estimators_bank_query_panel_contractions"] = sum(
+        contraction_counts().get(k, 0) - before.get(k, 0)
+        for k in ("specs_contracted", "pairs_contracted")
+    )
+    return out
+
+
 def _bench_serving(fast: bool):
     """Warm microbatched serving path on a synthetic state (the online
     E[r] query service, ``fm_returnprediction_tpu/serving``): build a
@@ -3240,6 +3339,7 @@ def main() -> None:
     sections.append(_bench_specgrid)  # _SPECGRID=0 handled in-section
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_grid_factorized)  # _GRID_FACTORIZED=0 in-section
+    sections.append(_bench_estimators)  # _ESTIMATORS=0 handled in-section
     sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
     sections.append(_bench_transport)  # _TRANSPORT=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section

@@ -146,6 +146,36 @@ def task_grid_parity():
     }
 
 
+def task_estimator_parity():
+    """The estimator subsystem's differential suite as one named exit-1
+    gate (``tests/test_estimators.py``): FWL-via-Schur vs the explicit-
+    controls solve (exact), absorbed-FE alternating projections vs the
+    dummy-variable within oracle, IV/2SLS vs the closed-form two-stage
+    host solve, every pooled sandwich-SE family vs the numpy oracle,
+    clustered FM means, streaming-bootstrap draw-0 ≡ point + exact
+    Chan merge, the estimator CellSpace dimension's OLS-cell parity,
+    and the bank-served ``estimator_query`` zero-contraction pin — the
+    pre-merge gate for anything touching ``specgrid/estimators/`` or
+    the bank/solve tails it rides. Sits alongside ``grid_parity``
+    (Gram routes) and ``transport_parity``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m estimators -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "estimators marker differential suite (FWL/FE/IV vs host "
+               "oracles, sandwich SEs, streaming bootstrap, banked "
+               "estimator queries) — exit-1 on any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }
+
+
 if __name__ == "__main__":
     try:
         from doit.doit_cmd import DoitMain

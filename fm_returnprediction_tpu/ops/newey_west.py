@@ -21,7 +21,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["nw_mean_se", "nw_mean_se_np", "compact_front"]
+__all__ = ["nw_mean_se", "nw_mean_se_np", "compact_front",
+           "clustered_mean_se", "clustered_mean_se_np"]
 
 
 def compact_front(x: jnp.ndarray, valid: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -70,6 +71,49 @@ def nw_mean_se(
 
     var_mean = (gamma0 + 2.0 * acc) / jnp.maximum(nf, 1.0) ** 2
     return jnp.where(n >= 2, jnp.sqrt(var_mean), jnp.nan)
+
+
+def clustered_mean_se(
+    x: jnp.ndarray,
+    valid: jnp.ndarray,
+    cluster_ids: jnp.ndarray,
+) -> jnp.ndarray:
+    """Cluster-robust standard error for the mean of the valid entries of
+    ``x`` — the FM estimator family's ``se="cluster"`` kernel
+    (``specgrid.estimators``): instead of the NW kernel's lag-windowed
+    autocovariances, ALL within-cluster covariance counts, with zero
+    leakage across clusters:
+
+        var(mean) = (Σ_g S_g²) / n²,   S_g = Σ_{i∈g} (x_i − x̄)
+
+    over the valid entries (``cluster_ids`` are CALENDAR groupings — e.g.
+    ``month // 12`` for by-year blocks — so clusters follow the calendar,
+    not the compacted survivor order the NW kernel uses). Like the NW
+    kernel: fewer than 2 valid entries → NaN. Unlike HAC, the clustered
+    variance is a sum of squares and can never go negative."""
+    valid = valid.astype(bool)
+    nf = valid.sum().astype(x.dtype)
+    mean = jnp.where(nf > 0, jnp.where(valid, x, 0.0).sum()
+                     / jnp.maximum(nf, 1.0), 0.0)
+    u = jnp.where(valid, x - mean, 0.0)
+    n_seg = x.shape[0]  # ≤ one cluster per entry; ids are in [0, T)
+    s_g = jnp.zeros(n_seg, x.dtype).at[cluster_ids].add(u)
+    var_mean = (s_g * s_g).sum() / jnp.maximum(nf, 1.0) ** 2
+    return jnp.where(nf >= 2, jnp.sqrt(var_mean), jnp.nan)
+
+
+def clustered_mean_se_np(vals: np.ndarray, clusters: np.ndarray) -> float:
+    """Numpy mirror of :func:`clustered_mean_se` on an already-compacted
+    valid series with its cluster labels — the host oracle
+    (``tests/test_estimators.py``)."""
+    vals = np.asarray(vals, float)
+    clusters = np.asarray(clusters)
+    n = vals.size
+    if n < 2:
+        return float("nan")
+    u = vals - vals.mean()
+    s_g = np.array([u[clusters == g].sum() for g in np.unique(clusters)])
+    return float(np.sqrt((s_g ** 2).sum() / n ** 2))
 
 
 def nw_mean_se_np(vals: np.ndarray, lags: int = 4,

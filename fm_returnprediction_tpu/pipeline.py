@@ -389,6 +389,7 @@ def run_pipeline(
     make_specgrid: bool = False,
     specgrid_cells: Optional[int] = None,
     specgrid_sink: Optional[str] = None,
+    specgrid_estimator: Optional[str] = None,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
     checkpoint_dir=None,
@@ -408,6 +409,9 @@ def run_pipeline(
     bootstrap-draw dimension grows; cells stream tile by tile so memory
     stays one-tile-bounded) and ``specgrid_sink`` picks the streaming
     aggregation (``frame``/``topk``/``summary``/``parquet``).
+    ``specgrid_estimator`` swaps the per-cell estimator (grammar
+    ``"fwl:c1+c2[@se]"``/``"absorb:..."``/``"iv:..."``/``"pooled[:se]"``;
+    ``None`` follows ``FMRP_SPECGRID_ESTIMATOR``, default OLS@NW).
 
     ``checkpoint_dir`` arms per-stage checkpoint-resume
     (``resilience.StageCheckpointer``): each reporting stage (Table 1,
@@ -486,6 +490,7 @@ def run_pipeline(
             make_specgrid=make_specgrid,
             specgrid_cells=specgrid_cells,
             specgrid_sink=specgrid_sink,
+            specgrid_estimator=specgrid_estimator,
             bootstrap_replicates=bootstrap_replicates,
             use_mesh=use_mesh,
             checkpoint_dir=checkpoint_dir,
@@ -508,6 +513,7 @@ def _run_pipeline_guarded(
     make_specgrid,
     specgrid_cells,
     specgrid_sink,
+    specgrid_estimator,
     bootstrap_replicates,
     use_mesh,
     checkpoint_dir,
@@ -757,6 +763,9 @@ def _run_pipeline_guarded(
     specgrid_scenarios = None
     if make_specgrid:
         from fm_returnprediction_tpu.specgrid import run_scenarios
+        from fm_returnprediction_tpu.specgrid.estimators import (
+            resolve_estimator,
+        )
         from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
 
         with timer.stage("specgrid"):
@@ -764,12 +773,20 @@ def _run_pipeline_guarded(
             # tile engine: lazy cell enumeration, one fused program per
             # tile batch, streamed through the configured sink
             # (``--specgrid-cells`` scales the bootstrap-draw dimension;
-            # ``--specgrid-sink``/FMRP_SPECGRID_SINK picks the sink)
+            # ``--specgrid-sink``/FMRP_SPECGRID_SINK picks the sink;
+            # ``--specgrid-estimator``/FMRP_SPECGRID_ESTIMATOR swaps the
+            # per-cell estimator — resolved loudly here so a typo'd
+            # grammar fails before the sweep runs)
+            _est = resolve_estimator(specgrid_estimator)
+            _estimators = (
+                None if _est.kind == "ols" and _est.se == "nw" else (_est,)
+            )
             specgrid_scenarios = _frame_stage(
                 "specgrid_scenarios",
                 lambda: run_scenarios(
                     panel, subset_masks, factors_dict,
                     cells=specgrid_cells, sink=specgrid_sink,
+                    estimators=_estimators,
                     output_dir=output_dir,
                 ),
             )
@@ -995,6 +1012,14 @@ def _main() -> None:
              "parquet part spill (default follows FMRP_SPECGRID_SINK)",
     )
     parser.add_argument(
+        "--specgrid-estimator", default=None, metavar="SPEC",
+        help="run the spec-grid sweep under an estimator cell instead of "
+             "OLS@NW — grammar 'fwl:c1+c2[@se]' | 'absorb:fe1+fe2' | "
+             "'iv:endog~z1+z2' | 'pooled[:se]' (default follows "
+             "FMRP_SPECGRID_ESTIMATOR; Table-2/figure parity surfaces "
+             "keep rejecting non-OLS loudly)",
+    )
+    parser.add_argument(
         "--no-guard", action="store_true",
         help="disable the data-integrity guardrails (stage-boundary "
              "contracts + in-program numerical sentinels; default follows "
@@ -1072,9 +1097,11 @@ def _main() -> None:
         synthetic_config=cfg if args.synthetic else None,
         make_bootstrap=args.bootstrap > 0,
         make_specgrid=(args.specgrid or args.specgrid_cells is not None
-                       or args.specgrid_sink is not None),
+                       or args.specgrid_sink is not None
+                       or args.specgrid_estimator is not None),
         specgrid_cells=args.specgrid_cells,
         specgrid_sink=args.specgrid_sink,
+        specgrid_estimator=args.specgrid_estimator,
         bootstrap_replicates=args.bootstrap or 10_000,
         checkpoint_dir=args.checkpoint_dir,
         guard=False if args.no_guard else None,

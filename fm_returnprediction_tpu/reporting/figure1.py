@@ -201,6 +201,14 @@ def subset_sweep(
         return {}
     from fm_returnprediction_tpu.specgrid.specs import resolve_route
 
+    # the figure sweep is a paper-parity surface: a leaked
+    # FMRP_SPECGRID_ESTIMATOR must reject loudly (table2's discipline),
+    # never silently swap the estimand under the decile sort
+    from fm_returnprediction_tpu.specgrid.estimators import (
+        resolve_estimator,
+    )
+
+    resolve_estimator(None, allowed=("ols",))
     if resolve_route(route, allowed=("gram", "stacked")) == "gram":
         return _subset_sweep_gram(
             panel, subset_masks, names, return_col, window, min_periods,

@@ -191,6 +191,14 @@ def build_table_2(
     # FMRP_SPECGRID_ROUTE=coreset must reject loudly on this parity
     # surface even when the mesh path (which ignores the route) is taken
     resolved_route = _resolve_route(route, allowed=("gram", "stacked"))
+    # the same discipline for a leaked FMRP_SPECGRID_ESTIMATOR: Table 2
+    # is the paper's OLS parity surface — a partialled/absorbed/IV cell
+    # here would be a silently different estimand
+    from fm_returnprediction_tpu.specgrid.estimators import (
+        resolve_estimator as _resolve_estimator,
+    )
+
+    _resolve_estimator(None, allowed=("ols",))
     if mesh is None and resolved_route == "gram":
         from fm_returnprediction_tpu.specgrid import run_spec_grid, table2_grid
 

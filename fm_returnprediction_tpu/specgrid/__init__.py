@@ -28,6 +28,10 @@ as a per-cell conditioning referee.
 - ``scenarios`` — robustness grids (subperiods, size universes, winsor
   levels, NW weights, bootstrap draws) → one tidy DataFrame via the
   tile engine.
+- ``estimators`` — the estimator subsystem (ISSUE 16): FWL
+  partialling-out, absorbed FE, IV/2SLS, clustered/pooled-sandwich SE
+  families and the streaming block bootstrap, each a transform of the
+  banked Gram stats and a first-class ``CellSpace`` dimension.
 """
 
 from fm_returnprediction_tpu.specgrid.cellspace import (
@@ -92,7 +96,16 @@ _SHARDED_NAMES = ("resolve_specgrid_mesh", "sharded_grid_parts",
 # plane, which a plain Table-2 import never touches
 _GRAMBANK_NAMES = ("GramBank", "build_bank", "save_bank", "load_bank",
                    "ingest_month", "window_query", "bootstrap_query",
-                   "scenario_query", "bank_key")
+                   "scenario_query", "estimator_query", "bank_key")
+
+# the estimator subsystem loads lazily too: its transforms (and their
+# jitted programs) only exist for sweeps that actually carry non-OLS
+# estimator cells
+_ESTIMATOR_NAMES = ("Estimator", "EST_OLS", "parse_estimator",
+                    "resolve_estimator", "run_estimator_grid_weights",
+                    "StreamingBootstrap", "ESTIMATOR_KINDS",
+                    "FM_SE_FAMILIES", "POOLED_SE_FAMILIES",
+                    "BANK_POOLED_SE")
 
 
 def __getattr__(name):
@@ -104,18 +117,29 @@ def __getattr__(name):
         from fm_returnprediction_tpu.specgrid import grambank
 
         return getattr(grambank, name)
+    if name in _ESTIMATOR_NAMES:
+        from fm_returnprediction_tpu.specgrid import estimators
+
+        return getattr(estimators, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
 
 
 __all__ = [
+    "BANK_POOLED_SE",
     "Cell",
     "CellSpace",
     "CellTile",
     "CoresetPlan",
+    "EST_OLS",
+    "ESTIMATOR_KINDS",
+    "Estimator",
+    "FM_SE_FAMILIES",
     "FrameSink",
     "GramBank",
+    "POOLED_SE_FAMILIES",
+    "StreamingBootstrap",
     "ParquetSink",
     "Sink",
     "Spec",
@@ -132,15 +156,19 @@ __all__ = [
     "build_bank",
     "contract_spec_grams",
     "coreset_plan",
+    "estimator_query",
     "figure1_grid",
     "ingest_month",
     "load_bank",
+    "parse_estimator",
     "product_grid",
     "program_trace_counts",
+    "resolve_estimator",
     "resolve_route",
     "resolve_sink",
     "resolve_specgrid_mesh",
     "run_cellspace",
+    "run_estimator_grid_weights",
     "run_scenarios",
     "run_scenarios_banked",
     "run_spec_grid",

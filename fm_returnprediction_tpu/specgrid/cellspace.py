@@ -24,6 +24,10 @@ The dimension ORDER is chosen for the execution grouping, not aesthetics:
 - ``weight`` next — every scheme re-aggregates inside ONE fused program
   (PR 3's ``run_spec_grid_weights``), so the engine always passes the
   space's full weight tuple as the program's static and slices per cell;
+- ``estimator`` next (ISSUE 16) — each estimator kind compiles its own
+  fused program (``estimators.grid``), so grouping cells by estimator
+  keeps one program live per run, and the incumbent OLS cells keep
+  riding the incumbent (refereed) grid path untouched;
 - the (set, universe, window) spec product in the middle — contiguous cell
   ranges decode to contiguous spec runs, which is what lets a tile chunk
   into fixed-width padded ``SpecGrid`` batches and reuse one compiled
@@ -38,6 +42,10 @@ import dataclasses
 import os
 from typing import Dict, Iterator, NamedTuple, Optional, Sequence, Tuple
 
+from fm_returnprediction_tpu.specgrid.estimators.core import (
+    EST_OLS,
+    Estimator,
+)
 from fm_returnprediction_tpu.specgrid.specs import Spec
 
 __all__ = ["Cell", "CellSpace", "CellTile", "resolve_tile_cells",
@@ -72,6 +80,7 @@ class Cell(NamedTuple):
     window_name: str
     window: Optional[Tuple[int, int]]
     draw: int
+    estimator: Estimator = EST_OLS
 
     def spec(self, tag: str = "") -> Spec:
         """The cell's ``Spec`` (draw/winsor/weight are solve-level
@@ -95,6 +104,7 @@ class CellSpace:
     windows: Tuple[Tuple[str, Optional[Tuple[int, int]]], ...]
     winsor_levels: Tuple[float, ...] = (1.0,)
     weights: Tuple[str, ...] = ("reference",)
+    estimators: Tuple[Estimator, ...] = (EST_OLS,)
     bootstrap: int = 1
     nw_lags: int = 4
     min_months: int = 10
@@ -102,11 +112,17 @@ class CellSpace:
 
     def __post_init__(self):
         if not (self.regressor_sets and self.universes and self.windows
-                and self.winsor_levels and self.weights):
+                and self.winsor_levels and self.weights and self.estimators):
             raise ValueError("every CellSpace dimension needs >= 1 value")
         if self.bootstrap < 1:
             raise ValueError("bootstrap counts the draws incl. the point "
                              "estimate; must be >= 1")
+        bad = [e for e in self.estimators if not isinstance(e, Estimator)]
+        if bad:
+            raise TypeError(
+                f"estimators must be Estimator instances, got {bad} — "
+                "parse spec strings with estimators.parse_estimator first"
+            )
 
     # dimension sizes, outermost → innermost (the mixed-radix digits)
     @property
@@ -114,6 +130,7 @@ class CellSpace:
         return (
             ("winsor", len(self.winsor_levels)),
             ("weight", len(self.weights)),
+            ("estimator", len(self.estimators)),
             ("set", len(self.regressor_sets)),
             ("universe", len(self.universes)),
             ("window", len(self.windows)),
@@ -143,10 +160,17 @@ class CellSpace:
     @property
     def union_predictors(self) -> Tuple[str, ...]:
         """Union of every set's columns, first-seen order — the column
-        order of the union tensor every tile contracts."""
+        order of the union tensor every tile contracts. Estimator aux
+        columns (FWL controls, IV endogenous/instrument columns) ride the
+        SAME union tensor (appended after the set columns), so estimator
+        cells transform the one contraction every other cell shares."""
         union = []
         for _, cols in self.regressor_sets:
             for c in cols:
+                if c not in union:
+                    union.append(c)
+        for e in self.estimators:
+            for c in (*e.controls, *e.endog, *e.instruments):
                 if c not in union:
                     union.append(c)
         return tuple(union)
@@ -171,7 +195,17 @@ class CellSpace:
             window_name=win_name,
             window=win,
             draw=digits["draw"],
+            estimator=self.estimators[digits["estimator"]],
         )
+
+    def estimator_index(self, index: int) -> int:
+        """The cell's position in the estimator dimension — cells
+        differing only in (set, universe, window, draw) share it (and
+        share one compiled estimator program inside a tile)."""
+        inner = (len(self.regressor_sets) * len(self.universes)
+                 * len(self.windows) * self.bootstrap)
+        _, e = divmod(index // inner, len(self.estimators))
+        return e
 
     def spec_index(self, index: int) -> int:
         """The cell's position in the (set, universe, window) spec product
@@ -225,6 +259,7 @@ def scenario_space(
     subperiods: int = 2,
     winsor_levels: Sequence[float] = (1.0,),
     weights: Sequence[str] = ("reference",),
+    estimators: Sequence[Estimator] = (EST_OLS,),
     bootstrap: int = 1,
     nw_lags: int = 4,
     min_months: int = 10,
@@ -248,6 +283,7 @@ def scenario_space(
         windows=windows,
         winsor_levels=tuple(float(v) for v in winsor_levels),
         weights=tuple(weights),
+        estimators=tuple(estimators),
         bootstrap=int(bootstrap),
         nw_lags=nw_lags,
         min_months=min_months,

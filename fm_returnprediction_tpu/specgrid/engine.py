@@ -93,38 +93,55 @@ def _winsor_groups(tile: CellTile) -> Iterator[Tuple[float, List[Cell]]]:
 
 
 class _TileSolver:
-    """Solves one winsor-group's distinct specs in fixed ``spec_pad``-wide
-    batches and serves per-cell views; one instance per group, dropped
-    when the group's rows have been emitted."""
+    """Solves one winsor-group's distinct (estimator, spec) cells in fixed
+    ``spec_pad``-wide batches and serves per-cell views; one instance per
+    group, dropped when the group's rows have been emitted. Cells are
+    deduped on (estimator_index, spec_index) — the estimator dimension
+    sits OUTSIDE the spec product, so each batch solves under exactly one
+    estimator and one compiled program."""
 
     def __init__(self, engine: "_Engine", x_level, cells: List[Cell]):
         self.engine = engine
         space = engine.space
-        seen: Dict[int, Cell] = {}
+        seen: Dict[Tuple[int, int], Cell] = {}
         for c in cells:
-            seen.setdefault(space.spec_index(c.index), c)
-        self.spec_rows: Dict[int, Tuple[int, int]] = {}
+            key = (space.estimator_index(c.index), space.spec_index(c.index))
+            seen.setdefault(key, c)
+        self.spec_rows: Dict[Tuple[int, int], Tuple[int, int]] = {}
         self.results: List[Dict[str, object]] = []
-        ids = list(seen)
+        self.disclosures: List[Optional[dict]] = []
+        groups: Dict[int, List[Tuple[int, int]]] = {}
+        for key in seen:
+            groups.setdefault(key[0], []).append(key)
         pad = engine.spec_pad
-        for b, start in enumerate(range(0, len(ids), pad)):
-            block_ids = ids[start:start + pad]
-            for row, sid in enumerate(block_ids):
-                self.spec_rows[sid] = (b, row)
-            # pad to the fixed program width by repeating the block's first
-            # spec; padded rows are never read back
-            padded = block_ids + [block_ids[0]] * (pad - len(block_ids))
-            grid = SpecGrid(
-                tuple(seen[sid].spec(tag=space.tag) for sid in padded),
-                nw_lags=space.nw_lags, min_months=space.min_months,
-                union=space.union_predictors,
-            )
-            self.results.append(engine.solve_block(grid, x_level))
+        b = 0
+        for eidx, keys in groups.items():
+            est = space.estimators[eidx]
+            for start in range(0, len(keys), pad):
+                block_keys = keys[start:start + pad]
+                for row, key in enumerate(block_keys):
+                    self.spec_rows[key] = (b, row)
+                # pad to the fixed program width by repeating the block's
+                # first spec; padded rows are never read back
+                padded = block_keys + [block_keys[0]] * (pad - len(block_keys))
+                grid = SpecGrid(
+                    tuple(seen[key].spec(tag=space.tag) for key in padded),
+                    nw_lags=space.nw_lags, min_months=space.min_months,
+                    union=space.union_predictors,
+                )
+                res, disc = engine.solve_block(grid, x_level, est)
+                self.results.append(res)
+                self.disclosures.append(disc)
+                b += 1
 
     def cell_view(self, cell: Cell):
-        """(per-weight SpecGridResult, local spec row) for one cell."""
-        b, row = self.spec_rows[self.engine.space.spec_index(cell.index)]
-        return self.results[b][cell.weight], row
+        """(per-weight SpecGridResult, local spec row, block disclosures)
+        for one cell."""
+        space = self.engine.space
+        key = (space.estimator_index(cell.index),
+               space.spec_index(cell.index))
+        b, row = self.spec_rows[key]
+        return self.results[b][cell.weight], row, self.disclosures[b]
 
 
 class _Engine:
@@ -133,7 +150,7 @@ class _Engine:
                  firm_chunk, label_of, seed: int,
                  coreset_m, coreset_budget_mb, tile_cells,
                  gram_route=None, precision=None, factorize=None,
-                 boot_route=None):
+                 boot_route=None, fe_codes=None):
         from fm_returnprediction_tpu.specgrid.boot import resolve_boot_route
         from fm_returnprediction_tpu.specgrid.grams import (
             resolve_gram_factorize,
@@ -201,6 +218,57 @@ class _Engine:
         # signature serves every batch (the engine's one-compiled-program
         # discipline).
         single_device = self.mesh is None and resolve_specgrid_procs(None) == 1
+        # estimator dimension (ISSUE 16): non-OLS kinds route each batch
+        # through run_estimator_grid_weights — single-device only (the
+        # mesh/multiproc programs predate the estimator transforms), no QR
+        # referee (disclosed, not refereed), and the validation is LOUD up
+        # front rather than a mid-sweep surprise S tiles in
+        self.fe_codes = fe_codes
+        self.emit_estimator = (
+            len(space.estimators) > 1
+            or any(e.kind != "ols" or e.se != "nw"
+                   for e in space.estimators)
+        )
+        ols_odd = [e for e in space.estimators
+                   if e.kind == "ols" and e.se != "nw"]
+        if ols_odd:
+            raise ValueError(
+                f"OLS cells ride the incumbent NW grid tail; se families "
+                f"{[e.se for e in ols_odd]} are estimator-subsystem tails "
+                "— query the gram bank instead (grambank.estimator_query "
+                "serves ols under the iid/clustered tails)"
+            )
+        non_ols = [e for e in space.estimators if e.kind != "ols"]
+        if non_ols:
+            if not single_device:
+                raise ValueError(
+                    "estimator kinds beyond OLS are a single-device route "
+                    "— the mesh and multi-process grid programs predate "
+                    f"the estimator transforms (space has {non_ols})"
+                )
+            pooled = [e for e in non_ols if e.kind == "pooled"]
+            if pooled and space.bootstrap > 1:
+                raise ValueError(
+                    "pooled estimator cells produce no per-month slope "
+                    "series to resample — a pooled space must have "
+                    "bootstrap=1"
+                )
+            if space.bootstrap > 1 and any(e.se != "nw" for e in non_ols):
+                raise ValueError(
+                    "bootstrap draws re-aggregate the slope series under "
+                    "the NW tail; estimator cells with se != 'nw' cannot "
+                    "ride them — drop the draws or use se='nw'"
+                )
+            for e in non_ols:
+                if e.kind == "absorb":
+                    missing = [nm for nm in e.absorb
+                               if nm not in (fe_codes or {})]
+                    if missing:
+                        raise KeyError(
+                            f"estimator {e.label!r} needs FE codes for "
+                            f"{missing} — pass fe_codes={{name: (T, N) "
+                            "int codes}} to run_cellspace"
+                        )
         fact = resolve_gram_factorize(factorize)
         if fact == "on" and not single_device:
             raise ValueError(
@@ -295,18 +363,34 @@ class _Engine:
         self._winsor_cache = (level, x_level)
         return x_level
 
-    def solve_block(self, grid: SpecGrid, x_level):
-        from fm_returnprediction_tpu.specgrid.solve import (
-            run_spec_grid_weights,
+    def solve_block(self, grid: SpecGrid, x_level, estimator):
+        """One padded spec batch under one estimator. OLS rides the
+        incumbent (refereed) grid program untouched; every other kind
+        routes through the estimator subsystem and returns its block
+        disclosures alongside (``(results, disclosures-or-None)``)."""
+        if estimator.kind == "ols":
+            from fm_returnprediction_tpu.specgrid.solve import (
+                run_spec_grid_weights,
+            )
+
+            return run_spec_grid_weights(
+                x=x_level, y=self.y, universe_masks=self.universe_masks,
+                grid=grid, weights=self.space.weights, referee=self.referee,
+                firm_chunk=self.firm_chunk, mesh=self.mesh,
+                row_weights=self.row_weights,
+                gram_route=self.gram_route, precision=self.precision,
+                factorize=self.gram_factorize, pair_pad=self.pair_pad,
+            ), None
+        from fm_returnprediction_tpu.specgrid.estimators.grid import (
+            run_estimator_grid_weights,
         )
 
-        return run_spec_grid_weights(
-            x=x_level, y=self.y, universe_masks=self.universe_masks,
-            grid=grid, weights=self.space.weights, referee=self.referee,
-            firm_chunk=self.firm_chunk, mesh=self.mesh,
-            row_weights=self.row_weights,
-            gram_route=self.gram_route, precision=self.precision,
-            factorize=self.gram_factorize, pair_pad=self.pair_pad,
+        return run_estimator_grid_weights(
+            estimator, self.y, x_level, self.universe_masks, grid,
+            self.space.weights, firm_chunk=self.firm_chunk,
+            row_weights=self.row_weights, gram_route=self.gram_route,
+            precision=self.precision, factorize=self.gram_factorize,
+            pair_pad=self.pair_pad, fe_codes=self.fe_codes,
         )
 
     def resample(self, draw: int) -> np.ndarray:
@@ -365,9 +449,17 @@ class _Engine:
 
     # -- row emission ------------------------------------------------------
 
-    def rows_for(self, cell: Cell, res, row: int) -> List[dict]:
+    def rows_for(self, cell: Cell, res, row: int,
+                 disc: Optional[dict] = None) -> List[dict]:
         space = self.space
-        pos = [self._union_pos[c] for c in cell.predictors]
+        preds = cell.predictors
+        if self.emit_estimator and cell.estimator.kind == "fwl":
+            # a control that overlaps the cell's focal set is partialled
+            # OUT of the solve (grid.py masks it from col_sel), so its
+            # slot holds padding, not a coefficient — never report it
+            dropped = set(cell.estimator.controls)
+            preds = tuple(c for c in preds if c not in dropped)
+        pos = [self._union_pos[c] for c in preds]
         if cell.draw == 0:
             coef = res.coef[row]
             tstat = res.tstat[row]
@@ -407,7 +499,7 @@ class _Engine:
             nw_se[pos] = nw_c[pos]
         refereed = row in res.referee_specs
         rows = []
-        for col, p in zip(cell.predictors, pos):
+        for col, p in zip(preds, pos):
             r = {
                 "cell": cell.index,
                 "model": cell.set_name,
@@ -424,6 +516,19 @@ class _Engine:
                 "n_months": n_months,
                 "refereed": refereed,
             }
+            if self.emit_estimator:
+                # estimator cells disclose, never referee: the label, the
+                # SE family, the conditioning disclosure, and (absorb)
+                # the alternating-projection convergence account
+                r["estimator"] = cell.estimator.label
+                r["se_family"] = cell.estimator.se
+                if cell.estimator.kind != "ols":
+                    r["suspect_months"] = int(res.suspect_months[row])
+                if disc is not None and "absorb_iters" in disc:
+                    r["absorb_iters"] = int(disc["absorb_iters"][row])
+                    r["absorb_converged"] = bool(
+                        disc["absorb_converged"][row]
+                    )
             if space.bootstrap > 1:
                 r["draw"] = cell.draw
             if self.precision == "bf16":
@@ -465,15 +570,17 @@ def run_cellspace(
     precision: Optional[str] = None,
     factorize: Optional[str] = None,
     boot_route: Optional[str] = None,
+    fe_codes: Optional[Dict[str, object]] = None,
 ):
     """Stream a ``CellSpace`` sweep through a sink.
 
     ``x`` must hold ``space.union_predictors`` in order; ``universe_masks``
     must cover every universe the space names. ``sink`` is a ``Sink``, a
     sink name (``sinks.SINK_NAMES``), or None (the ``FMRP_SPECGRID_SINK``/
-    ``"frame"`` default). Returns ``(sink.finish(), stats_dict)`` where the
-    stats disclose cells/rows/tiles/seconds (the bench's cells/s series
-    reads them).
+    ``"frame"`` default). ``fe_codes`` maps FE names → (T, N) int code
+    arrays for spaces carrying ``absorb`` estimator cells. Returns
+    ``(sink.finish(), stats_dict)`` where the stats disclose
+    cells/rows/tiles/seconds (the bench's cells/s series reads them).
     """
     from fm_returnprediction_tpu import telemetry
     from fm_returnprediction_tpu.specgrid.solve import contraction_counts
@@ -485,7 +592,7 @@ def run_cellspace(
         firm_chunk=firm_chunk, label_of=label_of, seed=seed,
         coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
         tile_cells=tile_cells, gram_route=gram_route, precision=precision,
-        factorize=factorize, boot_route=boot_route,
+        factorize=factorize, boot_route=boot_route, fe_codes=fe_codes,
     )
     contractions_before = contraction_counts()
     cells_counter = telemetry.registry().counter(
@@ -503,8 +610,8 @@ def run_cellspace(
                     solver = _TileSolver(engine, engine.x_at_level(level),
                                          cells)
                     for cell in cells:
-                        res, row = solver.cell_view(cell)
-                        frames.extend(engine.rows_for(cell, res, row))
+                        res, row, disc = solver.cell_view(cell)
+                        frames.extend(engine.rows_for(cell, res, row, disc))
                     del solver  # one tile of solve leaves live at a time
                 engine._boot_cache.clear()  # draw runs never straddle tiles
                 sink_obj.consume(pd.DataFrame(frames))

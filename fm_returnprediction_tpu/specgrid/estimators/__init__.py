@@ -1,0 +1,63 @@
+"""Estimator subsystem on the Gram bank: FWL partialling-out, absorbed
+fixed effects, IV/2SLS, clustered/HAC SE families, and the streaming
+block bootstrap — each a transform of the spec grid's banked per-month
+Gram sufficient statistics (see ``estimators.core`` for the design
+contract and ``docs/architecture.md`` § Estimators for the math)."""
+
+from fm_returnprediction_tpu.specgrid.estimators.absorb import (
+    absorb_transform,
+    contract_absorb_cells,
+)
+from fm_returnprediction_tpu.specgrid.estimators.cluster import (
+    BANK_POOLED_SE,
+    PooledResult,
+    decentered_stats,
+    fm_cluster_summary,
+    pooled_fit,
+    pooled_panel_meats,
+)
+from fm_returnprediction_tpu.specgrid.estimators.core import (
+    EST_OLS,
+    ESTIMATOR_KINDS,
+    FM_SE_FAMILIES,
+    POOLED_SE_FAMILIES,
+    Estimator,
+    masked_psd_solve,
+    parse_estimator,
+    resolve_estimator,
+)
+from fm_returnprediction_tpu.specgrid.estimators.fwl import fwl_transform
+from fm_returnprediction_tpu.specgrid.estimators.grid import (
+    run_estimator_grid_weights,
+)
+from fm_returnprediction_tpu.specgrid.estimators.iv import (
+    iv_r2,
+    iv_transform,
+)
+from fm_returnprediction_tpu.specgrid.estimators.stream import (
+    StreamingBootstrap,
+)
+
+__all__ = [
+    "ESTIMATOR_KINDS",
+    "FM_SE_FAMILIES",
+    "POOLED_SE_FAMILIES",
+    "BANK_POOLED_SE",
+    "Estimator",
+    "EST_OLS",
+    "parse_estimator",
+    "resolve_estimator",
+    "masked_psd_solve",
+    "fwl_transform",
+    "iv_transform",
+    "iv_r2",
+    "contract_absorb_cells",
+    "absorb_transform",
+    "fm_cluster_summary",
+    "decentered_stats",
+    "pooled_fit",
+    "pooled_panel_meats",
+    "PooledResult",
+    "run_estimator_grid_weights",
+    "StreamingBootstrap",
+]

@@ -1,0 +1,190 @@
+"""Absorbed fixed effects: alternating projections on per-month CELL
+sufficient statistics.
+
+The within transform never needs row-level residuals. For FE factors with
+codes ``(a, b)`` per row, everything the demeaned Gram needs is the FE
+crossing's cell statistics per (spec, month):
+
+    n_cell[a, b]  = Σ_{i∈cell} w_i                     counts
+    s_cell[a, b]  = Σ_{i∈cell} w_i v_i                 sums of v = [x̃, y]
+
+(one ``segment_sum`` pass over the panel — the ONLY panel contact the
+estimator makes, and the reason absorb is the one kind the Gram bank
+cannot serve: the bank stores Grams, not FE crossings). Alternating
+projections then run on AGGREGATES: the zig-zag (Halperin) iteration
+
+    α_a ← (s_a − Σ_b n_cell[a,b] β_b) / n_a
+    β_b ← (s_b − Σ_a n_cell[a,b] α_a) / n_b
+
+is the demeaning fixed point, one-way FE converging in a single exact
+projection (the closed-form within transform). Whatever FE values the
+iteration holds, the demeaned Gram identity
+
+    G_w = G_raw − A'S − S'A + Σ_cell n_cell a_cell a_cell'
+
+(``a_cell = α_a + β_b``) is EXACT for those values — so a non-converged
+two-way demeaning is an honestly-disclosed approximate demeaning (the
+``absorb_iters``/``absorb_converged`` columns), never a silently wrong
+Gram. The centered x̃ the bank already carries is within-invariant
+(demeaning absorbs any per-month constant shift), so banked stats and
+cell stats agree by construction.
+
+The transformed stats drop the intercept (the constant lies in the span
+of the FE dummies — the reported intercept is exactly 0), zero
+``ysum``/``center`` (demeaned y has mean zero per group), and gate the
+dof honestly: a month must carry ``#columns + #FE-levels-present − 1``
+rows or it is zeroed out of ``month_valid``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+
+from .core import _PRECISION
+
+__all__ = ["contract_absorb_cells", "absorb_transform"]
+
+
+@functools.partial(jax.jit, static_argnames=("ga", "gb"))
+def contract_absorb_cells(y, x, universes, uidx, col_sel, window,
+                          center, codes_a, codes_b, row_weights=None,
+                          *, ga: int, gb: int):
+    """One panel pass → per-(spec, month) FE cell statistics.
+
+    ``y`` (T, N), ``x`` (T, N, P), ``universes`` (U, T, N) bool, ``uidx``
+    (S,), ``col_sel`` (S, P) bool, ``window`` (S, T) bool, ``center``
+    (T, P), ``codes_a``/``codes_b`` (T, N) int in [0, ga)/[0, gb)
+    (one-way: ``gb=1`` and zeros). Row validity is the contraction's own
+    rule — universe ∧ finite(y) ∧ finite(selected x) ∧ window — so cell
+    counts sum to exactly ``SpecGramStats.n``; ``row_weights`` (T, N) is
+    the coreset route's importance weighting, applied exactly as
+    ``contract_spec_grams`` applies it (weighted counts and sums — the
+    FE estimand the panel-coreset paper covers). Returns ``(n_cells
+    (S, T, ga, gb), s_cells (S, T, ga, gb, V))`` with V = P + 1 over
+    ``v = [x − center, y]`` (unselected columns zeroed)."""
+    t, n, p = x.shape
+    seg = (jnp.arange(t)[:, None] * (ga * gb)
+           + codes_a * gb + codes_b).reshape(-1)            # (T*N,)
+    x_fin = jnp.isfinite(x)
+    y_fin = jnp.isfinite(y)
+    x_c = jnp.where(x_fin, x - center[:, None, :], 0.0)
+    y_z = jnp.where(y_fin, y, 0.0)
+
+    def one(ui, sel, win):
+        valid = (y_fin & win[:, None] & universes[ui]
+                 & jnp.all(x_fin | ~sel, axis=-1))
+        w = valid.astype(x.dtype)                            # (T, N)
+        if row_weights is not None:
+            w = w * row_weights
+        v = jnp.concatenate(
+            [jnp.where(sel, x_c, 0.0), y_z[..., None]], axis=-1
+        ) * w[..., None]                                     # (T, N, V)
+        n_c = jax.ops.segment_sum(
+            w.reshape(-1), seg, num_segments=t * ga * gb
+        ).reshape(t, ga, gb)
+        s_c = jax.ops.segment_sum(
+            v.reshape(t * n, p + 1), seg, num_segments=t * ga * gb
+        ).reshape(t, ga, gb, p + 1)
+        return n_c, s_c
+
+    return jax.vmap(one)(uidx, col_sel, window)
+
+
+def absorb_transform(stats: SpecGramStats, sel_aug, n_cells, s_cells,
+                     *, n_fe: int, tol: float, max_iter: int):
+    """Demean every (spec, month) Gram against the FE crossing.
+
+    Returns ``(stats', iters, delta)``: the within-transformed stats,
+    the (S, T) alternating-projection sweep counts actually used, and
+    the (S, T) final sup-norm change (``delta ≤ tol`` ⇔ converged;
+    one-way FE is exact in one sweep by construction)."""
+    dtype = stats.gram.dtype
+    n_cells = n_cells.astype(dtype)
+    s_cells = s_cells.astype(dtype)
+    n1 = n_cells.sum(-1)                                     # (S, T, ga)
+    n2 = n_cells.sum(-2)                                     # (S, T, gb)
+    s1 = s_cells.sum(-2)                                     # (S, T, ga, V)
+    s2 = s_cells.sum(-3)                                     # (S, T, gb, V)
+    d1 = jnp.maximum(n1, 1.0)[..., None]
+    d2 = jnp.maximum(n2, 1.0)[..., None]
+
+    a1 = s1 / d1
+    a2 = jnp.zeros_like(s2)
+    s_mt = stats.n.shape                                     # (S, T)
+    if n_fe == 1:
+        iters = jnp.ones(s_mt, jnp.int32)
+        delta = jnp.zeros(s_mt, dtype)
+    else:
+        def sweep(_, carry):
+            a1, a2, delta, iters = carry
+            a1n = (s1 - jnp.einsum("stab,stbv->stav", n_cells, a2,
+                                   precision=_PRECISION)) / d1
+            a2n = (s2 - jnp.einsum("stab,stav->stbv", n_cells, a1n,
+                                   precision=_PRECISION)) / d2
+            step = jnp.maximum(
+                jnp.abs(a1n - a1).max(axis=(-2, -1)),
+                jnp.abs(a2n - a2).max(axis=(-2, -1)),
+            )
+            live = delta > tol
+            return (jnp.where(live[..., None, None], a1n, a1),
+                    jnp.where(live[..., None, None], a2n, a2),
+                    jnp.where(live, step, delta),
+                    iters + live.astype(jnp.int32))
+
+        init_delta = jnp.full(s_mt, jnp.inf, dtype)
+        a1, a2, delta, iters = jax.lax.fori_loop(
+            0, max_iter, sweep,
+            (a1, a2, init_delta, jnp.zeros(s_mt, jnp.int32)),
+        )
+
+    a_cell = a1[..., :, None, :] + a2[..., None, :, :]       # (S,T,ga,gb,V)
+    as_ = jnp.einsum("stabv,stabw->stvw", a_cell, s_cells,
+                     precision=_PRECISION)
+    naa = jnp.einsum("stab,stabv,stabw->stvw", n_cells, a_cell, a_cell,
+                     precision=_PRECISION)
+
+    p = stats.center.shape[-1]
+    g_raw = jnp.concatenate([
+        jnp.concatenate([stats.gram[..., 1:, 1:],
+                         stats.moment[..., 1:, None]], axis=-1),
+        jnp.concatenate([stats.moment[..., None, 1:],
+                         stats.yy[..., None, None]], axis=-1),
+    ], axis=-2)                                              # (S,T,V,V)
+    g_w = g_raw - as_ - jnp.swapaxes(as_, -1, -2) + naa
+
+    col_sel = sel_aug[:, 1:]
+    sel2 = (col_sel[:, None, :, None] & col_sel[:, None, None, :])
+    gram2 = jnp.zeros_like(stats.gram)
+    gram2 = gram2.at[..., 1:, 1:].set(
+        jnp.where(sel2, g_w[..., :p, :p], 0.0)
+    )
+    gram2 = gram2.at[..., 0, 0].set(stats.n)
+    moment2 = jnp.zeros_like(stats.moment)
+    moment2 = moment2.at[..., 1:].set(
+        jnp.where(col_sel[:, None, :], g_w[..., :p, p], 0.0)
+    )
+    yy2 = jnp.maximum(g_w[..., p, p], 0.0)
+
+    # dof gate: absorbing k FE levels spends k − 1 dof beyond the
+    # constant the solve already charges for — a month must carry
+    # #columns + #levels-present − 1 rows to identify the within solve.
+    levels = (n1 > 0).sum(-1)
+    if n_fe == 2:
+        levels = levels + (n2 > 0).sum(-1) - 1
+    q_total = sel_aug.sum(-1)[:, None] + jnp.maximum(levels - 1, 0)
+    ok = stats.n >= q_total.astype(stats.n.dtype)
+    okf = ok.astype(dtype)
+    out = SpecGramStats(
+        gram=gram2 * okf[..., None, None],
+        moment=moment2 * okf[..., None],
+        n=stats.n * okf,
+        ysum=jnp.zeros_like(stats.ysum),
+        yy=yy2 * okf,
+        center=jnp.zeros_like(stats.center),
+    )
+    return out, iters, jnp.where(jnp.isfinite(delta), delta, 0.0)

@@ -1,0 +1,263 @@
+"""Clustered / robust SE families: the pooled-OLS sandwich and the
+clustered Fama-MacBeth aggregation.
+
+Two places a clustered SE enters the estimator subsystem:
+
+1. **FM kinds** (``se="cluster"``): the FM point estimate is a mean of
+   monthly slopes, so its clustered SE is the clustered variance of a
+   time-series mean — by-YEAR blocks (calendar ``month // 12``),
+   ``ops.newey_west.clustered_mean_se``. :func:`fm_cluster_summary` is
+   ``fama_macbeth_summary`` with that kernel swapped in (same dropna /
+   min-months / mean semantics — differential-shared, not re-derived).
+
+2. **Pooled kind**: one β per cell from the MONTH-SUMMED Grams, with a
+   sandwich variance ``V = B·meat·B`` (``B`` = bread, the pooled Gram
+   pinv). The banked stats are per-month CENTERED (x̃ = x − c_t with a
+   different c_t each month), so summing them naively would mix
+   incompatible bases — but de-centering is exact Gram algebra:
+
+       G_raw[j,k] = G̃[j,k] + c_j G̃[0,k] + c_k G̃[0,j] + n c_j c_k
+       m_raw[j]   = m̃[j] + c_j·ysum
+
+   (:func:`decentered_stats`), after which the month sum is the honest
+   pooled raw-basis Gram. Meats:
+
+   - ``iid``           — σ²·B with σ² = SSE/(n − q);
+   - ``cluster_month`` — Σ_t s_t s_t' over per-month score sums
+     ``s_t = m_t − G_t β`` — Gram algebra only, which is why the Gram
+     bank can serve it with ZERO panel contractions;
+   - ``cluster_firm``  — Σ_i s_i s_i' over per-firm score sums. The key
+     shape fact: ``s_i = Σ_t w x̃ u`` needs residuals first and then one
+     (T,N)-weighted contraction — never an (N, Q, Q) tensor;
+   - ``white``         — Σ w u² x̃ x̃' (heteroskedasticity-robust);
+   - ``cluster_twoway``— CGM inclusion-exclusion: month + firm − white.
+
+   No small-sample correction is applied to any meat (the simplest
+   honest convention; the host oracle in ``tests/test_estimators.py``
+   matches it exactly, and consumers needing G/(G−1)-style scalings can
+   apply them to the reported SE).
+
+Firm/white meats touch the panel; month/iid meats are pure sufficient
+statistics — the split that decides which pooled SE families
+``grambank.estimator_query`` accepts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.fama_macbeth import FamaMacbethSummary
+from fm_returnprediction_tpu.ops.newey_west import clustered_mean_se
+
+from .core import _PRECISION, POOLED_SE_FAMILIES, masked_psd_solve
+
+__all__ = [
+    "PooledResult",
+    "BANK_POOLED_SE",
+    "fm_cluster_summary",
+    "decentered_stats",
+    "pooled_fit",
+    "pooled_panel_meats",
+]
+
+#: pooled SE families computable from banked stats alone (no panel)
+BANK_POOLED_SE = ("iid", "cluster_month")
+
+
+class PooledResult(NamedTuple):
+    """Pooled-OLS leaves, spec-major (device arrays inside programs)."""
+
+    beta: jnp.ndarray       # (S, Q) raw-basis, intercept first
+    se: jnp.ndarray         # (S, Q) sandwich SE, selected cols
+    tstat: jnp.ndarray      # (S, Q)
+    r2: jnp.ndarray         # (S,) pooled R²
+    n_total: jnp.ndarray    # (S,) pooled row count
+    n_months: jnp.ndarray   # (S,) months contributing rows
+    deficient: jnp.ndarray  # (S,) bool: pooled Gram lost rank
+
+
+def fm_cluster_summary(cs, min_months: int, cluster_months: int = 12
+                       ) -> FamaMacbethSummary:
+    """``fama_macbeth_summary`` with the by-year clustered SE kernel in
+    place of Newey-West — the FM kinds' ``se="cluster"`` aggregation.
+    Cluster ids are CALENDAR ``t // cluster_months`` (clusters follow the
+    calendar even when interior months dropped out)."""
+    month_valid = cs.month_valid
+    n_months = month_valid.sum()
+    slope_valid = month_valid[:, None] & jnp.isfinite(cs.slopes)
+    count = slope_valid.sum(axis=0)
+    slopes_z = jnp.where(slope_valid, cs.slopes, 0.0)
+    mean_slope = slopes_z.sum(axis=0) / jnp.maximum(count, 1).astype(
+        cs.slopes.dtype
+    )
+    ids = jnp.arange(cs.slopes.shape[0]) // cluster_months
+    se = jax.vmap(
+        lambda s, v: clustered_mean_se(s, v, ids), in_axes=(1, 1)
+    )(cs.slopes, slope_valid)
+    enough = count >= min_months
+    coef = jnp.where(enough, mean_slope, jnp.nan)
+    tstat = jnp.where(enough, mean_slope / se, jnp.nan)
+    r2_valid = month_valid & jnp.isfinite(cs.r2)
+    r2_count = r2_valid.sum()
+    mean_r2 = jnp.where(
+        r2_count > 0,
+        jnp.sum(jnp.where(r2_valid, cs.r2, 0.0))
+        / jnp.maximum(r2_count, 1).astype(cs.r2.dtype),
+        jnp.nan,
+    )
+    mf = month_valid.astype(cs.r2.dtype)
+    mean_n = jnp.where(
+        n_months > 0,
+        jnp.sum(cs.n_obs.astype(cs.r2.dtype) * mf)
+        / jnp.maximum(n_months, 1).astype(cs.r2.dtype),
+        jnp.nan,
+    )
+    return FamaMacbethSummary(coef, tstat, se, mean_r2, mean_n, n_months)
+
+
+def decentered_stats(stats, sel_aug):
+    """Exact raw-basis per-month Gram/moment from the centered banked
+    stats (selection-masked so NaN-bearing unselected entries never
+    leak). Returns ``(g_raw (S,T,Q,Q), m_raw (S,T,Q))``."""
+    sel2 = sel_aug[:, None, :, None] & sel_aug[:, None, None, :]
+    g = jnp.where(sel2, stats.gram, 0.0)
+    m = jnp.where(sel_aug[:, None, :], stats.moment, 0.0)
+    caug = jnp.concatenate(
+        [jnp.zeros(stats.center.shape[:-1] + (1,), stats.center.dtype),
+         stats.center], axis=-1,
+    )                                                       # (T, Q)
+    c = jnp.where(sel_aug[:, None, :], caug[None], 0.0)     # (S, T, Q)
+    row0 = g[..., 0, :]                                     # (S, T, Q)
+    g_raw = (g
+             + c[..., :, None] * row0[..., None, :]
+             + c[..., None, :] * row0[..., :, None]
+             + stats.n[..., None, None] * c[..., :, None] * c[..., None, :])
+    m_raw = m + c * stats.ysum[..., None]
+    return g_raw, m_raw
+
+
+def pooled_fit(stats, sel_aug, se: str, data_eps: float,
+               panel=None, row_weights=None) -> PooledResult:
+    """Pooled OLS + sandwich over the month-summed de-centered Grams.
+
+    ``se`` ∈ :data:`~.core.POOLED_SE_FAMILIES`; the panel-borne meats
+    (``cluster_firm``/``white``/``cluster_twoway``) need ``panel`` =
+    ``(y, x, universes, uidx, col_sel, window)`` for the one residual
+    pass (:func:`pooled_panel_meats`) — stats-only callers (the Gram
+    bank) are restricted to :data:`BANK_POOLED_SE` and pass none."""
+    if se not in POOLED_SE_FAMILIES:
+        raise ValueError(
+            f"pooled se must be one of {POOLED_SE_FAMILIES}, got {se!r}"
+        )
+    needs_panel = se in ("cluster_firm", "white", "cluster_twoway")
+    if needs_panel and panel is None:
+        raise ValueError(
+            f"pooled se={se!r} needs the panel for its meat "
+            "(pooled_panel_meats) — stats-only routes serve only "
+            f"{BANK_POOLED_SE}"
+        )
+    g_raw, m_raw = decentered_stats(stats, sel_aug)
+    g_pool = g_raw.sum(1)                                   # (S, Q, Q)
+    m_pool = m_raw.sum(1)                                   # (S, Q)
+    n_tot = stats.n.sum(1)
+    ysum_tot = stats.ysum.sum(1)
+    yy_tot = stats.yy.sum(1)
+    dtype = g_pool.dtype
+    q = g_pool.shape[-1]
+
+    rhs = jnp.concatenate(
+        [m_pool[..., None],
+         jnp.broadcast_to(jnp.eye(q, dtype=dtype), g_pool.shape)],
+        axis=-1,
+    )
+    sol, deficient = masked_psd_solve(g_pool, sel_aug, rhs, data_eps)
+    beta = sol[..., 0]                                      # (S, Q)
+    bread = sol[..., 1:]                                    # (S, Q, Q) ≈ G⁻¹
+
+    bg = jnp.einsum("sq,sqr,sr->s", beta, g_pool, beta, precision=_PRECISION)
+    bm = jnp.einsum("sq,sq->s", beta, m_pool, precision=_PRECISION)
+    sse = yy_tot - 2.0 * bm + bg
+    sst = yy_tot - ysum_tot * ysum_tot / jnp.maximum(n_tot, 1.0)
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0),
+                   jnp.nan)
+
+    q_s = sel_aug.sum(-1).astype(dtype)
+    if se == "iid":
+        sigma2 = sse / jnp.maximum(n_tot - q_s, 1.0)
+        cov = sigma2[:, None, None] * bread
+    else:
+        meat_firm = meat_white = None
+        if needs_panel:
+            meat_firm, meat_white = pooled_panel_meats(
+                *panel, beta, row_weights=row_weights
+            )
+        if se in ("cluster_month", "cluster_twoway"):
+            s_t = m_raw - jnp.einsum("stqr,sr->stq", g_raw, beta,
+                                     precision=_PRECISION)
+            meat_month = jnp.einsum("stq,str->sqr", s_t, s_t,
+                                    precision=_PRECISION)
+        if se == "cluster_month":
+            meat = meat_month
+        elif se == "cluster_firm":
+            meat = meat_firm
+        elif se == "white":
+            meat = meat_white
+        else:  # cluster_twoway — CGM inclusion-exclusion
+            meat = meat_month + meat_firm - meat_white
+        cov = jnp.einsum("sqa,sab,sbr->sqr", bread, meat, bread,
+                         precision=_PRECISION)
+    var = jnp.diagonal(cov, axis1=-2, axis2=-1)
+    se_vec = jnp.where(sel_aug, jnp.sqrt(jnp.maximum(var, 0.0)), jnp.nan)
+    beta_out = jnp.where(sel_aug, beta, jnp.nan)
+    tstat = beta_out / se_vec
+    return PooledResult(
+        beta=beta_out, se=se_vec, tstat=tstat, r2=r2,
+        n_total=n_tot, n_months=(stats.n > 0).sum(1),
+        deficient=deficient,
+    )
+
+
+def pooled_panel_meats(y, x, universes, uidx, col_sel, window, beta,
+                       row_weights=None):
+    """The panel-borne sandwich meats for the pooled kind: per-firm score
+    outer products (``cluster_firm``) and the White meat, in ONE panel
+    pass. ``beta`` (S, Q) is the raw-basis pooled solution; ``window`` is
+    the per-spec (S, T) month mask; ``row_weights`` is the coreset
+    route's (T, N) importance weighting. Row validity is the
+    contraction's own rule, so the score sums match the pooled Gram
+    exactly."""
+    x_fin = jnp.isfinite(x)
+    y_fin = jnp.isfinite(y)
+    x_z = jnp.where(x_fin, x, 0.0)
+    y_z = jnp.where(y_fin, y, 0.0)
+
+    def one(ui, sel, win, b):
+        valid = (y_fin & win[:, None] & universes[ui]
+                 & jnp.all(x_fin | ~sel, axis=-1))
+        w = valid.astype(x.dtype)                           # (T, N)
+        if row_weights is not None:
+            w = w * row_weights
+        xs = jnp.where(sel, x_z, 0.0)                       # (T, N, P)
+        u = y_z - b[0] - jnp.einsum("tnp,p->tn", xs, b[1:],
+                                    precision=_PRECISION)
+        wu = w * u
+        # s_i = Σ_t w x̃ u — per-firm scores WITHOUT an (N, Q, Q) tensor
+        s_x = jnp.einsum("tn,tnp->np", wu, xs, precision=_PRECISION)
+        s_firm = jnp.concatenate([wu.sum(0)[:, None], s_x], axis=-1)
+        meat_firm = jnp.einsum("nq,nr->qr", s_firm, s_firm,
+                               precision=_PRECISION)
+        wu2 = w * u * u
+        mw_xx = jnp.einsum("tn,tnp,tnq->pq", wu2, xs, xs,
+                           precision=_PRECISION)
+        mw_x0 = jnp.einsum("tn,tnp->p", wu2, xs, precision=_PRECISION)
+        mw_00 = wu2.sum()
+        meat_white = jnp.concatenate([
+            jnp.concatenate([mw_00[None, None], mw_x0[None, :]], axis=-1),
+            jnp.concatenate([mw_x0[:, None], mw_xx], axis=-1),
+        ], axis=-2)
+        return meat_firm, meat_white
+
+    return jax.vmap(one)(uidx, col_sel, window, beta)

@@ -1,0 +1,268 @@
+"""Estimator kinds over banked Gram sufficient statistics.
+
+Every CellSpace cell historically ran ONE estimator: per-month OLS →
+Fama-MacBeth aggregation. This package makes the estimator itself a
+scenario dimension, and the design constraint is that every member of the
+family must be expressible as a TRANSFORM of the existing per-month Gram
+sufficient statistics (``specgrid.grams.SpecGramStats``) — because that is
+what lets the whole family ride the machinery the spec grid already has:
+the unique-pair factorized contraction, the padded eigh solve + FM tail,
+the Gram bank's zero-panel-read queries, the device-batched bootstrap
+aggregator, streaming sinks, and the registry-cached AOT programs.
+
+The kinds and their sufficient-statistic expression:
+
+- ``"ols"``    — the incumbent: identity transform (byte-compatible with
+  the historical route; an OLS estimator cell IS a plain grid cell).
+- ``"fwl"``    — Frisch-Waugh-Lovell partialling-out: the control block
+  (intercept + named control columns) is eliminated by a SCHUR COMPLEMENT
+  on each per-month Gram — ``G' = G_FF − G_FC G_CC⁻¹ G_CF`` — so every
+  spec sharing the controls reuses one banked Gram and the focal slopes
+  are EXACTLY the full regression's (the FWL theorem; the pinned test).
+- ``"absorb"`` — multi-way absorbed fixed effects: alternating-projection
+  demeaning run against per-month CELL sufficient statistics (group
+  counts + group sums over the FE crossing — ``estimators.absorb``), with
+  the iteration/convergence count disclosed per cell. One-way FE
+  converges in one projection (the closed-form within transform).
+- ``"iv"``     — IV/2SLS: two Gram solves — the first stage projects the
+  structural columns onto the instrument block (``Ĝ_XX = G_XZ G_ZZ⁻¹
+  G_ZX``), the structural solve runs on the projected system, and R²/SSE
+  come from the ORIGINAL stats (2SLS residuals use the raw regressors).
+- ``"pooled"`` — pooled OLS over the summed month Grams, the carrier for
+  the clustered/robust SE family (``estimators.cluster``): by-month and
+  by-firm cluster sandwiches, the two-way CGM combination, and the
+  heteroskedasticity-robust (White) meat.
+
+SE families (``Estimator.se``): the FM kinds accept ``"nw"`` (the
+reference Newey-West aggregation — the incumbent), ``"iid"`` (lag-0), and
+``"cluster"`` (the month-block clustered SE of the FM mean,
+``ops.newey_west.clustered_mean_se``); the pooled kind accepts ``"iid"``,
+``"white"``, ``"cluster_month"``, ``"cluster_firm"`` and
+``"cluster_twoway"``.
+
+Honest contracts carried over from the bank/coreset precedents: estimator
+cells are NEVER re-solved by the plain-OLS QR referee (a partialled/
+absorbed/instrumented cell is a different estimand — refereeing it with
+OLS would splice two estimators into one number), so rank-deficiency and
+conditioning flags are DISCLOSED per cell (``suspect_months``), exactly
+as the bank and coreset routes already do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "ESTIMATOR_KINDS",
+    "FM_SE_FAMILIES",
+    "POOLED_SE_FAMILIES",
+    "Estimator",
+    "EST_OLS",
+    "parse_estimator",
+    "resolve_estimator",
+    "masked_psd_solve",
+]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+ESTIMATOR_KINDS = ("ols", "fwl", "absorb", "iv", "pooled")
+
+#: SE families for the Fama-MacBeth kinds (per-month solve → aggregation)
+FM_SE_FAMILIES = ("nw", "iid", "cluster")
+
+#: SE families for the pooled kind (one β per cell → sandwich variance)
+POOLED_SE_FAMILIES = ("iid", "white", "cluster_month", "cluster_firm",
+                      "cluster_twoway")
+
+
+@dataclasses.dataclass(frozen=True)
+class Estimator:
+    """One estimator configuration — a solve-level cell dimension, hashable
+    so a ``CellSpace`` can carry a tuple of them (like ``weights``).
+
+    ``controls``/``endog``/``instruments`` name UNION predictor columns;
+    ``absorb`` names FE code arrays (supplied to the engine as
+    ``fe_codes[name] -> (T, N) int``). ``se`` selects the SE family for
+    the kind (see module docstring). ``absorb_tol``/``absorb_iters``
+    bound the alternating-projection demeaning (one-way FE converges in
+    a single projection regardless)."""
+
+    kind: str = "ols"
+    controls: Tuple[str, ...] = ()
+    absorb: Tuple[str, ...] = ()
+    endog: Tuple[str, ...] = ()
+    instruments: Tuple[str, ...] = ()
+    se: str = "nw"
+    absorb_tol: float = 1e-10
+    absorb_iters: int = 50
+
+    def __post_init__(self):
+        if self.kind not in ESTIMATOR_KINDS:
+            raise ValueError(
+                f"estimator kind must be one of {ESTIMATOR_KINDS}, "
+                f"got {self.kind!r}"
+            )
+        if self.kind == "fwl" and not self.controls:
+            raise ValueError("fwl needs at least one control column")
+        if self.kind == "absorb" and not 1 <= len(self.absorb) <= 2:
+            raise ValueError(
+                "absorb takes one or two FE code names (multi-way beyond "
+                f"two-way is not implemented), got {self.absorb}"
+            )
+        if self.kind == "iv":
+            if not self.endog or not self.instruments:
+                raise ValueError("iv needs endog and instruments columns")
+            if len(self.instruments) < len(self.endog):
+                raise ValueError(
+                    f"iv is underidentified: {len(self.endog)} endogenous "
+                    f"columns but only {len(self.instruments)} instruments"
+                )
+        families = (POOLED_SE_FAMILIES if self.kind == "pooled"
+                    else FM_SE_FAMILIES)
+        if self.se not in families:
+            raise ValueError(
+                f"se={self.se!r} is not a {self.kind} family "
+                f"(allowed: {families})"
+            )
+        for field, vals in (("controls", self.controls),
+                            ("endog", self.endog),
+                            ("instruments", self.instruments)):
+            if len(set(vals)) != len(vals):
+                raise ValueError(f"estimator repeats a {field} column: {vals}")
+
+    @property
+    def label(self) -> str:
+        """Short disclosure label for result-frame columns."""
+        if self.kind == "fwl":
+            return f"fwl[{'+'.join(self.controls)}]"
+        if self.kind == "absorb":
+            return f"absorb[{'+'.join(self.absorb)}]"
+        if self.kind == "iv":
+            return (f"iv[{'+'.join(self.endog)}~"
+                    f"{'+'.join(self.instruments)}]")
+        return self.kind
+
+
+#: the incumbent — plain per-month OLS → FM, the default estimator
+#: dimension of every CellSpace
+EST_OLS = Estimator()
+
+
+def parse_estimator(text: str) -> Estimator:
+    """Parse the CLI/env estimator grammar into an :class:`Estimator`.
+
+    - ``"ols"``
+    - ``"fwl:ctrl1+ctrl2"`` — partial out the named control columns
+    - ``"absorb:fe1"`` / ``"absorb:fe1+fe2"`` — absorb the named FE codes
+    - ``"iv:endog1~inst1+inst2"`` — instrument the endogenous columns
+    - ``"pooled"`` / ``"pooled:cluster_month"`` — pooled OLS, optionally
+      naming the sandwich SE family
+
+    An ``@se`` suffix selects the SE family for the FM kinds
+    (``"fwl:ctrl@iid"``)."""
+    text = (text or "ols").strip()
+    se = None
+    if "@" in text:
+        text, se = text.rsplit("@", 1)
+    kind, _, arg = text.partition(":")
+    kind = kind.strip().lower()
+    if kind == "ols":
+        return Estimator(se=se or "nw")
+    if kind == "fwl":
+        return Estimator(kind="fwl",
+                         controls=tuple(a for a in arg.split("+") if a),
+                         se=se or "nw")
+    if kind == "absorb":
+        return Estimator(kind="absorb",
+                         absorb=tuple(a for a in arg.split("+") if a),
+                         se=se or "nw")
+    if kind == "iv":
+        endog, _, inst = arg.partition("~")
+        return Estimator(
+            kind="iv",
+            endog=tuple(a for a in endog.split("+") if a),
+            instruments=tuple(a for a in inst.split("+") if a),
+            se=se or "nw",
+        )
+    if kind == "pooled":
+        return Estimator(kind="pooled", se=(arg.strip() or se or "iid"))
+    raise ValueError(
+        f"estimator kind must be one of {ESTIMATOR_KINDS}, got {kind!r} "
+        f"(from {text!r})"
+    )
+
+
+def resolve_estimator(
+    estimator=None,
+    default: str = "ols",
+    allowed: Optional[Tuple[str, ...]] = None,
+) -> Estimator:
+    """The estimator knob: explicit argument (an :class:`Estimator` or a
+    grammar string) wins, then the ``FMRP_SPECGRID_ESTIMATOR`` env var,
+    then ``default`` — the ``specs.resolve_route`` discipline, including
+    the loud rejection: the paper-parity surfaces (Table 2, the figure
+    sweep) pass ``allowed=("ols",)`` so an estimator knob leaking in from
+    a scenario-sweep environment FAILS instead of silently publishing
+    partialled/absorbed/instrumented numbers as the reference's."""
+    if estimator is None:
+        estimator = os.environ.get("FMRP_SPECGRID_ESTIMATOR", default)
+    if isinstance(estimator, str):
+        estimator = parse_estimator(estimator)
+    if not isinstance(estimator, Estimator):
+        raise TypeError(
+            f"estimator must be an Estimator or a grammar string, "
+            f"got {type(estimator).__name__}"
+        )
+    if allowed is not None and estimator.kind not in allowed:
+        raise ValueError(
+            f"estimator kind {estimator.kind!r} is not available here "
+            f"(allowed: {allowed}) — the estimator family is a scenario "
+            "dimension for the spec-grid engine and the bank's "
+            "estimator_query, not the parity reporting paths"
+        )
+    return estimator
+
+
+def masked_psd_solve(gram, mask, rhs, data_eps: float):
+    """Solve the SELECTED block of a batched PSD system with the grid
+    route's own numerics: identity-pad the unselected rows/columns,
+    Jacobi-equilibrate, eigendecompose, and apply a pinv-style eigenvalue
+    cutoff at ``q·eps·λmax`` — the exact ``specgrid.solve`` discipline, so
+    an estimator transform prices conditioning the same way the final
+    solve does.
+
+    ``gram`` (..., Q, Q), ``mask`` (..., Q) bool (the block to invert),
+    ``rhs`` (..., Q, R) with rows outside ``mask`` ignored. Returns
+    ``(x, deficient)`` where ``x`` (..., Q, R) is zero outside the masked
+    rows and ``deficient`` (...) flags batches whose masked block lost
+    rank at the cutoff — the estimator-level suspect signal (disclosed,
+    never refereed: see module docstring)."""
+    dtype = gram.dtype
+    q = gram.shape[-1]
+    eps = jnp.asarray(data_eps, dtype)
+    m2 = mask[..., :, None] & mask[..., None, :]
+    eye = jnp.eye(q, dtype=dtype)
+    a = jnp.where(m2, gram, eye)
+    dg = jnp.diagonal(a, axis1=-2, axis2=-1)
+    scale = jnp.where(dg > 0, jax.lax.rsqrt(jnp.maximum(dg, eps)), 1.0)
+    a_s = a * scale[..., :, None] * scale[..., None, :]
+    with jax.default_matmul_precision("highest"):
+        w, v = jnp.linalg.eigh(a_s)
+        cutoff = q * eps * w[..., -1]
+        winv = jnp.where(w > cutoff[..., None],
+                         1.0 / jnp.maximum(w, eps), 0.0)
+        r = jnp.where(mask[..., :, None], rhs, 0.0) * scale[..., :, None]
+        t1 = jnp.einsum("...qk,...qr->...kr", v, r, precision=_PRECISION)
+        x = scale[..., :, None] * jnp.einsum(
+            "...qk,...kr->...qr", v, t1 * winv[..., :, None],
+            precision=_PRECISION,
+        )
+    x = jnp.where(mask[..., :, None], x, 0.0)
+    q_m = mask.sum(-1)
+    rank = (w > cutoff[..., None]).sum(-1) - (q - q_m)
+    return x, rank < q_m

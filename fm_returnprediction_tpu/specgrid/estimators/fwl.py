@@ -1,0 +1,95 @@
+"""Frisch-Waugh-Lovell partialling-out as a Schur complement on banked
+per-month Grams.
+
+The FWL theorem: regressing y on [C | F] gives the same F-coefficients as
+(i) residualizing y and every F column on C, then (ii) regressing the y
+residuals on the F residuals. Step (i) never needs the panel — residual
+cross-products are a SCHUR COMPLEMENT of the month's augmented Gram:
+
+    G'_FF = G_FF − G_FC G_CC⁻¹ G_CF        (residualized Gram)
+    m'_F  = m_F  − G_FC G_CC⁻¹ m_C         (residualized x'y)
+    yy'   = yy   − m_C' G_CC⁻¹ m_C         (residualized y'y)
+
+with C = {intercept} ∪ controls and F the focal columns. So ONE banked
+(Q, Q) Gram per month serves every spec sharing the controls, and the
+focal slopes that come out of the ordinary padded solve on the
+transformed stats are EXACTLY the full regression's (pinned to f64
+round-off in ``tests/test_estimators.py``).
+
+What the transform leaves behind is an honest ``SpecGramStats``: the
+intercept row is reset to ``[n, 0, …]`` (residualized columns are
+orthogonal to the constant by construction), ``ysum``/``center`` go to
+zero (the residualized y has mean zero, so intercept recovery is a no-op
+and the reported intercept is exactly 0), and ``yy`` becomes the
+residual y'y — which makes the solve's R² the PARTIAL R² (variance
+explained beyond the controls), the quantity a partialled regression
+should report. Months with fewer rows than the FULL column count
+(intercept + controls + focal) are zeroed out entirely so the solve's
+``month_valid = n ≥ q`` gate sees them as empty rather than quietly
+underdetermined; control-block rank loss at the eigh cutoff is returned
+as a per-(spec, month) ``deficient`` flag for the suspect disclosure.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+
+from .core import _PRECISION, masked_psd_solve
+
+__all__ = ["fwl_transform"]
+
+
+def fwl_transform(stats: SpecGramStats, sel_aug, ctrl_aug, data_eps: float):
+    """Partial the control block out of every (spec, month) Gram.
+
+    ``sel_aug`` (S, Q) bool — the FULL augmented selection (intercept +
+    controls + focal columns; what the contraction validated rows
+    against); ``ctrl_aug`` (S, Q) bool — the block to eliminate
+    (intercept + controls; must be a subset of ``sel_aug``). Returns
+    ``(stats', deficient)``: transformed stats whose selected block is
+    the focal Schur complement, and the (S, T) control-block
+    rank-deficiency flag."""
+    gram, moment = stats.gram, stats.moment
+    dtype = gram.dtype
+    ctrl_rows = ctrl_aug[:, None, :, None]          # (S, 1, Q, 1)
+    b = jnp.where(ctrl_rows, gram, 0.0)             # rows C of G
+    m_c = jnp.where(ctrl_aug[:, None, :], moment, 0.0)
+    rhs = jnp.concatenate([b, m_c[..., None]], axis=-1)
+    z, deficient = masked_psd_solve(
+        gram, jnp.broadcast_to(ctrl_aug[:, None, :], gram.shape[:-1]),
+        rhs, data_eps,
+    )
+    z_g, z_y = z[..., :-1], z[..., -1]
+    g_proj = gram - jnp.einsum(
+        "stij,stik->stjk", b, z_g, precision=_PRECISION
+    )
+    m_proj = moment - jnp.einsum(
+        "stij,sti->stj", b, z_y, precision=_PRECISION
+    )
+    yy2 = stats.yy - jnp.einsum(
+        "sti,sti->st", m_c, z_y, precision=_PRECISION
+    )
+
+    fmask = sel_aug & ~ctrl_aug                      # focal columns only
+    f2 = fmask[:, None, :, None] & fmask[:, None, None, :]
+    g2 = jnp.where(f2, g_proj, 0.0)
+    g2 = g2.at[..., 0, 0].set(stats.n)
+    m2 = jnp.where(fmask[:, None, :], m_proj, 0.0)
+
+    # dof gate: a month must carry the FULL design (controls + focal) for
+    # the partialled solve to be the full regression's — zero out months
+    # that cannot, so month_valid sees them as empty.
+    q_total = sel_aug.sum(-1)                        # (S,)
+    ok = (stats.n >= q_total[:, None].astype(stats.n.dtype))
+    okf = ok.astype(dtype)
+    out = SpecGramStats(
+        gram=g2 * okf[..., None, None],
+        moment=m2 * okf[..., None],
+        n=stats.n * okf,
+        ysum=jnp.zeros_like(stats.ysum),
+        yy=jnp.maximum(yy2, 0.0) * okf,
+        center=jnp.zeros_like(stats.center),
+    )
+    return out, deficient & ok

@@ -1,0 +1,429 @@
+"""The estimator grid runner: one fused AOT program per estimator kind.
+
+``run_estimator_grid_weights`` is the estimator-family twin of
+``solve.run_spec_grid_weights`` — same panel inputs, same
+``Dict[weight -> SpecGridResult]`` shape out — with the estimator's
+Gram-stat transform spliced between the contraction and the padded
+solve:
+
+    contract (legacy or unique-pair factorized) → [upcast] →
+    estimator transform (fwl/iv/absorb) → padded eigh solve →
+    FM aggregation per weight/SE family
+
+Each kind gets its own ledger name (``estimator_program_fwl`` …) in the
+SHARED ``solve.PROGRAM_TRACES``/``solve.CONTRACTIONS`` counters and the
+same explicit AOT compile cache (``solve._compiled_grid_program``), so
+the bench's trace/contraction accounting and the registry provenance
+cover estimator programs exactly like the incumbent grid programs.
+
+Route discipline inherited wholesale:
+
+- the month-axis FACTORIZATION composes: fwl/iv contract per unique
+  (universe, effective-col_sel) pair and expand window masks at the
+  stats level (``expand_window_stats`` — the transform then runs on the
+  expanded per-spec stats, exact); absorb's cell contraction is per-spec
+  (its validity is per-spec) and stays legacy;
+- the CORESET route composes: ``row_weights`` flows into every
+  contraction (Gram, FE-cell, pooled meats);
+- precision policy: transforms run at solve precision (f64 under x64)
+  but every pinv/rank cutoff uses the eps of the dtype the stats were
+  CONTRACTED in (``contracted_eps`` — the solve's own rule);
+- NO QR referee: a partialled/absorbed/instrumented cell is a different
+  estimand than plain OLS, so the referee that would re-solve it with
+  OLS is structurally OFF (the ``row_weights`` precedent) and every
+  conditioning event is DISCLOSED — ``suspect_months`` plus the
+  per-kind disclosure dict (transform-level rank loss, absorb
+  iteration/convergence counts);
+- single-device only: the mesh/multiproc programs predate the estimator
+  transforms (the factorize='on' rule, one knob over).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fm_returnprediction_tpu.guard import checks as _guardchk
+from fm_returnprediction_tpu.ops.fama_macbeth import fama_macbeth_summary
+from fm_returnprediction_tpu.ops.ols import CSRegressionResult
+from fm_returnprediction_tpu.specgrid.grams import (
+    contract_spec_grams,
+    resolve_gram_factorize,
+    resolve_gram_precision,
+    resolve_gram_route,
+    unique_pairs,
+)
+from fm_returnprediction_tpu.specgrid.solve import (
+    CONTRACTIONS,
+    PROGRAM_TRACES,
+    SpecGridResult,
+    _compiled_grid_program,
+    _universe_stack,
+    expand_window_stats,
+    solve_spec_stats,
+)
+
+from .absorb import absorb_transform, contract_absorb_cells
+from .cluster import fm_cluster_summary, pooled_fit
+from .core import Estimator
+from .fwl import fwl_transform
+from .iv import iv_r2, iv_transform
+
+__all__ = ["run_estimator_grid_weights"]
+
+
+def _positions(union: Tuple[str, ...], names: Tuple[str, ...],
+               what: str) -> np.ndarray:
+    """(P,) bool mask of ``names`` inside the union predictor order —
+    loud on a name the union does not carry."""
+    mask = np.zeros(len(union), bool)
+    for nm in names:
+        if nm not in union:
+            raise KeyError(
+                f"estimator {what} column {nm!r} is not in the grid's "
+                f"union predictors {tuple(union)} — estimator columns "
+                "must ride the union tensor the contraction already has"
+            )
+        mask[union.index(nm)] = True
+    return mask
+
+
+def _upcast(stats):
+    """The solve's x64 upcast, applied BEFORE the transform so Schur
+    complements and projections run at solve precision."""
+    if not jax.config.jax_enable_x64 or stats.gram.dtype == jnp.float64:
+        return stats
+    return type(stats)(*(a.astype(jnp.float64) for a in stats))
+
+
+def _fm_tail(sol, stats_n, col_sel, out_dtype, *, weights, se,
+             nw_lags: int, min_months: int):
+    """SpecSolve → (cs, per-weight FM summaries) with the estimator's SE
+    family: ``"nw"`` is the incumbent aggregation, ``"iid"`` is lag-0,
+    ``"cluster"`` swaps in the by-year clustered kernel."""
+    slopes = jnp.where(col_sel[:, None, :], sol.beta[..., 1:], jnp.nan)
+    cs = CSRegressionResult(
+        slopes=slopes.astype(out_dtype),
+        intercept=sol.beta[..., 0].astype(out_dtype),
+        r2=sol.r2.astype(out_dtype),
+        n_obs=stats_n.astype(out_dtype),
+        month_valid=sol.month_valid,
+    )
+    if se == "cluster":
+        fms = tuple(
+            jax.vmap(lambda c: fm_cluster_summary(c, min_months=min_months))(
+                cs
+            )
+            for _ in weights
+        )
+    else:
+        lags = 0 if se == "iid" else nw_lags
+        fms = tuple(
+            jax.vmap(
+                lambda c, _w=w: fama_macbeth_summary(
+                    c, nw_lags=lags, min_months=min_months, weight=_w
+                )
+            )(cs)
+            for w in weights
+        )
+    return cs, fms
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kind", "se", "nw_lags", "min_months", "weights",
+                     "firm_chunk", "guard", "gram_route", "precision",
+                     "fact", "data_eps", "contracted_eps", "n_fe", "ga",
+                     "gb", "tol", "max_iter"),
+)
+def _estimator_program(
+    y, x, universes, uidx_c, col_sel_c, pair_idx, window, uidx, col_sel,
+    sel_aug, aux_sel, codes_a, codes_b, row_weights=None, *,
+    kind: str, se: str, nw_lags: int, min_months: int,
+    weights: Tuple[str, ...], firm_chunk: Optional[int], guard: bool,
+    gram_route: str, precision: str, fact: bool, data_eps: float,
+    contracted_eps: Optional[float], n_fe: int, ga: int, gb: int,
+    tol: float, max_iter: int,
+):
+    """Contraction + estimator transform + solve + aggregation — ONE
+    program per (kind, signature). ``uidx_c``/``col_sel_c`` drive the
+    contraction (pair-deduped under ``fact``); ``uidx``/``col_sel``/
+    ``sel_aug`` are the PER-SPEC selectors driving the cell contraction,
+    panel meats and solve; ``aux_sel`` is the kind's second block
+    (controls / instruments). Pooled ignores the FM tail statics and
+    returns its :class:`~.cluster.PooledResult`."""
+    PROGRAM_TRACES[f"estimator_program_{kind}"] += 1
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    record_trace(f"estimator_program_{kind}")
+    stats = contract_spec_grams(
+        y, x, universes, uidx_c, col_sel_c,
+        None if fact else window,
+        firm_chunk=firm_chunk, row_weights=row_weights,
+        route=gram_route, precision=precision,
+    )
+    if fact:
+        stats = expand_window_stats(stats, pair_idx, window)
+    stats = _upcast(stats)
+
+    if kind == "pooled":
+        res = pooled_fit(
+            stats, sel_aug, se, data_eps,
+            panel=(y, x, universes, uidx, col_sel, window),
+            row_weights=row_weights,
+        )
+        if guard:
+            counters = {
+                "pooled_nonfinite_beta": _guardchk.nonfinite_count(
+                    jnp.where(sel_aug, res.beta, 0.0)
+                ),
+            }
+            return res, counters
+        return res
+
+    extra = ()
+    if kind == "fwl":
+        stats2, deficient = fwl_transform(stats, sel_aug | aux_sel,
+                                          aux_sel, data_eps)
+    elif kind == "iv":
+        stats2, deficient = iv_transform(stats, sel_aug, aux_sel, data_eps)
+    elif kind == "absorb":
+        n_cells, s_cells = contract_absorb_cells(
+            y, x, universes, uidx, col_sel, window, stats.center,
+            codes_a, codes_b, row_weights=row_weights, ga=ga, gb=gb,
+        )
+        stats2, iters, delta = absorb_transform(
+            stats, sel_aug, n_cells, s_cells,
+            n_fe=n_fe, tol=tol, max_iter=max_iter,
+        )
+        deficient = jnp.zeros_like(stats2.n, bool)
+        extra = (iters, delta)
+    else:
+        raise ValueError(f"unknown estimator kind {kind!r}")
+
+    out = solve_spec_stats(stats2, sel_aug, guard=guard,
+                           contracted_eps=contracted_eps)
+    sol, counters = out if guard else (out, None)
+    if kind == "iv":
+        sol = sol._replace(r2=iv_r2(sol.beta, stats, sol.month_valid))
+    suspect = sol.suspect | (deficient & sol.month_valid)
+    cs, fms = _fm_tail(sol, stats2.n, col_sel, y.dtype, weights=weights,
+                       se=se, nw_lags=nw_lags, min_months=min_months)
+    if guard:
+        counters = dict(counters)
+        counters["transform_deficient_months"] = deficient.sum()
+        return (cs, fms, suspect, extra, counters)
+    return (cs, fms, suspect, extra)
+
+
+def run_estimator_grid_weights(
+    estimator: Estimator,
+    y,
+    x,
+    universe_masks: Dict[str, object],
+    grid,
+    weights: Tuple[str, ...],
+    firm_chunk: Optional[int] = None,
+    row_weights=None,
+    gram_route: Optional[str] = None,
+    precision: Optional[str] = None,
+    factorize: Optional[str] = None,
+    pair_pad: Optional[int] = None,
+    fe_codes: Optional[Dict[str, object]] = None,
+):
+    """Run a whole spec grid under one non-OLS estimator.
+
+    Returns ``(Dict[weight -> SpecGridResult], disclosures)`` — the same
+    result shape as ``run_spec_grid_weights`` (``referee_specs`` always
+    empty: estimator cells disclose, never referee) plus the estimator's
+    per-spec disclosure arrays (``deficient_months``; absorb adds
+    ``absorb_iters``/``absorb_converged``). ``fe_codes`` maps FE names →
+    (T, N) int code arrays (absorb kinds only)."""
+    est = estimator
+    if est.kind == "ols":
+        raise ValueError(
+            "kind='ols' is the incumbent grid path — call "
+            "run_spec_grid_weights (the engine routes it there)"
+        )
+    gram_route = resolve_gram_route(gram_route)
+    precision = resolve_gram_precision(precision)
+    factorize = resolve_gram_factorize(factorize)
+    guard = _guardchk.guard_active()
+    names = list(universe_masks)
+    y = jnp.asarray(y)
+    x = jnp.asarray(x)
+    universes = _universe_stack(universe_masks, names)
+    t = y.shape[0]
+    union = tuple(grid.union_predictors)
+    uidx_np = grid.universe_index(names)
+    col_sel_np = grid.column_selector()
+    window_np = grid.window_masks(t)
+    s_specs = int(col_sel_np.shape[0])
+    if row_weights is not None:
+        row_weights = jnp.asarray(row_weights, x.dtype)
+
+    # --- per-kind column blocks -----------------------------------------
+    ones = np.ones((s_specs, 1), bool)
+    codes_a = codes_b = jnp.zeros((1, 1), jnp.int32)
+    ga = gb = 1
+    n_fe = 0
+    col_sel_solve = col_sel_np
+    aux_sel_np = np.concatenate([ones, col_sel_np], axis=1)  # placeholder
+    col_sel_contract = col_sel_np
+    if est.kind == "fwl":
+        ctrl = _positions(union, est.controls, "control")
+        col_sel_contract = col_sel_np | ctrl[None, :]
+        col_sel_solve = col_sel_np & ~ctrl[None, :]
+        aux_sel_np = np.concatenate(
+            [ones, np.broadcast_to(ctrl, col_sel_np.shape)], axis=1
+        )
+    elif est.kind == "iv":
+        endog = _positions(union, est.endog, "endogenous")
+        inst = _positions(union, est.instruments, "instrument")
+        if (endog & inst).any():
+            raise ValueError(
+                "a column cannot be both endogenous and an instrument"
+            )
+        col_sel_contract = col_sel_np | inst[None, :]
+        aux_sel_np = np.concatenate(
+            [ones, (col_sel_np & ~endog[None, :]) | inst[None, :]], axis=1
+        )
+    elif est.kind == "absorb":
+        fe_codes = fe_codes or {}
+        missing = [nm for nm in est.absorb if nm not in fe_codes]
+        if missing:
+            raise KeyError(
+                f"absorb FE codes not supplied for {missing} — pass "
+                "fe_codes={name: (T, N) int codes}"
+            )
+        n_fe = len(est.absorb)
+        ca = np.asarray(fe_codes[est.absorb[0]])
+        ga = int(ca.max()) + 1
+        codes_a = jnp.asarray(ca, jnp.int32)
+        if n_fe == 2:
+            cb = np.asarray(fe_codes[est.absorb[1]])
+            gb = int(cb.max()) + 1
+            codes_b = jnp.asarray(cb, jnp.int32)
+        else:
+            codes_b = jnp.zeros_like(codes_a)
+    sel_aug_np = np.concatenate([ones, col_sel_solve], axis=1)
+
+    # --- contraction plan (factorization composes for the Gram kinds) ---
+    fact_ok = est.kind in ("fwl", "iv", "pooled") and factorize != "off"
+    use_fact = False
+    if fact_ok:
+        k_unique = int(
+            unique_pairs(uidx_np, col_sel_contract)[0].shape[0]
+        )
+        use_fact = factorize == "on" or k_unique < s_specs
+    CONTRACTIONS["specs_solved"] += s_specs
+    if use_fact:
+        uidx_u, col_sel_u, pair_idx_np = unique_pairs(
+            uidx_np, col_sel_contract, pad_to=pair_pad
+        )
+        CONTRACTIONS["pairs_unique"] += k_unique
+        CONTRACTIONS["pairs_contracted"] += int(uidx_u.shape[0])
+        uidx_c, col_sel_c = jnp.asarray(uidx_u), jnp.asarray(col_sel_u)
+        pair_idx = jnp.asarray(pair_idx_np)
+    else:
+        CONTRACTIONS["specs_contracted"] += s_specs
+        uidx_c, col_sel_c = jnp.asarray(uidx_np), jnp.asarray(col_sel_contract)
+        pair_idx = jnp.arange(s_specs)
+
+    # precision policy: cutoffs at the eps the stats were CONTRACTED in
+    panel_eps = float(jnp.finfo(jnp.bfloat16).eps) if precision == "bf16" \
+        else float(jnp.finfo(x.dtype).eps)
+    upcasts = (jax.config.jax_enable_x64 and x.dtype != jnp.float64)
+    contracted_eps = panel_eps if (precision == "bf16" or upcasts) else None
+
+    static_kwargs = dict(
+        kind=est.kind, se=est.se, nw_lags=grid.nw_lags,
+        min_months=grid.min_months, weights=tuple(weights),
+        firm_chunk=firm_chunk, guard=guard, gram_route=gram_route,
+        precision=precision, fact=use_fact, data_eps=panel_eps,
+        contracted_eps=contracted_eps, n_fe=n_fe, ga=ga, gb=gb,
+        tol=float(est.absorb_tol), max_iter=int(est.absorb_iters),
+    )
+    program_args = (
+        y, x, universes, uidx_c, col_sel_c, pair_idx,
+        jnp.asarray(window_np), jnp.asarray(uidx_np),
+        jnp.asarray(col_sel_solve),
+        jnp.asarray(sel_aug_np), jnp.asarray(aux_sel_np),
+        codes_a, codes_b, row_weights,
+    )
+    exe = _compiled_grid_program(
+        program_args, static_kwargs, fn=_estimator_program,
+        program=f"estimator_program_{est.kind}",
+    )
+    out = jax.device_get(exe(*program_args))
+
+    disclosures: Dict[str, object] = {
+        "estimator": est.label, "kind": est.kind, "se_family": est.se,
+    }
+    results: Dict[str, SpecGridResult] = {}
+    p = x.shape[-1]
+    if est.kind == "pooled":
+        res, counters = out if guard else (out, None)
+        if guard:
+            _guardchk.record("specgrid.estimator_program", counters)
+        n_months = np.asarray(res.n_months).astype(np.int64)
+        mean_n = np.divide(
+            np.asarray(res.n_total, float), np.maximum(n_months, 1),
+            where=n_months > 0,
+            out=np.full(n_months.shape, np.nan),
+        )
+        deficient = np.asarray(res.deficient, bool)
+        disclosures["deficient_months"] = deficient.astype(np.int64)
+        nan_st = np.full((s_specs, t), np.nan)
+        for w in weights:
+            results[w] = SpecGridResult(
+                slopes=np.full((s_specs, t, p), np.nan),
+                intercept=np.broadcast_to(
+                    np.asarray(res.beta[:, 0], float)[:, None],
+                    (s_specs, t),
+                ).copy(),
+                r2=nan_st.copy(),
+                n_obs=nan_st.copy(),
+                month_valid=np.zeros((s_specs, t), bool),
+                coef=np.asarray(res.beta[:, 1:], float),
+                tstat=np.asarray(res.tstat[:, 1:], float),
+                nw_se=np.asarray(res.se[:, 1:], float),
+                mean_r2=np.asarray(res.r2, float),
+                mean_n=mean_n,
+                n_months=n_months,
+                suspect_months=deficient.astype(np.int64),
+                referee_specs=(),
+            )
+        return results, disclosures
+
+    if guard:
+        cs, fms, suspect, extra, counters = out
+        _guardchk.record("specgrid.estimator_program", counters)
+    else:
+        cs, fms, suspect, extra = out
+    suspect_months = np.asarray(suspect).sum(axis=1).astype(np.int64)
+    disclosures["deficient_months"] = suspect_months
+    if est.kind == "absorb":
+        iters, delta = extra
+        iters = np.asarray(iters)
+        delta = np.asarray(delta)
+        month_valid = np.asarray(cs.month_valid, bool)
+        disclosures["absorb_iters"] = np.where(
+            month_valid, iters, 0
+        ).max(axis=1).astype(np.int64)
+        disclosures["absorb_converged"] = np.asarray(
+            ((delta <= est.absorb_tol) | ~month_valid).all(axis=1)
+        )
+    for w, fm in zip(weights, fms):
+        results[w] = SpecGridResult(
+            np.array(cs.slopes), np.array(cs.intercept), np.array(cs.r2),
+            np.array(cs.n_obs), np.array(cs.month_valid),
+            np.array(fm.coef), np.array(fm.tstat), np.array(fm.nw_se),
+            np.array(fm.mean_r2), np.array(fm.mean_n),
+            np.array(fm.n_months), suspect_months.copy(), (),
+        )
+    return results, disclosures

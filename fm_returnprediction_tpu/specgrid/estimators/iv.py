@@ -1,0 +1,107 @@
+"""IV/2SLS as two Gram solves over an instrument block.
+
+2SLS solves ``X̂'X̂ β = X̂'y`` with ``X̂ = P_Z X`` — and every entry of
+that projected system is a quadratic form in the month's augmented Gram
+over the UNION of structural and instrument columns:
+
+    Ĝ_jk = G_jZ G_ZZ⁻¹ G_Zk        (first stage, one masked solve)
+    m̂_j  = G_jZ G_ZZ⁻¹ m_Z
+
+with Z = {intercept} ∪ (exogenous structural columns) ∪ instruments.
+Exogenous columns project onto themselves (they sit inside Z), so only
+the endogenous block actually moves; the intercept row is overwritten
+with the ORIGINAL Gram row (exact, since the constant is in Z) to keep
+the solve's centering algebra untouched. The structural solve is then
+the ordinary padded eigh on the projected stats — no new solver.
+
+The one thing the projected stats get WRONG is R²: the solve's
+``sse = yy − 2β'm + β'Gβ`` would use the projected system, i.e. the
+FIRST-STAGE fitted values' residuals, where 2SLS residuals are defined
+against the RAW regressors (y − Xβ, not y − X̂β). :func:`iv_r2`
+recomputes the quadratic form against the original stats after the
+solve; the engine swaps it in. (2SLS R² can be legitimately negative —
+it is reported as-is, not clamped.)
+
+Identification is enforced statically (#instruments ≥ #endogenous, at
+``Estimator`` construction) and numerically per month: instrument-block
+rank loss at the eigh cutoff → ``deficient`` disclosure, and months with
+fewer rows than max(#Z, #X) columns are zeroed to fail ``month_valid``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+
+from .core import _PRECISION, masked_psd_solve
+
+__all__ = ["iv_transform", "iv_r2"]
+
+
+def iv_transform(stats: SpecGramStats, sel_aug, z_aug, data_eps: float):
+    """Project every (spec, month) Gram onto the instrument block.
+
+    ``sel_aug`` (S, Q) bool — structural selection (intercept + exogenous
+    + endogenous); ``z_aug`` (S, Q) bool — instrument selection
+    (intercept + exogenous + instruments). Returns ``(stats',
+    deficient)`` with the projected Gram/moment in the structural block
+    and the (S, T) instrument-block rank flag."""
+    gram, moment = stats.gram, stats.moment
+    dtype = gram.dtype
+    z_rows = z_aug[:, None, :, None]
+    bz = jnp.where(z_rows, gram, 0.0)
+    m_z = jnp.where(z_aug[:, None, :], moment, 0.0)
+    rhs = jnp.concatenate([bz, m_z[..., None]], axis=-1)
+    w, deficient = masked_psd_solve(
+        gram, jnp.broadcast_to(z_aug[:, None, :], gram.shape[:-1]),
+        rhs, data_eps,
+    )
+    w_g, w_y = w[..., :-1], w[..., -1]
+    g_hat = jnp.einsum("stij,stik->stjk", bz, w_g, precision=_PRECISION)
+    m_hat = jnp.einsum("stij,sti->stj", bz, w_y, precision=_PRECISION)
+
+    x2 = sel_aug[:, None, :, None] & sel_aug[:, None, None, :]
+    g2 = jnp.where(x2, g_hat, 0.0)
+    m2 = jnp.where(sel_aug[:, None, :], m_hat, 0.0)
+    # the constant is inside Z, so its projection is itself — restore the
+    # original intercept row/col (and x'1 = n) exactly rather than through
+    # a solve round-trip, keeping the centering algebra bit-honest.
+    row0 = jnp.where(sel_aug[:, None, :], gram[..., 0, :], 0.0)
+    g2 = g2.at[..., 0, :].set(row0).at[..., :, 0].set(row0)
+    g2 = g2.at[..., 0, 0].set(stats.n)
+    m2 = m2.at[..., 0].set(moment[..., 0])
+
+    q_total = jnp.maximum(z_aug.sum(-1), sel_aug.sum(-1))      # (S,)
+    ok = stats.n >= q_total[:, None].astype(stats.n.dtype)
+    okf = ok.astype(dtype)
+    out = SpecGramStats(
+        gram=g2 * okf[..., None, None],
+        moment=m2 * okf[..., None],
+        n=stats.n * okf,
+        # ysum/yy/center stay RAW: intercept recovery and iv_r2 both run
+        # against the original y geometry.
+        ysum=stats.ysum,
+        yy=stats.yy,
+        center=stats.center,
+    )
+    return out, deficient & ok
+
+
+def iv_r2(beta, stats: SpecGramStats, month_valid):
+    """2SLS R² against the RAW regressors: re-center the solved betas
+    (``beta`` (S, T, Q), raw intercept first — ``SpecSolve.beta``) and
+    evaluate ``sse = yy − 2β'm + β'Gβ`` on the ORIGINAL stats. Zeros
+    outside each spec's selection make masking unnecessary."""
+    a_c = beta[..., 0] + jnp.einsum(
+        "stp,tp->st", beta[..., 1:], stats.center, precision=_PRECISION
+    )
+    beta_c = jnp.concatenate([a_c[..., None], beta[..., 1:]], axis=-1)
+    bg = jnp.einsum("...p,...pq,...q->...", beta_c, stats.gram, beta_c,
+                    precision=_PRECISION)
+    bm = jnp.einsum("...p,...p->...", beta_c, stats.moment,
+                    precision=_PRECISION)
+    sse = stats.yy - 2.0 * bm + bg
+    sst = stats.yy - stats.ysum * stats.ysum / jnp.maximum(stats.n, 1.0)
+    r2 = jnp.where(sst > 0, 1.0 - sse / jnp.where(sst > 0, sst, 1.0), 0.0)
+    return jnp.where(month_valid, r2, 0.0)

@@ -73,6 +73,7 @@ __all__ = [
     "window_query",
     "bootstrap_query",
     "scenario_query",
+    "estimator_query",
 ]
 
 BANK_NAME = "gram_bank"
@@ -612,6 +613,241 @@ def bootstrap_query(
                    for k in range(bank.n_pairs)]
 
 
+# -- estimator queries (ISSUE 16: estimator kinds served from the bank) ------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kind", "se", "nw_lags", "min_months", "weights",
+                     "data_eps", "contracted_eps"),
+)
+def _bank_estimator_program(gram, moment, n, ysum, yy, center, sel_aug,
+                            aux_sel, col_sel, window, *, kind: str, se: str,
+                            nw_lags: int, min_months: int,
+                            weights: Tuple[str, ...], data_eps: float,
+                            contracted_eps: Optional[float]):
+    """ONE fused program per (estimator kind, query shape): window-mask
+    the banked stats, run the kind's Gram-stat transform (FWL Schur
+    complement / IV two-solve / pooled month-sum — never the panel), then
+    the padded solve + SE-family tail. The estimator twin of
+    ``_bank_query_program``; the (T, N, P) panel never appears."""
+    from fm_returnprediction_tpu.specgrid.estimators.cluster import (
+        pooled_fit,
+    )
+    from fm_returnprediction_tpu.specgrid.estimators.fwl import fwl_transform
+    from fm_returnprediction_tpu.specgrid.estimators.grid import (
+        _fm_tail,
+        _upcast,
+    )
+    from fm_returnprediction_tpu.specgrid.estimators.iv import (
+        iv_r2,
+        iv_transform,
+    )
+    from fm_returnprediction_tpu.specgrid.solve import (
+        PROGRAM_TRACES,
+        expand_window_stats,
+        solve_spec_stats,
+    )
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["grambank_estimator_query"] += 1
+    record_trace("grambank_estimator_query")
+    stats = SpecGramStats(gram, moment, n, ysum, yy, center)
+    k = gram.shape[0]
+    masked = _upcast(expand_window_stats(stats, jnp.arange(k), window))
+    if kind == "pooled":
+        return pooled_fit(masked, sel_aug, se, data_eps, panel=None)
+    if kind == "fwl":
+        stats2, deficient = fwl_transform(masked, sel_aug | aux_sel,
+                                          aux_sel, data_eps)
+    elif kind == "iv":
+        stats2, deficient = iv_transform(masked, sel_aug, aux_sel, data_eps)
+    else:  # ols under a non-default SE family: solve banked stats as-is
+        stats2, deficient = masked, jnp.zeros_like(masked.n, bool)
+    sol = solve_spec_stats(stats2, sel_aug, contracted_eps=contracted_eps)
+    if kind == "iv":
+        sol = sol._replace(r2=iv_r2(sol.beta, masked, sol.month_valid))
+    suspect = sol.suspect | (deficient & sol.month_valid)
+    cs, fms = _fm_tail(sol, stats2.n, col_sel, gram.dtype, weights=weights,
+                       se=se, nw_lags=nw_lags, min_months=min_months)
+    return cs, fms, suspect
+
+
+def estimator_query(
+    bank: GramBank,
+    estimator,
+    window=None,
+    nw_lags: Optional[int] = None,
+    min_months: Optional[int] = None,
+    weight: str = "reference",
+) -> Tuple[BankQueryResult, Dict[str, object]]:
+    """One estimator cell for every banked pair, answered ENTIRELY from
+    the banked month-axis Gram stats — the ``window_query`` of the
+    estimator subsystem. ``estimator`` is an
+    :class:`~fm_returnprediction_tpu.specgrid.estimators.Estimator`, a
+    spec string (``"fwl:beme+mom@iid"``), or None (env/default via
+    ``resolve_estimator``). Returns ``(result, disclosures)`` where
+    ``disclosures["col_sel"]`` is the (K, P) selection actually SOLVED
+    (focal columns under FWL; structural under IV).
+
+    Bank-servable kinds and their honest limits:
+
+    - ``ols``/``fwl``/``iv`` — exact Gram-stat transforms of the banked
+      leaves; zero panel contractions (ledger-provable: only
+      ``grambank_estimator_query`` traces, ``CONTRACTIONS`` untouched).
+    - ``pooled`` — servable for the month-separable SE families only
+      (:data:`~fm_returnprediction_tpu.specgrid.estimators.BANK_POOLED_SE`);
+      firm/White/two-way meats need firm-level residuals the bank does
+      not hold, so those raise loudly here (run the grid route).
+    - ``absorb`` — RAISES: alternating projections need per-(month, FE
+      cell) sufficient stats the bank does not carry. Banking FE cells
+      would multiply the bank by the FE cardinality; re-contract instead.
+
+    Under IV the banked pair columns are read as structural ∪ EXCLUDED
+    instruments: ``instruments`` are removed from the structural
+    selection. Every control/instrument must be banked in EVERY pair —
+    a pair that never contracted the column cannot answer (loud, with
+    the offending pairs named)."""
+    from fm_returnprediction_tpu.specgrid.estimators.cluster import (
+        BANK_POOLED_SE,
+    )
+    from fm_returnprediction_tpu.specgrid.estimators.core import (
+        resolve_estimator,
+    )
+
+    est = resolve_estimator(estimator)
+    if est.kind == "absorb":
+        raise ValueError(
+            f"estimator {est.label!r} cannot be served from the gram "
+            "bank: absorbed FE needs per-(month, FE-cell) sufficient "
+            "stats the bank does not carry — run "
+            "run_estimator_grid_weights on the panel instead"
+        )
+    if est.kind == "pooled" and est.se not in BANK_POOLED_SE:
+        raise ValueError(
+            f"pooled SE family {est.se!r} needs firm-level residual "
+            f"scores the bank does not hold; bank-servable families are "
+            f"{BANK_POOLED_SE} — run the grid route for the rest"
+        )
+    nw_lags = int(bank.meta.get("nw_lags", 4) if nw_lags is None
+                  else nw_lags)
+    min_months = int(bank.meta.get("min_months", 10) if min_months is None
+                     else min_months)
+    union = bank.union
+    pos = {c: i for i, c in enumerate(union)}
+
+    def _mask(names, what):
+        m = np.zeros(len(union), bool)
+        for nm in names:
+            if nm not in pos:
+                raise KeyError(
+                    f"estimator {what} column {nm!r} is not in the "
+                    f"bank's union {tuple(union)}"
+                )
+            m[pos[nm]] = True
+        return m
+
+    def _require_banked(m, what):
+        lacking = [bank.pair_labels[k]
+                   for k in range(bank.n_pairs)
+                   if not (m <= bank.col_sel[k]).all()]
+        if lacking:
+            raise ValueError(
+                f"estimator {what} columns were not contracted into "
+                f"every banked pair — pairs lacking them: {lacking}; "
+                "rebuild the bank with the columns in each regressor set"
+            )
+
+    col_sel = np.asarray(bank.col_sel, bool)
+    ones = np.ones((bank.n_pairs, 1), bool)
+    aux_sel = np.concatenate([ones, col_sel], axis=1)  # placeholder
+    sel_solve = col_sel
+    if est.kind == "fwl":
+        ctrl = _mask(est.controls, "control")
+        _require_banked(ctrl, "control")
+        sel_solve = col_sel & ~ctrl[None, :]
+        aux_sel = np.concatenate(
+            [ones, np.broadcast_to(ctrl, col_sel.shape)], axis=1
+        )
+    elif est.kind == "iv":
+        inst = _mask(est.instruments, "instrument")
+        endog = _mask(est.endog, "endogenous")
+        _require_banked(inst, "instrument")
+        _require_banked(endog, "endogenous")
+        sel_solve = col_sel & ~inst[None, :]
+        aux_sel = np.concatenate(
+            [ones, (sel_solve & ~endog[None, :]) | inst[None, :]], axis=1
+        )
+    sel_aug = np.concatenate([ones, sel_solve], axis=1)
+
+    # precision policy — cutoffs at the eps the bank was CONTRACTED in
+    precision = str(bank.meta.get("precision", "highest"))
+    bank_dtype = np.dtype(bank.dtype)
+    panel_eps = float(jnp.finfo(jnp.bfloat16).eps) if precision == "bf16" \
+        else float(np.finfo(bank_dtype).eps)
+    upcasts = (jax.config.jax_enable_x64 and bank_dtype != np.float64)
+    contracted_eps = panel_eps if (precision == "bf16" or upcasts) else None
+
+    mask = _window_mask(bank, window)
+    win = jnp.asarray(np.broadcast_to(mask, (bank.n_pairs, bank.n_months)))
+    s = bank.stats()
+    out = jax.device_get(_bank_estimator_program(
+        s.gram, s.moment, s.n, s.ysum, s.yy, s.center,
+        jnp.asarray(sel_aug), jnp.asarray(aux_sel), jnp.asarray(sel_solve),
+        win, kind=est.kind, se=est.se, nw_lags=nw_lags,
+        min_months=min_months, weights=(str(weight),),
+        data_eps=panel_eps, contracted_eps=contracted_eps,
+    ))
+    disclosures: Dict[str, object] = {
+        "estimator": est.label, "kind": est.kind, "se_family": est.se,
+        "col_sel": sel_solve,
+    }
+    k, t = bank.n_pairs, bank.n_months
+    p = len(union)
+    if est.kind == "pooled":
+        res = out
+        deficient = np.asarray(res.deficient, bool)
+        n_months = np.asarray(res.n_months).astype(np.int64)
+        disclosures["deficient_months"] = deficient.astype(np.int64)
+        nan_kt = np.full((k, t), np.nan)
+        result = BankQueryResult(
+            slopes=np.full((k, t, p), np.nan),
+            r2=nan_kt.copy(),
+            n_obs=nan_kt.copy(),
+            month_valid=np.zeros((k, t), bool),
+            coef=np.asarray(res.beta[:, 1:], float),
+            tstat=np.asarray(res.tstat[:, 1:], float),
+            nw_se=np.asarray(res.se[:, 1:], float),
+            mean_r2=np.asarray(res.r2, float),
+            mean_n=np.divide(
+                np.asarray(res.n_total, float), np.maximum(n_months, 1),
+                where=n_months > 0,
+                out=np.full(n_months.shape, np.nan),
+            ),
+            n_months=n_months,
+            suspect_months=deficient.astype(np.int64),
+        )
+        return result, disclosures
+    cs, fms, suspect = out
+    fm = fms[0]
+    suspect_months = np.asarray(suspect).sum(axis=1).astype(np.int64)
+    disclosures["deficient_months"] = suspect_months
+    result = BankQueryResult(
+        slopes=np.asarray(cs.slopes),
+        r2=np.asarray(cs.r2),
+        n_obs=np.asarray(cs.n_obs),
+        month_valid=np.asarray(cs.month_valid),
+        coef=np.asarray(fm.coef),
+        tstat=np.asarray(fm.tstat),
+        nw_se=np.asarray(fm.nw_se),
+        mean_r2=np.asarray(fm.mean_r2),
+        mean_n=np.asarray(fm.mean_n),
+        n_months=np.asarray(fm.n_months),
+        suspect_months=suspect_months,
+    )
+    return result, disclosures
+
+
 def scenario_query(
     bank: GramBank,
     windows: Optional[Dict[str, object]] = None,
@@ -619,6 +855,7 @@ def scenario_query(
     seed: int = 0,
     weights: Sequence[str] = ("reference",),
     label_of: Optional[Dict[str, str]] = None,
+    estimator=None,
 ) -> pd.DataFrame:
     """The scenarios path over banked stats: a tidy frame in the
     ``run_scenarios`` row schema (model/universe/window/nw_weight/
@@ -626,21 +863,63 @@ def scenario_query(
     per (window, weight, draw) from the bank — a new-window or
     new-bootstrap scenario sweep with ZERO panel reads. No QR referee
     exists here, so ``refereed`` is always False and ``suspect_months``
-    carries the disclosure instead."""
+    carries the disclosure instead.
+
+    ``estimator`` (None = incumbent OLS@NW path) sweeps the whole
+    scenario grid under a bank-servable estimator cell
+    (:func:`estimator_query` — ols/fwl/iv plus month-separable pooled);
+    rows then carry ``estimator``/``se_family`` columns, and bootstrap
+    draws resample the transformed per-month slope series (pooled has no
+    month series, so pooled + ``bootstrap > 1`` raises)."""
     windows = windows if windows is not None else {"full": None}
     label_of = label_of or {}
     rows = []
     union = bank.union
+    est = None
+    if estimator is not None:
+        from fm_returnprediction_tpu.specgrid.estimators.core import (
+            resolve_estimator,
+        )
+
+        est = resolve_estimator(estimator)
+        if est.kind == "pooled" and bootstrap > 1:
+            raise ValueError(
+                "pooled estimator cells produce no per-month slope "
+                "series to resample — bootstrap must be 1"
+            )
     for win_name, window in windows.items():
         for w in weights:
-            if bootstrap > 1:
+            est_sel = None
+            if est is not None:
+                point, disc = estimator_query(bank, est, window, weight=w)
+                est_sel = disc["col_sel"]
+                draw_stacks = None
+                if bootstrap > 1:
+                    from fm_returnprediction_tpu.specgrid.boot import (
+                        bootstrap_aggregate_pairs,
+                        resample_matrix,
+                    )
+
+                    idx = resample_matrix(bank.n_months, int(bootstrap),
+                                          seed=seed)
+                    mask = _window_mask(bank, window)
+                    stacked = bootstrap_aggregate_pairs(
+                        point.slopes, point.r2, point.n_obs,
+                        point.month_valid & mask[None, :], idx,
+                        int(bank.meta.get("nw_lags", 4)),
+                        int(bank.meta.get("min_months", 10)), w,
+                    )
+                    draw_stacks = [tuple(leaf[k] for leaf in stacked)
+                                   for k in range(bank.n_pairs)]
+            elif bootstrap > 1:
                 point, draw_stacks = bootstrap_query(
                     bank, bootstrap, window, seed=seed, weight=w)
             else:
                 point = window_query(bank, window, weight=w)
                 draw_stacks = None
             for k, (set_name, uni) in enumerate(bank.pair_labels):
-                pos = np.flatnonzero(bank.col_sel[k])
+                pos = np.flatnonzero(
+                    bank.col_sel[k] if est_sel is None else est_sel[k])
                 for d in range(int(bootstrap)):
                     if d == 0:
                         coef, tstat, nw_se = (point.coef[k], point.tstat[k],
@@ -672,6 +951,9 @@ def scenario_query(
                             "suspect_months": int(point.suspect_months[k]),
                             "source": "bank",
                         }
+                        if est is not None:
+                            r["estimator"] = est.label
+                            r["se_family"] = est.se
                         if bootstrap > 1:
                             r["draw"] = d
                         rows.append(r)

@@ -201,6 +201,8 @@ def run_scenarios(
     precision: Optional[str] = None,
     factorize: Optional[str] = None,
     boot_route: Optional[str] = None,
+    estimators=None,
+    fe_codes: Optional[Dict[str, object]] = None,
 ):
     """The scenario sweep: one tidy row per (cell, predictor).
 
@@ -224,6 +226,12 @@ def run_scenarios(
     dimension until the space holds at least N cells (the pod-scale knob
     ``--specgrid-cells`` rides). ``mesh`` (or ``FMRP_SPECGRID_MESH``)
     routes the solve through the declarative sharded path.
+
+    ``estimators`` (ISSUE 16) adds the estimator dimension to the sweep:
+    a sequence of ``estimators.Estimator`` values or spec strings
+    (``"fwl:beme@iid"`` — ``parse_estimator`` grammar); None keeps the
+    incumbent OLS@NW-only space and the incumbent row schema. ``fe_codes``
+    maps FE names → (T, N) int code arrays for ``absorb`` cells.
     """
     from fm_returnprediction_tpu.models.lewellen import MODELS
     from fm_returnprediction_tpu.specgrid.cellspace import scenario_space
@@ -234,10 +242,21 @@ def run_scenarios(
     label_of = {col: label for label, col in variables_dict.items()}
 
     t = len(panel.months)
+    est_kwargs = {}
+    if estimators is not None:
+        from fm_returnprediction_tpu.specgrid.estimators import (
+            Estimator,
+            parse_estimator,
+        )
+
+        est_kwargs["estimators"] = tuple(
+            e if isinstance(e, Estimator) else parse_estimator(str(e))
+            for e in estimators
+        )
     space = scenario_space(
         variables_dict, universes, t, models=models, subperiods=subperiods,
         winsor_levels=winsor_levels, weights=weights, bootstrap=bootstrap,
-        nw_lags=nw_lags, min_months=min_months,
+        nw_lags=nw_lags, min_months=min_months, **est_kwargs,
     )
     if cells is not None and cells > len(space):
         # grow the draw dimension (the only one that scales freely) until
@@ -255,7 +274,7 @@ def run_scenarios(
         referee=referee, mask=jnp.asarray(panel.mask), label_of=label_of,
         seed=seed, coreset_m=coreset_m, coreset_budget_mb=coreset_budget_mb,
         output_dir=output_dir, gram_route=gram_route, precision=precision,
-        factorize=factorize, boot_route=boot_route,
+        factorize=factorize, boot_route=boot_route, fe_codes=fe_codes,
     )
     if return_stats:
         return frame, stats
@@ -312,6 +331,7 @@ def run_scenarios_banked(
     seed: int = 0,
     weights: Sequence[str] = ("reference",),
     variables_dict: Optional[Dict[str, str]] = None,
+    estimator=None,
 ) -> pd.DataFrame:
     """The scenarios path over BANKED stats: a tidy frame in the
     ``run_scenarios`` row schema, answered entirely from the bank's
@@ -320,12 +340,18 @@ def run_scenarios_banked(
     scenario-query latency leg). ``windows`` defaults to the full sample;
     pass ``subperiod_windows(bank.n_months, pieces)`` for fresh splits.
     No QR referee runs here (the panel is not read): ``refereed`` is
-    always False and ``suspect_months`` carries the disclosure."""
+    always False and ``suspect_months`` carries the disclosure.
+
+    ``estimator`` sweeps the banked scenarios under a bank-servable
+    estimator cell (``grambank.estimator_query`` — ols/fwl/iv plus the
+    month-separable pooled families), still with ZERO panel
+    contractions; absorb kinds raise there (the bank holds no FE-cell
+    stats)."""
     from fm_returnprediction_tpu.specgrid.grambank import scenario_query
 
     label_of = ({col: label for label, col in variables_dict.items()}
                 if variables_dict else None)
     return scenario_query(
         bank, windows=windows, bootstrap=bootstrap, seed=seed,
-        weights=weights, label_of=label_of,
+        weights=weights, label_of=label_of, estimator=estimator,
     )

@@ -39,6 +39,15 @@ def main(argv=None) -> int:
                         help="specgrid task streaming sink (default "
                              "follows FMRP_SPECGRID_SINK, else the full "
                              "tidy frame)")
+    parser.add_argument("--specgrid-estimator", default=None,
+                        metavar="SPEC",
+                        help="run the specgrid sweep under an estimator "
+                             "cell instead of OLS@NW — grammar "
+                             "'fwl:c1+c2[@se]' | 'absorb:fe1+fe2' | "
+                             "'iv:endog~z1+z2' | 'pooled[:se]' (default "
+                             "follows FMRP_SPECGRID_ESTIMATOR; the "
+                             "Table-2/figure parity surfaces keep "
+                             "rejecting non-OLS loudly)")
     parser.add_argument("--notebooks", action="store_true",
                         help="include the notebook conversion/execution tasks")
     parser.add_argument("--db", default=None, help="state db path")
@@ -89,7 +98,8 @@ def main(argv=None) -> int:
 
     tasks = build_tasks(synthetic=args.synthetic,
                         specgrid_cells=args.specgrid_cells,
-                        specgrid_sink=args.specgrid_sink)
+                        specgrid_sink=args.specgrid_sink,
+                        specgrid_estimator=args.specgrid_estimator)
     if args.notebooks:
         tasks += build_notebook_tasks()
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"

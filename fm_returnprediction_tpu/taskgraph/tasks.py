@@ -377,17 +377,27 @@ SPECGRID_KNOBS_FILE = "specgrid_scenarios.knobs.json"
 
 
 def _specgrid_effective_knobs(cells: Optional[int],
-                              sink: Optional[str]) -> dict:
+                              sink: Optional[str],
+                              estimator: Optional[str] = None) -> dict:
     """The knobs that shape the artifact: cell count + RESOLVED sink name
-    (CLI argument or ``FMRP_SPECGRID_SINK`` — tile width is excluded
-    deliberately, tiling is pinned bit-identical on the frame)."""
+    (CLI argument or ``FMRP_SPECGRID_SINK``) + RESOLVED estimator cell
+    (``--specgrid-estimator`` or ``FMRP_SPECGRID_ESTIMATOR`` — a
+    partialled/absorbed/IV frame must never be served as an OLS one).
+    Tile width is excluded deliberately; tiling is pinned bit-identical
+    on the frame."""
+    from fm_returnprediction_tpu.specgrid.estimators import (
+        resolve_estimator,
+    )
     from fm_returnprediction_tpu.specgrid.sinks import resolve_sink_name
 
-    return {"cells": cells, "sink": resolve_sink_name(sink)}
+    est = resolve_estimator(estimator)
+    return {"cells": cells, "sink": resolve_sink_name(sink),
+            "estimator": f"{est.label}@{est.se}"}
 
 
 def _specgrid_knobs_unchanged(output_dir: Path, cells: Optional[int],
-                              sink: Optional[str]) -> bool:
+                              sink: Optional[str],
+                              estimator: Optional[str] = None) -> bool:
     """``uptodate`` check: the cached CSV only counts as current when the
     knobs it was BUILT under (sidecar written by ``_specgrid``) match this
     invocation's effective knobs — a knob change in either direction
@@ -395,38 +405,49 @@ def _specgrid_knobs_unchanged(output_dir: Path, cells: Optional[int],
     CSV would be served as the tidy scenario frame by a later default
     run. A missing sidecar reads as a default-knob build (pre-sidecar
     artifacts were only ever default)."""
-    want = _specgrid_effective_knobs(cells, sink)
+    want = _specgrid_effective_knobs(cells, sink, estimator)
     try:
         with open(Path(output_dir) / SPECGRID_KNOBS_FILE) as f:
             have = json.load(f)
     except (OSError, ValueError):
         have = {"cells": None, "sink": "frame"}
+    have.setdefault("estimator", "ols@nw")
     return have == want
 
 
 def _specgrid(processed_dir: Path, output_dir: Path,
               cells: Optional[int] = None,
-              sink: Optional[str] = None) -> None:
+              sink: Optional[str] = None,
+              estimator: Optional[str] = None) -> None:
     """Panel checkpoint → spec-grid robustness sweep CSV.
 
     Runs the scenario grids (``specgrid.run_scenarios``: subperiod halves
     × the three size universes × all models) through the lazy tile engine
     and writes the sink's result frame. ``cells`` scales the sweep to a
     pod-scale cell count via bootstrap draws; ``sink`` picks the streaming
-    aggregation (``--specgrid-cells``/``--specgrid-sink`` on the CLI).
-    Compute is replicated on every process (same contract as
-    ``_reports``); only the primary writes."""
+    aggregation; ``estimator`` (``--specgrid-estimator`` /
+    ``FMRP_SPECGRID_ESTIMATOR`` grammar, e.g. ``"fwl:beme@iid"``) runs
+    the sweep under an estimator-subsystem cell instead of OLS@NW (rows
+    then carry estimator/se_family disclosure columns). Compute is
+    replicated on every process (same contract as ``_reports``); only
+    the primary writes."""
     from fm_returnprediction_tpu.panel.dense import DensePanel
     from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
     from fm_returnprediction_tpu.specgrid import run_scenarios
+    from fm_returnprediction_tpu.specgrid.estimators import (
+        resolve_estimator,
+    )
 
     panel = DensePanel.load(processed_dir / PANEL_FILE)
     _guard_panel(panel, "specgrid")
     with open(processed_dir / FACTORS_FILE) as f:
         factors_dict = json.load(f)
     masks = compute_subset_masks(panel)
+    est = resolve_estimator(estimator)
+    estimators = None if (est.kind == "ols" and est.se == "nw") else (est,)
     frame = run_scenarios(panel, masks, factors_dict, cells=cells,
-                          sink=sink, output_dir=output_dir)
+                          sink=sink, output_dir=output_dir,
+                          estimators=estimators)
 
     from fm_returnprediction_tpu.guard import checks as _guard_checks
     from fm_returnprediction_tpu.guard import contracts as _contracts
@@ -444,7 +465,7 @@ def _specgrid(processed_dir: Path, output_dir: Path,
         # sidecar: the knobs this artifact was built under, read by the
         # task's uptodate check (_specgrid_knobs_unchanged)
         with open(output_dir / SPECGRID_KNOBS_FILE, "w") as f:
-            json.dump(_specgrid_effective_knobs(cells, sink), f)
+            json.dump(_specgrid_effective_knobs(cells, sink, estimator), f)
 
     _primary_writes("specgrid_saved", _save)
 
@@ -491,6 +512,7 @@ def build_tasks(
     output_dir: Optional[Path] = None,
     specgrid_cells: Optional[int] = None,
     specgrid_sink: Optional[str] = None,
+    specgrid_estimator: Optional[str] = None,
 ) -> List[Task]:
     """Assemble the DAG against the configured directory tree."""
     raw_dir = Path(raw_dir or config("RAW_DATA_DIR"))
@@ -557,7 +579,8 @@ def build_tasks(
             name="specgrid",
             actions=[lambda: _specgrid(processed_dir, output_dir,
                                        cells=specgrid_cells,
-                                       sink=specgrid_sink)],
+                                       sink=specgrid_sink,
+                                       estimator=specgrid_estimator)],
             # reads only the panel checkpoint — a reports-only refresh
             # must not re-run the scenario sweep
             file_dep=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
@@ -569,7 +592,8 @@ def build_tasks(
             # FMRP_SPECGRID_SINK — a change in EITHER direction re-runs
             uptodate=[
                 lambda: _specgrid_knobs_unchanged(
-                    output_dir, specgrid_cells, specgrid_sink
+                    output_dir, specgrid_cells, specgrid_sink,
+                    specgrid_estimator,
                 )
             ],
             doc="Panel checkpoint → Gram spec-grid robustness sweep CSV",

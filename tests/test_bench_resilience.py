@@ -38,7 +38,11 @@ def _clean_env(**overrides):
     return env
 
 
-def _run_child(code: str, timeout: float = 120, **env_overrides):
+def _run_child(code: str, timeout: float = 300, **env_overrides):
+    # the budget bounds a CPU-quota-dependent wall (the fast-shape
+    # sections alone are ~90-150 s depending on host throttling); it is
+    # a hang guard, not a latency pin — the deadline mechanics under
+    # test have their own in-child FMRP_BENCH_DEADLINE_S clock
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=timeout, env=_clean_env(**env_overrides), cwd=str(_REPO),
@@ -86,6 +90,7 @@ bench._bench_kernel = lambda fast: {}
 bench._bench_daily_fullscale = lambda fast: {}
 bench._bench_pallas = lambda fast: {}
 bench._bench_mesh8 = lambda fast: {}
+bench._bench_estimators = lambda fast: {}
 bench.main()
 """,
         # keep the un-stubbed sections (serving, specgrid, resilience) at

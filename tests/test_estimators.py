@@ -1,0 +1,600 @@
+"""Estimator subsystem on the Gram bank (``specgrid.estimators``).
+
+The ISSUE-16 contracts, each differential-pinned against a host oracle:
+
+- FWL partialling-out via the Schur complement on banked per-month Grams
+  equals the explicit-controls OLS solve EXACTLY (focal slopes and FM
+  means), at the transform level and through the grid engine;
+- multi-way absorbed FE via alternating projections on per-month
+  sufficient stats matches the dummy-variable within oracle (one-way and
+  two-way), with iteration count + convergence disclosed;
+- IV/2SLS via two Gram solves matches the closed-form two-stage host
+  solve, including the structural-residual R²;
+- every pooled sandwich-SE family (iid/white/cluster_month/cluster_firm/
+  cluster_twoway) matches the numpy meat-and-bread oracle; the clustered
+  FM mean matches ``clustered_mean_se_np``;
+- the streaming circular-block bootstrap's draw 0 IS the point estimate,
+  chunked accumulation matches one pass, and the Chan sufficient-stats
+  merge of disjoint halves is exact;
+- the estimator CellSpace dimension is inert for OLS cells (mixed-sweep
+  OLS rows bit-match a pure-OLS sweep) and loud everywhere it must be;
+- ``grambank.estimator_query`` answers FWL/IV/pooled cells from banked
+  stats with ZERO ``(T, N, P)`` panel contractions (ledger-proven) and
+  matches the grid route; absorb and firm-clustered pooled SEs are
+  rejected loudly (the bank lacks their sufficient stats);
+- the ``FMRP_SPECGRID_ESTIMATOR`` knob resolves through
+  ``resolve_estimator`` and the reporting parity surfaces reject a
+  leaked non-OLS value instead of silently changing the estimand.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.newey_west import (
+    clustered_mean_se,
+    clustered_mean_se_np,
+)
+from fm_returnprediction_tpu.specgrid.cellspace import CellSpace
+from fm_returnprediction_tpu.specgrid.engine import run_cellspace
+from fm_returnprediction_tpu.specgrid.estimators import (
+    EST_OLS,
+    Estimator,
+    StreamingBootstrap,
+    parse_estimator,
+    resolve_estimator,
+    run_estimator_grid_weights,
+)
+from fm_returnprediction_tpu.specgrid.estimators.absorb import (
+    absorb_transform,
+    contract_absorb_cells,
+)
+from fm_returnprediction_tpu.specgrid.estimators.cluster import pooled_fit
+from fm_returnprediction_tpu.specgrid.estimators.fwl import fwl_transform
+from fm_returnprediction_tpu.specgrid.estimators.iv import iv_r2, iv_transform
+from fm_returnprediction_tpu.specgrid.grambank import (
+    build_bank,
+    estimator_query,
+    scenario_query,
+)
+from fm_returnprediction_tpu.specgrid.grams import contract_spec_grams
+from fm_returnprediction_tpu.specgrid.solve import (
+    contraction_counts,
+    run_spec_grid_weights,
+    solve_spec_stats,
+)
+from fm_returnprediction_tpu.specgrid.specs import Spec, SpecGrid
+
+pytestmark = pytest.mark.estimators
+
+EPS64 = float(jnp.finfo(jnp.float64).eps)
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def panel():
+    """(T, N, P=5) panel with NaN holes — transform-level oracle shape."""
+    rng = np.random.default_rng(7)
+    t, n, p = 18, 60, 5
+    y = rng.normal(size=(t, n))
+    x = rng.normal(size=(t, n, p))
+    x[rng.random((t, n, p)) < 0.03] = np.nan
+    y[rng.random((t, n)) < 0.02] = np.nan
+    uni = np.ones((1, t, n), bool)
+    uidx = jnp.zeros(1, int)
+    window = np.ones((1, t), bool)
+    return y, x, uni, uidx, window
+
+
+@pytest.fixture(scope="module")
+def grid_panel():
+    """Grid-level panel: named columns, one universe, a window spec."""
+    rng = np.random.default_rng(11)
+    t, n, p = 30, 50, 4
+    names = ("a", "b", "c", "z")
+    y = rng.normal(size=(t, n))
+    x = rng.normal(size=(t, n, p))
+    x[rng.random((t, n, p)) < 0.03] = np.nan
+    masks = {"all": np.ones((t, n), bool)}
+    grid = SpecGrid(specs=(
+        Spec("s0", ("a",), "all"),
+        Spec("s1", ("a", "b"), "all"),
+        Spec("s2", ("a", "b"), "all", window=(5, 25)),
+    ), union=names)
+    return y, x, masks, grid, names
+
+
+def _ols_host(yv, xv):
+    xa = np.column_stack([np.ones(len(yv)), xv])
+    b, *_ = np.linalg.lstsq(xa, yv, rcond=None)
+    return b
+
+
+# ------------------------------------------------------------ spec grammar
+def test_parse_grammar_round_trips():
+    assert parse_estimator("ols") == EST_OLS
+    e = parse_estimator("fwl:c1+c2@iid")
+    assert e.kind == "fwl" and e.controls == ("c1", "c2") and e.se == "iid"
+    assert e.label == "fwl[c1+c2]"
+    e = parse_estimator("absorb:ind+size")
+    assert e.kind == "absorb" and e.absorb == ("ind", "size")
+    e = parse_estimator("iv:beme~z1+z2")
+    assert e.endog == ("beme",) and e.instruments == ("z1", "z2")
+    assert e.label == "iv[beme~z1+z2]"
+    assert parse_estimator("pooled:cluster_month").se == "cluster_month"
+    assert parse_estimator("pooled").se == "iid"
+
+
+@pytest.mark.parametrize("bad", [
+    "fwl",                    # fwl needs controls
+    "iv:b",                   # iv needs instruments
+    "pooled:cluster_galaxy",  # unknown pooled se family
+    "fwl:c@cluster_month",    # pooled-only se on an FM-route kind
+    "ridge:0.1",              # unknown kind
+])
+def test_parse_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        parse_estimator(bad)
+
+
+def test_resolve_env_knob_and_loud_allowed(monkeypatch):
+    monkeypatch.delenv("FMRP_SPECGRID_ESTIMATOR", raising=False)
+    assert resolve_estimator(None) == EST_OLS
+    monkeypatch.setenv("FMRP_SPECGRID_ESTIMATOR", "fwl:beme@iid")
+    assert resolve_estimator(None).label == "fwl[beme]"
+    # argument beats environment
+    assert resolve_estimator("iv:b~z").kind == "iv"
+    # the reporting parity surfaces resolve with allowed=("ols",):
+    # a leaked non-OLS knob must fail loudly, not change the estimand
+    with pytest.raises(ValueError, match="ols"):
+        resolve_estimator(None, allowed=("ols",))
+    monkeypatch.delenv("FMRP_SPECGRID_ESTIMATOR", raising=False)
+    with pytest.raises(TypeError):
+        resolve_estimator(123)
+
+
+def test_reporting_surfaces_reject_leaked_estimator(monkeypatch):
+    """The figure sweep resolves the knob with allowed=("ols",) at entry
+    — a leaked non-OLS estimator fails loudly before any compute (the
+    panel argument is never touched)."""
+    monkeypatch.setenv("FMRP_SPECGRID_ESTIMATOR", "pooled:cluster_month")
+    from fm_returnprediction_tpu.reporting.figure1 import subset_sweep
+    with pytest.raises(ValueError, match="ols"):
+        subset_sweep(None, {"All stocks": None}, ["All stocks"])
+
+
+# ------------------------------------------------- FWL: exact Schur parity
+def test_fwl_transform_equals_explicit_controls(panel):
+    y, x, uni, uidx, window = panel
+    t, _, p = x.shape
+    col_full = np.ones((1, p), bool)
+    ctrl = np.zeros(p, bool)
+    ctrl[3:] = True
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col_full), jnp.asarray(window))
+    sel_aug = jnp.asarray(
+        np.concatenate([[[True]], col_full & ~ctrl], axis=1))
+    ctrl_aug = jnp.asarray(np.concatenate([[[True]], ctrl[None]], axis=1))
+    full_aug = jnp.asarray(np.concatenate([[[True]], col_full], axis=1))
+    st2, deficient = fwl_transform(stats, full_aug, ctrl_aug, EPS64)
+    sol = solve_spec_stats(st2, sel_aug)
+    beta = np.asarray(sol.beta)[0]
+    errs = []
+    for m in range(t):
+        rows = np.isfinite(y[m]) & np.all(np.isfinite(x[m]), axis=-1)
+        if rows.sum() < p + 1:
+            continue
+        b_full = _ols_host(y[m, rows], x[m, rows])
+        errs.append(
+            np.abs(beta[m, 1:][~ctrl] - b_full[1:][~ctrl]).max())
+    assert errs and max(errs) < 1e-8
+    assert not np.asarray(deficient).any()
+
+
+def test_fwl_grid_vs_explicit_controls(grid_panel):
+    y, x, masks, grid, names = grid_panel
+    est = Estimator(kind="fwl", controls=("c",))
+    res, disc = run_estimator_grid_weights(
+        est, y, x, masks, grid, ("reference",))
+    r = res["reference"]
+    assert disc["kind"] == "fwl" and disc["estimator"] == "fwl[c]"
+    grid_ctrl = SpecGrid(specs=(
+        Spec("s0", ("a", "c"), "all"),
+        Spec("s1", ("a", "b", "c"), "all"),
+        Spec("s2", ("a", "b", "c"), "all", window=(5, 25)),
+    ), union=names)
+    full = run_spec_grid_weights(
+        y, x, masks, grid_ctrl, ("reference",), referee=False)["reference"]
+    # focal slopes AND the FM tail over them — both exact
+    assert np.nanmax(np.abs(r.slopes[:, :, :2] - full.slopes[:, :, :2])) \
+        < 1e-10
+    assert np.nanmax(np.abs(r.coef[:, :2] - full.coef[:, :2])) < 1e-10
+
+
+# --------------------------------------------------------- IV: 2SLS parity
+def test_iv_vs_closed_form_2sls(panel):
+    y, x, uni, uidx, window = panel
+    t, _, p = x.shape
+    col_iv = np.zeros((1, p), bool)
+    col_iv[0, :2] = True                      # structural: 1 + x0 + x1
+    inst = np.zeros(p, bool)
+    inst[3:] = True                           # excluded instruments: x3, x4
+    endog = np.zeros(p, bool)
+    endog[1] = True                           # x1 endogenous
+    col_eff = col_iv | inst[None]
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col_eff), jnp.asarray(window))
+    sel_aug = jnp.asarray(np.concatenate([[[True]], col_iv], axis=1))
+    z_aug = jnp.asarray(np.concatenate(
+        [[[True]], (col_iv & ~endog[None]) | inst[None]], axis=1))
+    st_iv, _ = iv_transform(stats, sel_aug, z_aug, EPS64)
+    sol = solve_spec_stats(st_iv, sel_aug)
+    r2 = np.asarray(iv_r2(sol.beta, stats, sol.month_valid))
+    beta = np.asarray(sol.beta)[0]
+    errs_b, errs_r2 = [], []
+    for m in range(t):
+        rows = (np.isfinite(y[m])
+                & np.all(np.isfinite(x[m][:, col_eff[0]]), axis=-1))
+        if rows.sum() < 5:
+            continue
+        yv = y[m, rows]
+        big_x = np.column_stack([np.ones(rows.sum()), x[m, rows][:, :2]])
+        big_z = np.column_stack([np.ones(rows.sum()), x[m, rows][:, 0],
+                                 x[m, rows][:, 3:]])
+        pz = big_z @ np.linalg.pinv(big_z.T @ big_z) @ big_z.T
+        xh = pz @ big_x
+        b2sls = np.linalg.pinv(xh.T @ big_x) @ (xh.T @ yv)
+        errs_b.append(np.abs(beta[m, :3] - b2sls).max())
+        u = yv - big_x @ b2sls
+        errs_r2.append(abs(
+            float(r2[0, m]) - (1 - (u @ u) / ((yv - yv.mean())**2).sum())))
+    assert errs_b and max(errs_b) < 1e-8
+    assert max(errs_r2) < 1e-8
+
+
+# ----------------------------------------- absorbed FE vs dummy-OLS oracle
+def test_absorb_oneway_vs_dummy_ols(panel):
+    y, x, uni, uidx, window = panel
+    t, n, p = x.shape
+    rng = np.random.default_rng(70)
+    ga = 4
+    codes = rng.integers(0, ga, size=(t, n))
+    col = np.zeros((1, p), bool)
+    col[0, :3] = True
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window))
+    sel_aug = jnp.asarray(np.concatenate([[[True]], col], axis=1))
+    nc, sc = contract_absorb_cells(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window), stats.center,
+        jnp.asarray(codes, jnp.int32), jnp.zeros((t, n), jnp.int32),
+        ga=ga, gb=1)
+    st, iters, _ = absorb_transform(stats, sel_aug, nc, sc,
+                                    n_fe=1, tol=1e-12, max_iter=50)
+    beta = np.asarray(solve_spec_stats(st, sel_aug).beta)[0]
+    errs = []
+    for m in range(t):
+        rows = np.isfinite(y[m]) & np.all(np.isfinite(x[m][:, :3]), axis=-1)
+        pres = np.unique(codes[m, rows])
+        if rows.sum() < 3 + len(pres):
+            continue
+        dummies = (codes[m, rows][:, None] == pres[None, :]).astype(float)
+        xd = np.column_stack([x[m, rows][:, :3], dummies])
+        b, *_ = np.linalg.lstsq(xd, y[m, rows], rcond=None)
+        errs.append(np.abs(beta[m, 1:4] - b[:3]).max())
+    assert errs and max(errs) < 1e-8
+    # one-way absorption is a single exact sweep
+    assert int(np.asarray(iters).max()) <= 2
+
+
+def test_absorb_twoway_vs_dummy_ols(panel):
+    y, x, uni, uidx, window = panel
+    t, n, p = x.shape
+    rng = np.random.default_rng(71)
+    ga, gb = 4, 3
+    codes_a = rng.integers(0, ga, size=(t, n))
+    codes_b = rng.integers(0, gb, size=(t, n))
+    col = np.zeros((1, p), bool)
+    col[0, :3] = True
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window))
+    sel_aug = jnp.asarray(np.concatenate([[[True]], col], axis=1))
+    nc, sc = contract_absorb_cells(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window), stats.center,
+        jnp.asarray(codes_a, jnp.int32), jnp.asarray(codes_b, jnp.int32),
+        ga=ga, gb=gb)
+    st, iters, delta = absorb_transform(stats, sel_aug, nc, sc,
+                                        n_fe=2, tol=1e-13, max_iter=200)
+    beta = np.asarray(solve_spec_stats(st, sel_aug).beta)[0]
+    errs = []
+    for m in range(t):
+        rows = np.isfinite(y[m]) & np.all(np.isfinite(x[m][:, :3]), axis=-1)
+        pa = np.unique(codes_a[m, rows])
+        pb = np.unique(codes_b[m, rows])
+        if rows.sum() < 3 + len(pa) + len(pb):
+            continue
+        da = (codes_a[m, rows][:, None] == pa[None, :]).astype(float)
+        db = (codes_b[m, rows][:, None] == pb[None, :]).astype(float)
+        xd = np.column_stack([x[m, rows][:, :3], da, db[:, 1:]])
+        b, *_ = np.linalg.lstsq(xd, y[m, rows], rcond=None)
+        errs.append(np.abs(beta[m, 1:4] - b[:3]).max())
+    assert errs and max(errs) < 1e-7
+    # two-way needs real alternation, and it converged within budget
+    assert int(np.asarray(iters).max()) < 200
+    assert float(np.asarray(delta).max()) < 1e-10
+
+
+def test_absorb_grid_disclosure(grid_panel):
+    y, x, masks, grid, _ = grid_panel
+    rng = np.random.default_rng(72)
+    codes = rng.integers(0, 3, size=y.shape)
+    res, disc = run_estimator_grid_weights(
+        Estimator(kind="absorb", absorb=("ind",)), y, x, masks, grid,
+        ("reference",), fe_codes={"ind": codes})
+    assert np.asarray(disc["absorb_converged"]).all()
+    assert int(np.asarray(disc["absorb_iters"]).max()) >= 1
+    assert np.isfinite(res["reference"].coef[1, :2]).all()
+
+
+# --------------------------------------- pooled sandwich SEs vs numpy oracle
+@pytest.mark.parametrize("se_kind", [
+    "iid", "white", "cluster_month", "cluster_firm", "cluster_twoway",
+])
+def test_pooled_sandwich_vs_host_oracle(panel, se_kind):
+    y, x, uni, uidx, window = panel
+    t, _, p = x.shape
+    col = np.zeros((1, p), bool)
+    col[0, :3] = True
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window))
+    sel_aug = jnp.asarray(np.concatenate([[[True]], col], axis=1))
+    rows3 = np.isfinite(y) & np.all(np.isfinite(x[:, :, :3]), axis=-1)
+    ys, xs, tids, fids = [], [], [], []
+    for m in range(t):
+        r = rows3[m]
+        ys.append(y[m, r])
+        xs.append(x[m, r][:, :3])
+        tids.append(np.full(r.sum(), m))
+        fids.append(np.flatnonzero(r))
+    yv = np.concatenate(ys)
+    xa = np.column_stack([np.ones(len(yv)), np.concatenate(xs)])
+    tid, fid = np.concatenate(tids), np.concatenate(fids)
+    bread = np.linalg.pinv(xa.T @ xa)
+    bh = bread @ (xa.T @ yv)
+    uh = yv - xa @ bh
+
+    panel_args = (jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+                  jnp.asarray(col), jnp.asarray(window))
+    res = pooled_fit(stats, sel_aug, se_kind, EPS64, panel=panel_args)
+    assert np.abs(np.asarray(res.beta)[0][:4] - bh).max() < 1e-8
+
+    if se_kind == "iid":
+        v = (uh @ uh / (len(yv) - 4)) * bread
+    else:
+        def meat_by(ids):
+            meat = np.zeros((4, 4))
+            for g in np.unique(ids):
+                s = (xa[ids == g] * uh[ids == g, None]).sum(0)
+                meat += np.outer(s, s)
+            return meat
+
+        mw = (xa * (uh**2)[:, None]).T @ xa
+        meat = {"white": mw,
+                "cluster_month": meat_by(tid),
+                "cluster_firm": meat_by(fid),
+                "cluster_twoway": meat_by(tid) + meat_by(fid) - mw}[se_kind]
+        v = bread @ meat @ bread
+    assert np.abs(
+        np.asarray(res.se)[0][:4] - np.sqrt(np.diag(v))).max() < 1e-8
+
+
+def test_pooled_month_separable_needs_no_panel(panel):
+    """iid/cluster_month are computable from the Grams alone."""
+    y, x, uni, uidx, window = panel
+    p = x.shape[-1]
+    col = np.zeros((1, p), bool)
+    col[0, :3] = True
+    stats = contract_spec_grams(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(uni), uidx,
+        jnp.asarray(col), jnp.asarray(window))
+    sel_aug = jnp.asarray(np.concatenate([[[True]], col], axis=1))
+    res = pooled_fit(stats, sel_aug, "cluster_month", EPS64, panel=None)
+    assert np.isfinite(np.asarray(res.se)[0][:4]).all()
+    with pytest.raises(ValueError, match="panel"):
+        pooled_fit(stats, sel_aug, "cluster_firm", EPS64, panel=None)
+
+
+def test_clustered_mean_se_vs_np_oracle(rng):
+    t = 120
+    x = rng.standard_normal(t)
+    valid = rng.random(t) > 0.1
+    clusters = rng.integers(0, 10, size=t)
+    se_d = clustered_mean_se(
+        jnp.asarray(x), jnp.asarray(valid), jnp.asarray(clusters))
+    se_h = clustered_mean_se_np(x[valid], clusters[valid])
+    np.testing.assert_allclose(float(se_d), se_h, atol=1e-12)
+    # degenerate: one valid entry → NaN, like the NW kernel
+    one = np.zeros(t, bool)
+    one[3] = True
+    assert np.isnan(float(clustered_mean_se(
+        jnp.asarray(x), jnp.asarray(one), jnp.asarray(clusters))))
+
+
+def test_fm_se_families_run_through_grid(grid_panel):
+    y, x, masks, grid, _ = grid_panel
+    for se in ("iid", "cluster"):
+        res, disc = run_estimator_grid_weights(
+            Estimator(kind="fwl", controls=("c",), se=se),
+            y, x, masks, grid, ("reference",))
+        assert disc["se_family"] == se
+        assert np.isfinite(res["reference"].nw_se[1, :2]).all()
+
+
+# --------------------------------------------------- streaming bootstrap
+def test_streaming_bootstrap_draw0_chunks_and_merge(grid_panel):
+    y, x, masks, grid, _ = grid_panel
+    base = run_spec_grid_weights(
+        y, x, masks, grid, ("reference",))["reference"]
+    k_slopes = base.slopes[:2][:, :, :2]
+    args = (k_slopes, base.r2[:2], base.n_obs[:2], base.month_valid[:2])
+
+    sb = StreamingBootstrap(*args, seed=3, chunk=16)
+    # draw 0 of the circular block resample IS the identity permutation
+    assert np.nanmax(np.abs(sb.point - base.coef[:2, :2])) < 1e-12
+
+    sb.extend(64)
+    one = StreamingBootstrap(*args, seed=3, chunk=500)
+    one.extend(64)
+    assert np.allclose(sb.mean, one.mean, equal_nan=True)
+    assert np.allclose(sb.std, one.std, equal_nan=True)
+
+    # Chan merge of disjoint halves == the single pass, exactly
+    h1 = StreamingBootstrap(*args, seed=3, chunk=500)
+    h1.extend(32)
+    h2 = StreamingBootstrap(*args, seed=3, chunk=500)
+    h2.draws_done = 32
+    h2.extend(64)
+    h1.merge(h2)
+    assert np.allclose(h1.mean, one.mean, equal_nan=True)
+    assert np.allclose(h1.m2, one.m2, equal_nan=True)
+    assert one.summary()["draws_done"] == 64
+
+
+# ------------------------------------------- CellSpace estimator dimension
+def _mixed_space():
+    return CellSpace(
+        regressor_sets=(("m1", ("a",)), ("m2", ("a", "b"))),
+        universes=("all", "big"),
+        windows=(("full", None), ("early", (0, 15))),
+        estimators=(EST_OLS, Estimator(kind="fwl", controls=("c",)),
+                    Estimator(kind="absorb", absorb=("ind",)),
+                    Estimator(kind="pooled", se="cluster_month")),
+    )
+
+
+def test_cellspace_estimator_dim_decode_and_union():
+    space = _mixed_space()
+    assert space.union_predictors == ("a", "b", "c")
+    for i in range(len(space)):
+        assert space.estimators[space.estimator_index(i)] \
+            is space.cell(i).estimator
+    with pytest.raises(TypeError, match="parse_estimator"):
+        CellSpace(regressor_sets=(("m1", ("a",)),), universes=("all",),
+                  windows=(("full", None),), estimators=("fwl:c",))
+
+
+def test_mixed_sweep_ols_cells_match_pure_ols_sweep(rng):
+    t, n = 30, 60
+    y = rng.normal(size=(t, n))
+    x = rng.normal(size=(t, n, 3))
+    masks = {"all": np.ones((t, n), bool),
+             "big": rng.random((t, n)) > 0.3}
+    codes = rng.integers(0, 3, size=(t, n))
+    space = _mixed_space()
+    frame, _ = run_cellspace(y, x, masks, space, fe_codes={"ind": codes})
+    assert {"estimator", "se_family"} <= set(frame.columns)
+    ab = frame[frame["estimator"].str.startswith("absorb")]
+    assert len(ab) and ab["absorb_converged"].all()
+
+    space_ols = CellSpace(regressor_sets=space.regressor_sets,
+                          universes=space.universes, windows=space.windows)
+    frame_ols, _ = run_cellspace(y, x[:, :, :2], masks, space_ols)
+    key = ["model", "universe", "window", "predictor"]
+    got = (frame[frame["estimator"] == "ols"].sort_values(key)
+           [["coef", "tstat", "mean_r2"]].to_numpy())
+    want = (frame_ols.sort_values(key)
+            [["coef", "tstat", "mean_r2"]].to_numpy())
+    assert np.allclose(got, want, equal_nan=True)
+
+
+def test_engine_loud_validations(rng):
+    t, n = 12, 20
+    y = rng.normal(size=(t, n))
+    x = rng.normal(size=(t, n, 1))
+    masks = {"all": np.ones((t, n), bool)}
+    sets = (("m1", ("a",)),)
+    wins = (("full", None),)
+    # absorb without fe_codes for the named factor
+    with pytest.raises(KeyError, match="ind"):
+        run_cellspace(y, x, masks, CellSpace(
+            regressor_sets=sets, universes=("all",), windows=wins,
+            estimators=(Estimator(kind="absorb", absorb=("ind",)),)))
+    # pooled cells cannot ride the slope-series bootstrap re-aggregation
+    with pytest.raises(ValueError, match="bootstrap"):
+        run_cellspace(y, x, masks, CellSpace(
+            regressor_sets=sets, universes=("all",), windows=wins,
+            bootstrap=3,
+            estimators=(Estimator(kind="pooled", se="iid"),)))
+
+
+# ------------------------------------- bank-served estimator queries (ZERO
+# panel contractions, ledger-proven; acceptance criterion of ISSUE 16)
+@pytest.fixture(scope="module")
+def bank(grid_panel):
+    y, x, masks, _, names = grid_panel
+    return build_bank(y, x, masks, CellSpace(
+        regressor_sets=(("m2", names),),
+        universes=("all",), windows=(("full", None),),
+    ))
+
+
+def test_bank_estimator_query_zero_contractions(bank, grid_panel):
+    y, x, masks, _, names = grid_panel
+    before = contraction_counts()
+    res, disc = estimator_query(bank, "fwl:c")
+    assert contraction_counts() == before, \
+        "estimator_query touched the (T, N, P) panel"
+    assert disc["kind"] == "fwl"
+    # parity vs the grid route on the same cell
+    grid = SpecGrid(specs=(Spec("m2", names, "all"),), union=names)
+    res_g, _ = run_estimator_grid_weights(
+        Estimator(kind="fwl", controls=("c",)), y, x, masks, grid,
+        ("reference",))
+    err = np.nanmax(np.abs(res.coef[0] - res_g["reference"].coef[0]))
+    assert err < 1e-12
+
+
+def test_bank_iv_and_pooled_serve_absorb_rejects(bank):
+    res_iv, _ = estimator_query(bank, "iv:b~z")
+    assert np.isfinite(res_iv.coef[0, :2]).all()
+    res_p, _ = estimator_query(bank, "pooled:cluster_month")
+    assert np.isfinite(res_p.coef[0]).all()
+    with pytest.raises(ValueError, match="absorb"):
+        estimator_query(bank, "absorb:ind")
+    # firm clusters need row-level residuals the bank does not hold
+    with pytest.raises(ValueError, match="cluster_firm"):
+        estimator_query(bank, "pooled:cluster_firm")
+    with pytest.raises(KeyError):
+        estimator_query(bank, "fwl:not_a_column")
+
+
+def test_bank_scenario_sweep_estimator_zero_contractions(bank):
+    before = contraction_counts()
+    df = scenario_query(bank, windows={"full": None, "late": (15, 30)},
+                        estimator="fwl:c", bootstrap=3)
+    assert contraction_counts() == before
+    assert set(df["estimator"]) == {"fwl[c]"}
+    assert df["draw"].max() == 2
+    # the partialled control never shows up as a reported predictor
+    assert not df["predictor"].isin(["c"]).any()
+
+
+# ------------------------------------------------------- taskgraph knob
+def test_taskgraph_knob_carries_estimator(monkeypatch):
+    from fm_returnprediction_tpu.taskgraph.tasks import (
+        _specgrid_effective_knobs,
+    )
+    monkeypatch.delenv("FMRP_SPECGRID_ESTIMATOR", raising=False)
+    assert _specgrid_effective_knobs(None, None)["estimator"] == "ols@nw"
+    assert _specgrid_effective_knobs(
+        None, None, "fwl:c@iid")["estimator"] == "fwl[c]@iid"
+    monkeypatch.setenv("FMRP_SPECGRID_ESTIMATOR", "pooled:cluster_month")
+    assert _specgrid_effective_knobs(
+        None, None)["estimator"] == "pooled@cluster_month"
