@@ -2029,6 +2029,145 @@ def _bench_estimators(fast: bool):
     return out
 
 
+def _bench_backtest(fast: bool):
+    """Rolling-origin backtest subsystem (the ISSUE-18 acceptance
+    evidence), four legs:
+
+    - **origins/s ladder** — the warm prefix-sum scan program
+      (``backtest.paths``) per scheme: one batched per-month solve plus a
+      masked prefix sum answers EVERY origin at once, so the series
+      prices origins per second, not solves per origin. The warm repeat
+      runs under ``recompile_watch`` — a re-trace of the path program is
+      a regression.
+    - **bank-vs-refit speedup** — the same paths through the per-origin
+      full-refit differential oracle (``route="refit"``): the ratio is
+      the factorization win the scan route exists for
+      (``backtest_scan_vs_refit_speedup``, higher is better).
+    - **zero-contraction pin** — a full sweep (2 schemes × EW/VW through
+      ``run_backtest``) answered from the bank with the panel-contraction
+      ledger delta reported; 0 is the acceptance criterion
+      (``backtest_sweep_panel_contractions``).
+    - **portfolio consumer vs a live fleet** — the ``loadgen``
+      ``portfolio_consumer`` phase forms portfolios from E[r] quotes
+      served THROUGH a 2-replica fleet (admission, routing,
+      microbatching), with rows/s + p99 disclosed and the request
+      journal replayed clean (``backtest_consumer_journal_clean``).
+
+    Series are shape-qualified via ``backtest_shape`` (device-dependent
+    walls). FMRP_BENCH_BACKTEST=0 skips."""
+    if os.environ.get("FMRP_BENCH_BACKTEST", "1") == "0":
+        return {}
+    import tempfile
+
+    from fm_returnprediction_tpu.backtest import (
+        backtest_paths,
+        backtest_space,
+        run_backtest,
+    )
+    from fm_returnprediction_tpu.serving import (
+        ServingFleet,
+        build_serving_state,
+        portfolio_consumer,
+        replay_journal,
+    )
+    from fm_returnprediction_tpu.specgrid.cellspace import CellSpace
+    from fm_returnprediction_tpu.specgrid.grambank import build_bank
+    from fm_returnprediction_tpu.specgrid.solve import contraction_counts
+    from fm_returnprediction_tpu.telemetry import recompile_watch
+
+    t = int(os.environ.get("FMRP_BENCH_BACKTEST_MONTHS",
+                           48 if fast else 240))
+    n = int(os.environ.get("FMRP_BENCH_BACKTEST_FIRMS",
+                           160 if fast else 2000))
+    p = 4
+    y, x, subsets = _make_panel(t, n, p)
+    masks = dict(zip(("All", "Big"), subsets[:2]))
+    names = tuple(f"x{i:02d}" for i in range(p))
+    window = max(t // 4, 6)
+    schemes = ("expanding", f"rolling{window}")
+    space = CellSpace(
+        regressor_sets=(("m2", names[:2]), ("full", names)),
+        universes=tuple(masks), windows=(("full", None),),
+    )
+    out = {"backtest_shape": f"T{t}_N{n}_P{p}_K{2 * len(masks)}"}
+
+    with _timed("bench.backtest_bank_build") as build_t:
+        bank = build_bank(y, x, masks, space)
+    out["backtest_bank_build_s"] = round(build_t.s, 4)
+
+    # origins/s ladder: warm scan program per scheme, the warm repeat of
+    # the first scheme under the recompile sentinel
+    for i, scheme in enumerate(schemes):
+        backtest_paths(bank, scheme, route="scan")  # compile
+        ctx = (recompile_watch("backtest_scan_warm", warm=True)
+               if i == 0 else nullcontext())
+        with ctx as delta, _timed(f"bench.backtest_scan_{scheme}") as w:
+            backtest_paths(bank, scheme, route="scan")
+        key = "expanding" if i == 0 else "rolling"
+        out[f"backtest_{key}_warm_s"] = round(w.s, 4)
+        out[f"backtest_{key}_origins_per_s"] = round(t / w.s, 1)
+        if i == 0:
+            out["backtest_scan_warm_cache_growth"] = (
+                delta.entries_after - delta.entries_before)
+
+    # the refit oracle prices what the scan route replaced: T origins,
+    # each a masked Gram re-aggregation + fresh solve
+    backtest_paths(bank, "expanding", route="refit")  # compile
+    with _timed("bench.backtest_refit") as refit_t:
+        backtest_paths(bank, "expanding", route="refit")
+    out["backtest_refit_s"] = round(refit_t.s, 4)
+    out["backtest_scan_vs_refit_speedup"] = round(
+        refit_t.s / out["backtest_expanding_warm_s"], 1)
+
+    # full sweep from the bank — the ledger delta is the acceptance pin
+    bt_space = backtest_space(
+        bank, schemes=",".join(schemes), weightings=("ew", "vw"),
+        n_quantiles=5, min_obs=min(30, max(n // 8, 5)),
+    )
+    rng = np.random.default_rng(2018)
+    weights = np.abs(rng.lognormal(size=(t, n))) + 0.1  # synthetic ME
+    run_backtest(bank, x, y, masks, space=bt_space,
+                 weights_var=weights)  # compile
+    before = contraction_counts()
+    with _timed("bench.backtest_sweep") as sweep_t:
+        _, stats = run_backtest(bank, x, y, masks, space=bt_space,
+                                weights_var=weights)
+    after = contraction_counts()
+    out["backtest_sweep_cells"] = stats["cells"]
+    out["backtest_sweep_warm_s"] = round(sweep_t.s, 4)
+    out["backtest_sweep_cells_per_s"] = round(stats["cells"] / sweep_t.s, 1)
+    out["backtest_sweep_panel_contractions"] = sum(
+        after.get(k, 0) - before.get(k, 0)
+        for k in ("specs_contracted", "pairs_contracted")
+    )
+
+    # portfolio consumer vs a live fleet: E[r] quotes through the front
+    # door, portfolios formed host-side, journal replayed clean
+    state = build_serving_state(
+        y, x, np.isfinite(y), window=min(120, t // 2),
+        min_periods=min(60, t // 4),
+    )
+    q_months = int(os.environ.get("FMRP_BENCH_BACKTEST_CONSUMER_MONTHS", 3))
+    q_firms = min(n, 48 if fast else 128)
+    have = np.nonzero(state.have_coef())[0]
+    pick = have[-q_months:] if len(have) >= q_months else have
+    with tempfile.TemporaryDirectory() as root:
+        journal = os.path.join(root, "journal.jsonl")
+        with ServingFleet(state, 2, max_batch=64, max_latency_ms=1.0,
+                          journal=journal) as fleet:
+            report = portfolio_consumer(
+                fleet, pick, x[pick][:, :q_firms], n_quantiles=5,
+            )
+        replay = replay_journal(journal)
+    out["backtest_consumer_rows_per_s"] = report["rows_per_s"]
+    out["backtest_consumer_p99_ms"] = report["p99_ms"]
+    out["backtest_consumer_quotes"] = report["n"]
+    out["backtest_consumer_months_formed"] = report["months_formed"]
+    out["backtest_consumer_shed"] = report["shed"]
+    out["backtest_consumer_journal_clean"] = bool(replay.clean)
+    return out
+
+
 def _bench_serving(fast: bool):
     """Warm microbatched serving path on a synthetic state (the online
     E[r] query service, ``fm_returnprediction_tpu/serving``): build a
@@ -3340,6 +3479,7 @@ def main() -> None:
     sections.append(_bench_specgrid_scale)  # _SPECGRID_SCALE=0 in-section
     sections.append(_bench_grid_factorized)  # _GRID_FACTORIZED=0 in-section
     sections.append(_bench_estimators)  # _ESTIMATORS=0 handled in-section
+    sections.append(_bench_backtest)  # _BACKTEST=0 handled in-section
     sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
     sections.append(_bench_transport)  # _TRANSPORT=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section

@@ -176,6 +176,35 @@ def task_estimator_parity():
     }
 
 
+def task_backtest_parity():
+    """The backtest subsystem's differential suite as one named exit-1
+    gate (``tests/test_backtest.py``): the scan-route prefix-sum paths
+    vs the per-origin full-refit oracle (f64 ≤ 1e-13 / f32 ≤ 1e-6, OLS
+    and FWL), OOS R²/IC/rank-IC vs their numpy host oracles, quantile
+    assignment vs the pandas-qcut-style oracle incl. tie months,
+    bootstrap draw-0 ≡ point, the fleet-served portfolio consumer's
+    quotes bit-identical to the batch executor, and the zero-panel-
+    contraction sweep ledger — the pre-merge gate for anything touching
+    ``backtest/`` or the bank/solve/serving tails it rides. Sits
+    alongside ``grid_parity`` and ``estimator_parity``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m backtest -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "backtest marker differential suite (scan-vs-refit paths, "
+               "OOS R2/IC/decile oracles, consumer quote parity, "
+               "zero-contraction ledger) — exit-1 on any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }
+
+
 if __name__ == "__main__":
     try:
         from doit.doit_cmd import DoitMain

@@ -1,0 +1,97 @@
+"""Backtest & portfolio subsystem — rolling-origin out-of-sample E[r]
+evaluation and quantile portfolios as device programs on the Gram bank
+(ISSUE 18).
+
+The estimation side never touches the ``(T, N, P)`` panel: every
+expanding/rolling estimation origin is a masked re-aggregation of the
+banked additive per-month Gram stats (``specgrid.grambank``), and because
+each month's cross-sectional slope solves from that month's Gram alone —
+a sample window only selects WHICH months enter the Fama-MacBeth
+aggregation — the entire origin-indexed coefficient path is one batched
+per-month solve plus a masked prefix sum (``backtest.paths``,
+``FMRP_BACKTEST_ROUTE=auto|scan|refit`` with the per-origin full-refit
+loop retained as the differential oracle — exact by Gram additivity).
+
+Layers:
+
+- ``paths``     — rolling-origin coefficient paths (scan route + refit
+  oracle), E[r] prediction at t+1, OLS/FWL estimator composition with
+  loud rejection of the non-composing kinds;
+- ``portfolio`` — per-month quantile sorts on predicted E[r] (EW/VW,
+  tie-deterministic), long-short spread, one-way turnover;
+- ``evaluate``  — OOS R² vs the expanding historical-mean benchmark,
+  Pearson/rank IC, NW SEs and the device-batched circular-block
+  bootstrap over origins (``specgrid.boot``);
+- ``space``     — the backtest cell space (scheme × estimator × set ×
+  universe × weighting), index-addressable and lazy like ``CellSpace``;
+- ``sweep``     — the tile runner streaming cells to sinks with the
+  zero-panel-contraction ledger proof;
+- ``sinks``     — streaming sinks (frame/topk/summary/parquet reused
+  from specgrid, plus the O(1) ``metrics`` aggregate sink).
+"""
+
+from fm_returnprediction_tpu.backtest.evaluate import (
+    bootstrap_series,
+    ic_series,
+    ic_series_np,
+    oos_r2,
+    oos_r2_np,
+    series_inference,
+)
+from fm_returnprediction_tpu.backtest.paths import (
+    BACKTEST_ROUTES,
+    BacktestPaths,
+    backtest_paths,
+    parse_scheme,
+    predict_er,
+    resolve_backtest_route,
+    resolve_quantiles,
+    resolve_schemes,
+)
+from fm_returnprediction_tpu.backtest.portfolio import (
+    PortfolioResult,
+    quantile_sorts,
+)
+from fm_returnprediction_tpu.backtest.sinks import (
+    BACKTEST_SINK_NAMES,
+    MetricsSink,
+    resolve_backtest_sink,
+    resolve_backtest_sink_name,
+)
+from fm_returnprediction_tpu.backtest.space import (
+    BacktestCell,
+    BacktestSpace,
+    backtest_space,
+)
+from fm_returnprediction_tpu.backtest.sweep import (
+    run_backtest,
+    run_backtest_scenarios,
+)
+
+__all__ = [
+    "BACKTEST_ROUTES",
+    "BACKTEST_SINK_NAMES",
+    "BacktestCell",
+    "BacktestPaths",
+    "BacktestSpace",
+    "MetricsSink",
+    "PortfolioResult",
+    "backtest_paths",
+    "backtest_space",
+    "bootstrap_series",
+    "ic_series",
+    "ic_series_np",
+    "oos_r2",
+    "oos_r2_np",
+    "parse_scheme",
+    "predict_er",
+    "quantile_sorts",
+    "resolve_backtest_route",
+    "resolve_backtest_sink",
+    "resolve_backtest_sink_name",
+    "resolve_quantiles",
+    "resolve_schemes",
+    "run_backtest",
+    "run_backtest_scenarios",
+    "series_inference",
+]

@@ -1,0 +1,233 @@
+"""Out-of-sample evaluation metrics and their inference.
+
+The evaluation half of the backtest subsystem, with each device kernel
+mirrored by a numpy host oracle (the repo's differential discipline —
+``ops.newey_west.nw_mean_se_np``, ``specgrid.boot.fm_aggregate_np``):
+
+- ``oos_r2``  — Campbell-Thompson style out-of-sample R² vs the
+  EXPANDING HISTORICAL-MEAN benchmark: benchmark forecast for month t is
+  the pooled mean of the evaluable sample's realized returns over months
+  < t (strictly past; months before any history exist are excluded from
+  both sums, so the model and the benchmark face the same sample);
+- ``ic_series`` — per-month Pearson information coefficient between the
+  forecast and the realized return, plus the rank IC (Spearman) on
+  double-argsort ORDINAL ranks: ties break by firm position — stable,
+  deterministic, and mirrored exactly by the oracle (average-rank tie
+  handling would need segment means the device kernel doesn't carry;
+  the ordinal convention is disclosed, not hidden);
+- ``series_inference`` — mean, NW SE, and t-stat of a backtest series
+  (spread, IC) through the existing ``ops.newey_west`` kernel;
+- ``bootstrap_series`` — the device-batched circular-block bootstrap
+  over ORIGINS: month resamples of the series through the same gathered
+  aggregator (``specgrid.boot``) and the same archived draw seeds as the
+  spec-grid engine, so draw 0 is the never-resampled point estimate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se, nw_mean_se_np
+
+__all__ = [
+    "bootstrap_series",
+    "ic_series",
+    "ic_series_np",
+    "oos_r2",
+    "oos_r2_np",
+    "series_inference",
+]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+@jax.jit
+def oos_r2(er, er_valid, realized):
+    """Out-of-sample R² of the forecast vs the expanding historical-mean
+    benchmark over the evaluable sample (forecast AND realized present).
+
+    ``1 − Σ(r − ê)² / Σ(r − r̄_hist)``, where ``r̄_hist`` at month t is
+    the pooled mean of evaluable realized returns over months < t. NaN
+    when no month has prior history or the benchmark sum is zero."""
+    ok = er_valid & jnp.isfinite(realized)
+    dtype = er.dtype
+    r_z = jnp.where(ok, realized, 0.0)
+    msum = r_z.sum(axis=1)                                  # (T,)
+    mcnt = ok.sum(axis=1).astype(dtype)
+    csum = jnp.cumsum(msum)
+    ccnt = jnp.cumsum(mcnt)
+    # strictly-past pooled mean: shift the prefix sums one month
+    prev_sum = jnp.concatenate([jnp.zeros(1, dtype), csum[:-1]])
+    prev_cnt = jnp.concatenate([jnp.zeros(1, dtype), ccnt[:-1]])
+    hist = jnp.where(prev_cnt > 0,
+                     prev_sum / jnp.maximum(prev_cnt, 1.0), jnp.nan)
+    use = ok & (prev_cnt > 0)[:, None]
+    err_model = jnp.where(use, realized - er, 0.0)
+    err_bench = jnp.where(use, realized - hist[:, None], 0.0)
+    num = jnp.einsum("tn,tn->", err_model, err_model, precision=_PRECISION)
+    den = jnp.einsum("tn,tn->", err_bench, err_bench, precision=_PRECISION)
+    return jnp.where(den > 0, 1.0 - num / jnp.where(den > 0, den, 1.0),
+                     jnp.nan)
+
+
+def oos_r2_np(er, er_valid, realized) -> float:
+    """Numpy mirror of :func:`oos_r2` — the host oracle."""
+    er = np.asarray(er, float)
+    realized = np.asarray(realized, float)
+    ok = np.asarray(er_valid, bool) & np.isfinite(realized)
+    t = er.shape[0]
+    num = den = 0.0
+    run_sum = run_cnt = 0.0
+    for m in range(t):
+        if run_cnt > 0:
+            hist = run_sum / run_cnt
+            rows = ok[m]
+            num += float(((realized[m, rows] - er[m, rows]) ** 2).sum())
+            den += float(((realized[m, rows] - hist) ** 2).sum())
+        run_sum += float(realized[m, ok[m]].sum())
+        run_cnt += float(ok[m].sum())
+    return 1.0 - num / den if den > 0 else float("nan")
+
+
+def _masked_corr(a, b, ok, min_obs: int):
+    """Per-month Pearson correlation of two (T, N) panels over ``ok``."""
+    dtype = a.dtype
+    n = ok.sum(axis=1).astype(dtype)
+    nz = jnp.maximum(n, 1.0)
+    a_z = jnp.where(ok, a, 0.0)
+    b_z = jnp.where(ok, b, 0.0)
+    ma = a_z.sum(axis=1) / nz
+    mb = b_z.sum(axis=1) / nz
+    da = jnp.where(ok, a - ma[:, None], 0.0)
+    db = jnp.where(ok, b - mb[:, None], 0.0)
+    cov = jnp.einsum("tn,tn->t", da, db, precision=_PRECISION)
+    va = jnp.einsum("tn,tn->t", da, da, precision=_PRECISION)
+    vb = jnp.einsum("tn,tn->t", db, db, precision=_PRECISION)
+    good = (n >= min_obs) & (va > 0) & (vb > 0)
+    corr = cov / jnp.sqrt(jnp.where(good, va * vb, 1.0))
+    return jnp.where(good, corr, jnp.nan), good
+
+
+def _ordinal_ranks(values, ok):
+    """Per-month ordinal ranks of the valid entries (invalid pushed to
+    the back); ties break by firm position via the stable double
+    argsort — the documented deterministic convention."""
+    big = jnp.where(ok, values, jnp.inf)
+    order = jnp.argsort(big, axis=1, stable=True)
+    n = values.shape[1]
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(values.shape[0])[:, None], order
+    ].set(jnp.broadcast_to(jnp.arange(n), order.shape))
+    return ranks.astype(values.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("min_obs",))
+def ic_series(er, er_valid, realized, min_obs: int = 10):
+    """Per-month Pearson and rank (Spearman-on-ordinal-ranks) information
+    coefficients. Returns ``(ic (T,), rank_ic (T,), ic_valid (T,))`` —
+    NaN months have fewer than ``min_obs`` evaluable firms or a
+    degenerate (zero-variance) side."""
+    ok = er_valid & jnp.isfinite(realized)
+    ic, good = _masked_corr(er, realized, ok, min_obs)
+    r_er = _ordinal_ranks(er, ok)
+    r_re = _ordinal_ranks(realized, ok)
+    rank_ic, _ = _masked_corr(r_er, r_re, ok, min_obs)
+    return ic, rank_ic, good
+
+
+def ic_series_np(er, er_valid, realized, min_obs: int = 10):
+    """Numpy mirror of :func:`ic_series` — the host oracle (same ordinal
+    tie convention: ranks by stable sort order, firm index breaking)."""
+    er = np.asarray(er, float)
+    realized = np.asarray(realized, float)
+    ok = np.asarray(er_valid, bool) & np.isfinite(realized)
+    t = er.shape[0]
+    ic = np.full(t, np.nan)
+    rank_ic = np.full(t, np.nan)
+    for m in range(t):
+        rows = np.flatnonzero(ok[m])
+        if rows.size < min_obs:
+            continue
+        a, b = er[m, rows], realized[m, rows]
+        if a.std() == 0 or b.std() == 0:
+            continue
+        ic[m] = np.corrcoef(a, b)[0, 1]
+        ra = np.empty(rows.size)
+        ra[np.argsort(a, kind="stable")] = np.arange(rows.size)
+        rb = np.empty(rows.size)
+        rb[np.argsort(b, kind="stable")] = np.arange(rows.size)
+        if ra.std() == 0 or rb.std() == 0:
+            continue
+        rank_ic[m] = np.corrcoef(ra, rb)[0, 1]
+    return ic, rank_ic
+
+
+def series_inference(series, valid=None, nw_lags: int = 4,
+                     weight: str = "reference"):
+    """Mean / NW SE / t-stat of one backtest series through the existing
+    ``ops.newey_west`` kernel. ``valid`` defaults to the finite entries.
+    Returns host floats ``(mean, nw_se, tstat, n)``."""
+    series = jnp.asarray(series)
+    valid = jnp.isfinite(series) if valid is None \
+        else jnp.asarray(valid, bool) & jnp.isfinite(series)
+    n = int(valid.sum())
+    mean = float(jnp.where(valid, series, 0.0).sum() / max(n, 1)) \
+        if n else float("nan")
+    se = float(nw_mean_se(series, valid, lags=nw_lags, weight=weight))
+    tstat = mean / se if n and np.isfinite(se) and se else float("nan")
+    return mean, se, tstat, n
+
+
+def bootstrap_series(
+    series,
+    valid=None,
+    draws: int = 100,
+    seed: int = 0,
+    block: Optional[int] = None,
+    nw_lags: int = 4,
+    weight: str = "reference",
+):
+    """Circular-block bootstrap of a backtest series over ORIGINS — the
+    device-batched gathered aggregator (``specgrid.boot``) on the same
+    archived draw seeds as the spec-grid engine, so results are
+    reproducible against any other consumer of ``(seed, draw)``.
+
+    ``series`` may be (T,) or (T, P) — several series (spread, IC, rank
+    IC) share one gather plan. Returns
+    ``(point (P,), boot_se (P,), draw_means (draws-1, P))`` where
+    ``point`` is the never-resampled draw-0 mean and ``boot_se`` the
+    ddof-1 std of the resampled draw means (NaN below 3 draws)."""
+    from fm_returnprediction_tpu.specgrid.boot import (
+        bootstrap_aggregate_device,
+        resample_matrix,
+    )
+
+    if draws < 1:
+        raise ValueError("draws counts the point estimate; must be >= 1")
+    arr = np.asarray(series, float)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    t, p = arr.shape
+    month_valid = np.isfinite(arr).any(axis=1) if valid is None \
+        else np.asarray(valid, bool)
+    point = np.array([
+        arr[np.isfinite(arr[:, j]) & month_valid, j].mean()
+        if (np.isfinite(arr[:, j]) & month_valid).any() else np.nan
+        for j in range(p)
+    ])
+    if draws < 2:
+        return point, np.full(p, np.nan), np.zeros((0, p))
+    idx = resample_matrix(t, int(draws), seed=seed, block=block)
+    coef, _, _, _, _, _ = bootstrap_aggregate_device(
+        arr, np.zeros(t), np.zeros(t), month_valid, idx,
+        nw_lags=nw_lags, min_months=1, weight=weight,
+    )
+    draw_means = np.asarray(coef)                           # (draws-1, P)
+    boot_se = (np.nanstd(draw_means, axis=0, ddof=1)
+               if draws >= 3 else np.full(p, np.nan))
+    return point, boot_se, draw_means

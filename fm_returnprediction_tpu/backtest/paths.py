@@ -1,0 +1,428 @@
+"""Rolling-origin coefficient paths on banked Gram stats — the scan route.
+
+The estimation insight the whole subsystem rides: per-month
+cross-sectional slopes are WINDOW-INDEPENDENT. Each month's slope solves
+from that month's own Gram (``solve.solve_spec_stats`` batches every
+(pair, month) system at once), and an expanding or rolling estimation
+window only selects WHICH months enter the Fama-MacBeth coefficient mean.
+So the entire origin-indexed coefficient path — "estimate on months ≤ t,
+for every t" — is ONE batched per-month solve plus a masked prefix sum:
+
+    expanding:   path_t = Σ_{s≤t} v_s β_s / Σ_{s≤t} v_s
+    rolling-W:   path_t = (C_t − C_{t−W}) / (c_t − c_{t−W}),  C = cumsum(vβ)
+
+with ``v_s`` the month-validity indicator. Exact by Gram additivity: the
+per-origin full-refit loop (mask the banked stats at each origin, fresh
+solve, re-aggregate) produces the same numbers up to summation order
+(f64 ≤ 1e-13; pinned in ``tests/test_backtest.py``), and is retained as
+the differential ORACLE behind ``FMRP_BACKTEST_ROUTE=refit``.
+
+Estimator composition (the PR-16 grammar): ``ols`` solves the banked
+stats as-is; ``fwl`` partials the control block out of every month's Gram
+first (``estimators.fwl.fwl_transform`` — the Schur complement, so focal
+path slopes are exactly the full regression's). The kinds that do NOT
+compose are rejected LOUDLY via ``resolve_estimator(allowed=...)``:
+``iv``'s projected system and ``pooled``'s single-β cell have no
+per-month slope path to roll an origin over, and ``absorb`` needs
+per-(month, FE-cell) stats the bank does not carry. Under FWL the
+reported intercept is exactly 0 (the transform residualizes y against
+the controls), so predictions quote the PARTIALLED focal projection —
+disclosed via ``estimator_label``, never silently mixed with OLS paths.
+
+Prediction alignment: the coefficient path at origin t is applied to
+month t+1's characteristics (``x`` already holds lagged characteristics,
+the repo-wide convention — ``models.forecast``), an O(N·P) einsum per
+month that never forms a Gram: the contraction ledger
+(``solve.CONTRACTIONS``) stays flat across a whole backtest sweep, the
+``run_backtest`` stats dict proves it.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+
+__all__ = [
+    "BACKTEST_ROUTES",
+    "BacktestPaths",
+    "backtest_paths",
+    "parse_scheme",
+    "predict_er",
+    "resolve_backtest_route",
+    "resolve_quantiles",
+    "resolve_schemes",
+]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+BACKTEST_ROUTES = ("auto", "scan", "refit")
+
+#: estimator kinds with a per-month slope path an origin can roll over
+BACKTEST_ESTIMATOR_KINDS = ("ols", "fwl")
+
+DEFAULT_SCHEMES = "expanding,rolling120"
+DEFAULT_QUANTILES = 10
+
+
+def resolve_backtest_route(route: Optional[str] = None) -> str:
+    """The path route: explicit argument > ``FMRP_BACKTEST_ROUTE`` env >
+    ``"auto"`` (→ scan). Resolved OUTSIDE jit (the repo's knob
+    discipline); ``"refit"`` keeps the per-origin full-refit loop — the
+    differential oracle — as a first-class production escape hatch."""
+    if route is None:
+        route = os.environ.get("FMRP_BACKTEST_ROUTE", "auto").strip().lower() \
+            or "auto"
+    if route not in BACKTEST_ROUTES:
+        raise ValueError(
+            f"backtest route must be one of {BACKTEST_ROUTES}, got {route!r}"
+        )
+    return route
+
+
+def parse_scheme(text: str) -> Tuple[str, Optional[int]]:
+    """Parse one window-scheme name: ``"expanding"`` (origin t estimates
+    on all months ≤ t) or ``"rolling<W>"`` (the last W months ≤ t, e.g.
+    ``"rolling120"``). Returns ``(name, window)`` with ``window=None``
+    for expanding."""
+    name = (text or "").strip().lower()
+    if name == "expanding":
+        return name, None
+    if name.startswith("rolling"):
+        digits = name[len("rolling"):]
+        if digits.isdigit() and int(digits) >= 1:
+            return name, int(digits)
+    raise ValueError(
+        f"window scheme must be 'expanding' or 'rolling<W>' (W >= 1), "
+        f"got {text!r}"
+    )
+
+
+def resolve_schemes(schemes=None) -> Tuple[Tuple[str, Optional[int]], ...]:
+    """The scheme list: explicit argument (a comma string or an iterable
+    of scheme names) > ``FMRP_BACKTEST_SCHEMES`` env > the default
+    ``"expanding,rolling120"``."""
+    if schemes is None:
+        schemes = os.environ.get("FMRP_BACKTEST_SCHEMES",
+                                 DEFAULT_SCHEMES).strip() or DEFAULT_SCHEMES
+    if isinstance(schemes, str):
+        names = [s for s in (p.strip() for p in schemes.split(",")) if s]
+    else:
+        names = [str(s).strip() for s in schemes]
+    if not names:
+        raise ValueError("at least one window scheme is required")
+    parsed = tuple(parse_scheme(n) for n in names)
+    if len({n for n, _ in parsed}) != len(parsed):
+        raise ValueError(f"window schemes repeat a name: {names}")
+    return parsed
+
+
+def resolve_quantiles(n: Optional[int] = None) -> int:
+    """Portfolio quantile count: explicit argument >
+    ``FMRP_BACKTEST_QUANTILES`` env > 10 (deciles). Must be >= 2."""
+    if n is None:
+        n = int(os.environ.get("FMRP_BACKTEST_QUANTILES", DEFAULT_QUANTILES))
+    n = int(n)
+    if n < 2:
+        raise ValueError(f"quantile count must be >= 2, got {n}")
+    return n
+
+
+class BacktestPaths(NamedTuple):
+    """Origin-indexed coefficient paths for every banked pair (host
+    numpy). ``beta`` is the per-month [intercept, slopes] solve (zeros on
+    unselected columns and invalid months — NOT NaN, so path sums never
+    poison); ``path[k, t]`` is the coefficient mean an estimation ending
+    at origin t would use, NaN-gated where fewer than ``min_months``
+    months entered. ``col_sel`` is the selection actually SOLVED (focal
+    columns under FWL)."""
+
+    beta: np.ndarray          # (K, T, Q) per-month [intercept, slopes]
+    month_valid: np.ndarray   # (K, T) bool
+    path: np.ndarray          # (K, T, Q) origin-t coefficient means
+    count: np.ndarray         # (K, T) months entering each origin's mean
+    suspect: np.ndarray       # (K, T) bool — disclosed, never refereed
+    col_sel: np.ndarray       # (K, P) bool — the solved selection
+    scheme: str
+    window: Optional[int]
+    estimator_label: str
+    route: str
+
+
+def _estimator_selection(bank, est):
+    """The (sel_aug, ctrl_aug, sel_solve) selectors an estimator needs on
+    this bank's pairs — the ``grambank.estimator_query`` discipline:
+    every control must be banked in EVERY pair (loud otherwise)."""
+    union = bank.union
+    pos = {c: i for i, c in enumerate(union)}
+    col_sel = np.asarray(bank.col_sel, bool)
+    k = bank.n_pairs
+    ones = np.ones((k, 1), bool)
+    ctrl_aug = np.zeros((k, len(union) + 1), bool)
+    sel_solve = col_sel
+    if est.kind == "fwl":
+        ctrl = np.zeros(len(union), bool)
+        for nm in est.controls:
+            if nm not in pos:
+                raise KeyError(
+                    f"estimator control column {nm!r} is not in the "
+                    f"bank's union {tuple(union)}"
+                )
+            ctrl[pos[nm]] = True
+        lacking = [bank.pair_labels[j] for j in range(k)
+                   if not (ctrl <= col_sel[j]).all()]
+        if lacking:
+            raise ValueError(
+                "estimator control columns were not contracted into "
+                f"every banked pair — pairs lacking them: {lacking}; "
+                "rebuild the bank with the columns in each regressor set"
+            )
+        sel_solve = col_sel & ~ctrl[None, :]
+        ctrl_aug = np.concatenate(
+            [ones, np.broadcast_to(ctrl, col_sel.shape)], axis=1
+        )
+    sel_aug = np.concatenate([ones, sel_solve], axis=1)
+    return sel_aug, ctrl_aug, sel_solve
+
+
+def _bank_eps(bank):
+    """(data_eps, contracted_eps) under the precision policy the bank's
+    estimator queries already follow: cutoffs at the eps the stats were
+    CONTRACTED in, with the x64-upcast disclosure."""
+    precision = str(bank.meta.get("precision", "highest"))
+    bank_dtype = np.dtype(bank.dtype)
+    data_eps = float(jnp.finfo(jnp.bfloat16).eps) if precision == "bf16" \
+        else float(np.finfo(bank_dtype).eps)
+    upcasts = (jax.config.jax_enable_x64 and bank_dtype != np.float64)
+    contracted_eps = data_eps if (precision == "bf16" or upcasts) else None
+    return data_eps, contracted_eps
+
+
+def _transform_and_solve(stats, sel_aug, ctrl_aug, kind: str,
+                         data_eps: float, contracted_eps):
+    """The shared per-month estimation core of both routes: (optional)
+    FWL Schur complement, then the grid route's own padded solve."""
+    from fm_returnprediction_tpu.specgrid.estimators.fwl import fwl_transform
+    from fm_returnprediction_tpu.specgrid.estimators.grid import _upcast
+    from fm_returnprediction_tpu.specgrid.solve import solve_spec_stats
+
+    stats = _upcast(stats)
+    deficient = jnp.zeros_like(stats.n, bool)
+    if kind == "fwl":
+        stats, deficient = fwl_transform(stats, sel_aug | ctrl_aug,
+                                         ctrl_aug, data_eps)
+    sol = solve_spec_stats(stats, sel_aug, contracted_eps=contracted_eps)
+    suspect = sol.suspect | (deficient & sol.month_valid)
+    return sol, suspect
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kind", "window", "min_months", "data_eps",
+                     "contracted_eps"),
+)
+def _backtest_path_program(gram, moment, n, ysum, yy, center, sel_aug,
+                           ctrl_aug, *, kind: str, window: Optional[int],
+                           min_months: int, data_eps: float,
+                           contracted_eps: Optional[float]):
+    """The SCAN route — one fused program: batched per-month solve over
+    the banked stats, then the masked prefix-sum coefficient paths. The
+    (T, N, P) panel never appears; the largest operand is the
+    (K, T, Q, Q) bank."""
+    from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["backtest_path"] += 1
+    record_trace("backtest_path")
+    stats = SpecGramStats(gram, moment, n, ysum, yy, center)
+    sol, suspect = _transform_and_solve(stats, sel_aug, ctrl_aug, kind,
+                                        data_eps, contracted_eps)
+    beta, month_valid = sol.beta, sol.month_valid
+    dtype = beta.dtype
+    v = month_valid.astype(dtype)                              # (K, T)
+    cs_b = jnp.cumsum(beta * v[..., None], axis=1)             # (K, T, Q)
+    cs_c = jnp.cumsum(v, axis=1)                               # (K, T)
+    if window is not None:
+        # rolling-W: C_t − C_{t−W} (prefix sums W slots apart; the shift
+        # prepends exact zeros, so early origins fall back to expanding
+        # over the first min(t+1, W) months — then the min_months gate)
+        prev_b = jnp.pad(cs_b, ((0, 0), (window, 0), (0, 0)))[
+            :, :cs_b.shape[1]]
+        prev_c = jnp.pad(cs_c, ((0, 0), (window, 0)))[:, :cs_c.shape[1]]
+        sum_b, cnt = cs_b - prev_b, cs_c - prev_c
+    else:
+        sum_b, cnt = cs_b, cs_c
+    have = cnt >= min_months
+    path = jnp.where(have[..., None],
+                     sum_b / jnp.maximum(cnt, 1.0)[..., None], jnp.nan)
+    return beta, month_valid, path, cnt, suspect
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kind", "data_eps", "contracted_eps"),
+)
+def _refit_origin_program(gram, moment, n, ysum, yy, center, sel_aug,
+                          ctrl_aug, window, *, kind: str, data_eps: float,
+                          contracted_eps: Optional[float]):
+    """ONE origin of the REFIT oracle: mask the banked stats to the
+    origin's estimation window (``expand_window_stats`` — exact), run a
+    FRESH per-month solve on the masked stats, and aggregate directly
+    (a plain masked mean, not a prefix sum — a genuinely independent
+    summation order). The oracle pays one dispatch per origin, which is
+    exactly the cost the scan route amortizes away."""
+    from fm_returnprediction_tpu.specgrid.solve import (
+        PROGRAM_TRACES,
+        expand_window_stats,
+    )
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["backtest_refit_origin"] += 1
+    record_trace("backtest_refit_origin")
+    stats = SpecGramStats(gram, moment, n, ysum, yy, center)
+    k = gram.shape[0]
+    masked = expand_window_stats(stats, jnp.arange(k), window)
+    sol, suspect = _transform_and_solve(masked, sel_aug, ctrl_aug, kind,
+                                        data_eps, contracted_eps)
+    v = sol.month_valid.astype(sol.beta.dtype)
+    cnt = v.sum(axis=1)                                        # (K,)
+    mean = (sol.beta * v[..., None]).sum(axis=1) \
+        / jnp.maximum(cnt, 1.0)[..., None]                     # (K, Q)
+    return mean, cnt, sol.beta, sol.month_valid, suspect
+
+
+def backtest_paths(
+    bank,
+    scheme: str = "expanding",
+    estimator=None,
+    min_months: Optional[int] = None,
+    route: Optional[str] = None,
+) -> BacktestPaths:
+    """Origin-indexed coefficient paths for every banked pair under one
+    window scheme — the backtest's estimation half, answered entirely
+    from the bank.
+
+    ``scheme`` is ``"expanding"`` or ``"rolling<W>"`` (:func:`parse_scheme`);
+    ``estimator`` composes the PR-16 grammar where a per-month slope path
+    exists (``ols``/``fwl``; everything else is rejected loudly);
+    ``min_months`` gates origins whose window holds too few surviving
+    months (default: the bank's own ``min_months`` meta); ``route``
+    resolves via :func:`resolve_backtest_route` — ``"scan"`` (and
+    ``"auto"``) run the fused prefix-sum program, ``"refit"`` the
+    per-origin full-refit oracle."""
+    from fm_returnprediction_tpu.specgrid.estimators.core import (
+        resolve_estimator,
+    )
+
+    est = resolve_estimator(estimator, allowed=BACKTEST_ESTIMATOR_KINDS)
+    scheme_name, window = parse_scheme(scheme)
+    route = resolve_backtest_route(route)
+    effective = "refit" if route == "refit" else "scan"
+    min_months = int(bank.meta.get("min_months", 10) if min_months is None
+                     else min_months)
+    sel_aug, ctrl_aug, sel_solve = _estimator_selection(bank, est)
+    data_eps, contracted_eps = _bank_eps(bank)
+    s = bank.stats()
+    args = (s.gram, s.moment, s.n, s.ysum, s.yy, s.center,
+            jnp.asarray(sel_aug), jnp.asarray(ctrl_aug))
+
+    if effective == "scan":
+        beta, month_valid, path, cnt, suspect = jax.device_get(
+            _backtest_path_program(
+                *args, kind=est.kind, window=window, min_months=min_months,
+                data_eps=data_eps, contracted_eps=contracted_eps,
+            )
+        )
+        return BacktestPaths(
+            beta=np.asarray(beta), month_valid=np.asarray(month_valid),
+            path=np.asarray(path), count=np.asarray(cnt),
+            suspect=np.asarray(suspect), col_sel=sel_solve,
+            scheme=scheme_name, window=window,
+            estimator_label=est.label, route=effective,
+        )
+
+    # refit oracle: one masked re-solve + re-aggregate per origin
+    t, k, q = bank.n_months, bank.n_pairs, len(bank.union) + 1
+    path = np.full((k, t, q), np.nan)
+    count = np.zeros((k, t))
+    beta = month_valid = suspect = None
+    for origin in range(t):
+        lo = 0 if window is None else max(0, origin - window + 1)
+        win = np.zeros(t, bool)
+        win[lo:origin + 1] = True
+        mean, cnt, b, mv, sus = jax.device_get(_refit_origin_program(
+            *args, jnp.asarray(np.broadcast_to(win, (k, t))),
+            kind=est.kind, data_eps=data_eps,
+            contracted_eps=contracted_eps,
+        ))
+        ok = np.asarray(cnt) >= min_months
+        path[:, origin][ok] = np.asarray(mean)[ok]
+        count[:, origin] = np.asarray(cnt)
+        if origin == t - 1:
+            # the full-sample origin sees every month: its per-month
+            # leaves ARE the unwindowed solve (window-independence)
+            beta, month_valid, suspect = (np.asarray(b), np.asarray(mv),
+                                          np.asarray(sus))
+    if window is not None:
+        # the last origin's window misses early months — re-solve the
+        # full sample once for the disclosed per-month leaves
+        full = np.ones(t, bool)
+        _, _, b, mv, sus = jax.device_get(_refit_origin_program(
+            *args, jnp.asarray(np.broadcast_to(full, (k, t))),
+            kind=est.kind, data_eps=data_eps,
+            contracted_eps=contracted_eps,
+        ))
+        beta, month_valid, suspect = (np.asarray(b), np.asarray(mv),
+                                      np.asarray(sus))
+    return BacktestPaths(
+        beta=beta, month_valid=month_valid, path=path, count=count,
+        suspect=suspect, col_sel=sel_solve, scheme=scheme_name,
+        window=window, estimator_label=est.label, route=effective,
+    )
+
+
+@jax.jit
+def _predict_program(coef, col_sel_row, x, mask):
+    """Ê[r] for one pair from an origin-ALIGNED coefficient path: month
+    t's forecast uses ``coef[t]`` (already shifted to origin t−1 by the
+    caller). An O(N·P) einsum per month — never a Gram contraction."""
+    from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["backtest_predict"] += 1
+    record_trace("backtest_predict")
+    have = jnp.isfinite(coef[:, 0])                            # (T,)
+    # a row forecasts when its SOLVED predictors are all finite —
+    # unselected columns carry exact-zero path slopes and never matter
+    rows = mask & jnp.all(jnp.isfinite(x) | ~col_sel_row, axis=-1)
+    er = coef[:, 0][:, None] + jnp.einsum(
+        "tnp,tp->tn",
+        jnp.where(rows[..., None] & col_sel_row, x, 0.0),
+        jnp.where(have[:, None], coef[:, 1:], 0.0),
+        precision=_PRECISION,
+    )
+    er_valid = rows & have[:, None]
+    return jnp.where(er_valid, er, jnp.nan), er_valid
+
+
+def predict_er(paths: BacktestPaths, x, universe_mask, pair: int):
+    """Out-of-sample Ê[r] for one banked pair: the coefficient path at
+    origin t−1 applied to month t's (lagged) characteristics — strictly
+    past information only; month 0 has no origin and never forecasts.
+    ``x`` holds the bank's union columns; returns host
+    ``(er (T, N), er_valid (T, N))``."""
+    coef_path = np.asarray(paths.path[pair])
+    q = coef_path.shape[1]
+    shifted = np.concatenate(
+        [np.full((1, q), np.nan, coef_path.dtype), coef_path[:-1]], axis=0
+    )
+    er, er_valid = jax.device_get(_predict_program(
+        jnp.asarray(shifted), jnp.asarray(paths.col_sel[pair]),
+        jnp.asarray(x), jnp.asarray(universe_mask),
+    ))
+    return np.asarray(er), np.asarray(er_valid)

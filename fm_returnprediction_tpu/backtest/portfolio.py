@@ -1,0 +1,168 @@
+"""Per-month quantile portfolios on predicted E[r] — EW/VW, spread,
+turnover.
+
+The portfolio half of the backtest subsystem: sort each month's
+cross-section into ``n_quantiles`` buckets on the out-of-sample forecast,
+track each bucket's realized return at t+1 under equal or value weights,
+the top-minus-bottom spread with its NW t-stat, and one-way turnover.
+
+Conventions (inherited from ``models.forecast.decile_sorts``, the
+Lewellen parity surface, and extended):
+
+- breakpoints are the masked interior percentiles (``ops.quantiles.
+  masked_quantile`` — pandas-linear interpolation);
+- assignment is TIE-DETERMINISTIC: bucket = number of breakpoints
+  STRICTLY below the forecast, so equal forecasts land in the same
+  bucket regardless of firm order, tile width, or backend;
+- a month participates with at least ``min_obs`` sortable firms; summary
+  statistics (per-bucket means, the spread) use months where EVERY
+  bucket is populated, so they cover the same months;
+- value weights are the supplied per-firm weight (market equity in the
+  pipeline); non-finite or non-positive weights drop the firm from the
+  sortable set — a VW portfolio cannot hold an unweightable position;
+- one-way turnover of bucket d at month t is
+  ``½ Σ_i |w_{t,i,d} − w_{t−1,i,d}|`` over the bucket's NORMALIZED
+  weights (EW: 1/count; VW: weight/Σweight), defined when t and t−1 are
+  both valid months with the bucket populated; ``spread_turnover`` is
+  the mean of the two legs' turnovers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.ops.newey_west import nw_mean_se
+from fm_returnprediction_tpu.ops.quantiles import masked_quantile
+
+__all__ = ["PortfolioResult", "quantile_sorts"]
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+class PortfolioResult(NamedTuple):
+    quantile_returns: jnp.ndarray  # (T, D) realized return per bucket
+    counts: jnp.ndarray            # (T, D) firms per bucket
+    month_valid: jnp.ndarray       # (T,) months with a sortable section
+    mean_returns: jnp.ndarray      # (D,) time-series mean per bucket
+    spread_series: jnp.ndarray     # (T,) top − bottom realized return
+    spread: jnp.ndarray            # () mean spread
+    spread_tstat: jnp.ndarray      # () spread / NW SE
+    spread_nw_se: jnp.ndarray      # ()
+    turnover: jnp.ndarray          # (T, D) one-way turnover per bucket
+    spread_turnover: jnp.ndarray   # (T,) mean of the two legs' turnovers
+    n_months: jnp.ndarray          # () months in the summary statistics
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_quantiles", "min_obs", "nw_lags", "nw_weight",
+                     "value_weighted"),
+)
+def quantile_sorts(
+    er: jnp.ndarray,
+    er_valid: jnp.ndarray,
+    realized: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+    n_quantiles: int = 10,
+    min_obs: int = 50,
+    nw_lags: int = 4,
+    nw_weight: str = "reference",
+    value_weighted: bool = False,
+) -> PortfolioResult:
+    """Monthly quantile portfolios on the forecast — one fused program.
+
+    ``er``/``er_valid``/``realized`` are (T, N); ``weights`` is the (T, N)
+    value-weight variable, consulted only under ``value_weighted=True``
+    (the static flag keeps the EW jaxpr free of the weight operand).
+    The per-bucket loop is a static unroll over ``n_quantiles`` — peak
+    incremental memory is one (T, N) bucket slice, never the (T, N, D)
+    one-hot tensor."""
+    from fm_returnprediction_tpu.specgrid.solve import PROGRAM_TRACES
+    from fm_returnprediction_tpu.telemetry import record_trace
+
+    PROGRAM_TRACES["backtest_portfolio"] += 1
+    record_trace("backtest_portfolio")
+    dtype = er.dtype
+    ok = er_valid & jnp.isfinite(realized)
+    if value_weighted:
+        ok = ok & jnp.isfinite(weights) & (weights > 0)
+        wv = jnp.where(ok, weights, 0.0).astype(dtype)
+    else:
+        wv = ok.astype(dtype)
+    n = ok.sum(axis=1)
+    month_valid = n >= min_obs
+
+    qs = jnp.arange(1, n_quantiles) / n_quantiles
+    breaks = masked_quantile(er, ok, qs)                   # (T, D-1)
+    # bucket = number of interior breakpoints STRICTLY below the
+    # forecast — the tie-deterministic assignment
+    er_z = jnp.where(ok, er, 0.0)
+    bucket = (er_z[:, :, None] > breaks[:, None, :]).sum(axis=-1)  # (T, N)
+
+    ret_z = jnp.where(ok, realized, 0.0)
+    qret_cols, cnt_cols, tau_cols = [], [], []
+    for d in range(n_quantiles):
+        sel = (bucket == d) & ok
+        wd = jnp.where(sel, wv, 0.0)                       # (T, N)
+        sw = wd.sum(axis=1)
+        cnt = sel.sum(axis=1)
+        sums = jnp.einsum("tn,tn->t", wd, ret_z, precision=_PRECISION)
+        qret = jnp.where(sw > 0, sums / jnp.where(sw > 0, sw, 1.0),
+                         jnp.nan)
+        # normalized holdings → one-way turnover against last month
+        wnorm = wd / jnp.where(sw > 0, sw, 1.0)[:, None]
+        tau_tail = 0.5 * jnp.abs(wnorm[1:] - wnorm[:-1]).sum(axis=1)
+        tau = jnp.concatenate(
+            [jnp.full((1,), jnp.nan, dtype), tau_tail.astype(dtype)]
+        )
+        both = jnp.concatenate(
+            [jnp.zeros((1,), bool),
+             month_valid[1:] & month_valid[:-1] & (cnt[1:] > 0)
+             & (cnt[:-1] > 0)]
+        )
+        qret_cols.append(jnp.where(month_valid, qret, jnp.nan))
+        cnt_cols.append(cnt)
+        tau_cols.append(jnp.where(both, tau, jnp.nan))
+    qret = jnp.stack(qret_cols, axis=1)                    # (T, D)
+    counts = jnp.stack(cnt_cols, axis=1)
+    turnover = jnp.stack(tau_cols, axis=1)
+
+    # summary over months where EVERY bucket is populated — per-bucket
+    # means and the spread cover the same months (decile_sorts contract)
+    usable = month_valid & jnp.all(counts > 0, axis=1)
+    n_use = usable.sum()
+    mean_ret = jnp.where(
+        n_use > 0,
+        jnp.where(usable[:, None], jnp.nan_to_num(qret), 0.0).sum(axis=0)
+        / jnp.maximum(n_use, 1).astype(dtype),
+        jnp.nan,
+    )
+    spread_series = qret[:, -1] - qret[:, 0]
+    spread_valid = usable & jnp.isfinite(spread_series)
+    n_spread = spread_valid.sum()
+    spread = jnp.where(
+        n_spread > 0,
+        jnp.where(spread_valid, spread_series, 0.0).sum()
+        / jnp.maximum(n_spread, 1).astype(dtype),
+        jnp.nan,
+    )
+    se = nw_mean_se(spread_series, spread_valid, lags=nw_lags,
+                    weight=nw_weight)
+    spread_turnover = 0.5 * (turnover[:, -1] + turnover[:, 0])
+    return PortfolioResult(
+        quantile_returns=qret,
+        counts=counts,
+        month_valid=month_valid,
+        mean_returns=mean_ret,
+        spread_series=spread_series,
+        spread=spread,
+        spread_tstat=spread / se,
+        spread_nw_se=se,
+        turnover=turnover,
+        spread_turnover=spread_turnover,
+        n_months=n_spread,
+    )

@@ -1,0 +1,316 @@
+"""The backtest tile runner — stream cells to sinks with the
+zero-panel-contraction ledger proof.
+
+``run_backtest`` walks a ``BacktestSpace`` tile by tile and emits ONE ROW
+PER CELL (the wide metric schema the sinks document). The execution
+grouping rides the space's dimension order:
+
+- a ONE-SLOT path memo keyed by the (scheme, estimator) digits — cells
+  are contiguous in that key, so exactly one coefficient-path solve
+  (``backtest_paths``) is live at any moment regardless of sweep size;
+- a ONE-SLOT pair memo keyed by (path, pair) for the predicted-E[r]
+  panel and the weighting-independent metrics (OOS R², IC series and
+  their NW inference) — EW and VW cells of the same pair reuse it;
+- the portfolio program (``quantile_sorts``) runs per cell: weighting is
+  the innermost digit and a static jit flag, so the sweep compiles at
+  most two sort programs (EW, VW) per shape.
+
+The LEDGER PROOF: everything after bank construction is per-month-Gram
+re-aggregation plus O(N·P) prediction einsums, so the panel-contraction
+counters (``specgrid.solve.contraction_counts``) must not move during
+the sweep. ``run_backtest`` snapshots the counters and returns the delta
+in its stats dict — ``panel_contractions`` is asserted 0 in
+``tests/test_backtest.py`` and pinned in the bench section, exactly the
+PR 14/16 discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fm_returnprediction_tpu.backtest.evaluate import (
+    bootstrap_series,
+    ic_series,
+    oos_r2,
+    series_inference,
+)
+from fm_returnprediction_tpu.backtest.paths import (
+    backtest_paths,
+    predict_er,
+    resolve_backtest_route,
+)
+from fm_returnprediction_tpu.backtest.portfolio import quantile_sorts
+from fm_returnprediction_tpu.backtest.sinks import (
+    resolve_backtest_sink,
+    resolve_backtest_sink_name,
+)
+from fm_returnprediction_tpu.backtest.space import BacktestSpace, backtest_space
+
+__all__ = ["run_backtest", "run_backtest_scenarios"]
+
+
+def _finite_mean(series: np.ndarray) -> float:
+    good = np.isfinite(series)
+    return float(series[good].mean()) if good.any() else float("nan")
+
+
+def run_backtest_scenarios(
+    panel,
+    subset_masks: Dict[str, object],
+    variables_dict: Dict[str, str],
+    models=None,
+    universes=None,
+    schemes=None,
+    estimator=None,
+    weightings=("ew", "vw"),
+    n_quantiles: Optional[int] = None,
+    min_obs: int = 50,
+    route: Optional[str] = None,
+    sink=None,
+    output_dir=None,
+    weights_col: str = "me",
+    return_col: str = "retx",
+    nw_lags: int = 4,
+    min_months: int = 10,
+    bootstrap: int = 1,
+    seed: int = 0,
+    return_stats: bool = False,
+):
+    """The PIPELINE's backtest stage: contract the scenario panel once
+    into a Gram bank (``specgrid.scenarios.bank_for_scenarios`` — the
+    PR-14/16 factorized route), then answer the whole backtest cell
+    product (scheme × estimator × model × universe × weighting) from it
+    with :func:`run_backtest` — zero further (T, N, P) contractions,
+    ledger-proven in the returned stats.
+
+    ``estimator`` is one grammar string or ``Estimator`` (``"fwl:beme"``)
+    composed next to OLS when given; ``weights_col`` is the VW weight
+    variable (market equity) — when the panel lacks it, VW cells drop to
+    EW-only with the reduction disclosed in stats rather than a crash.
+    Returns the sink's frame, or ``(frame, stats)`` under
+    ``return_stats=True``."""
+    from fm_returnprediction_tpu.specgrid.estimators.core import EST_OLS
+    from fm_returnprediction_tpu.specgrid.scenarios import bank_for_scenarios
+
+    universes = (list(universes) if universes is not None
+                 else list(subset_masks))
+    bank = bank_for_scenarios(
+        panel, subset_masks, variables_dict, models=models,
+        universes=universes, nw_lags=nw_lags, min_months=min_months,
+        return_col=return_col, fingerprint="backtest",
+    )
+    estimators = (EST_OLS,) if estimator is None else (estimator,)
+    weightings = tuple(weightings)
+    weights = None
+    reduced = False
+    if "vw" in weightings:
+        if weights_col in panel.var_names:
+            weights = np.asarray(panel.var(weights_col))
+        else:
+            weightings = tuple(w for w in weightings if w != "vw")
+            reduced = True
+            if not weightings:
+                raise ValueError(
+                    f"panel lacks the weight column {weights_col!r} and "
+                    "only 'vw' was requested"
+                )
+    space = backtest_space(
+        bank, schemes=schemes, estimators=estimators,
+        weightings=weightings, n_quantiles=n_quantiles, min_obs=min_obs,
+    )
+    x = np.asarray(panel.select(list(bank.union)))
+    realized = np.asarray(panel.var(return_col))
+    frame, stats = run_backtest(
+        bank, x, realized,
+        {name: np.asarray(subset_masks[name]) for name in space.universes},
+        space=space, weights_var=weights, sink=sink, output_dir=output_dir,
+        route=route, min_months=min_months, bootstrap=bootstrap, seed=seed,
+    )
+    stats["weighting_reduced"] = reduced
+    if return_stats:
+        return frame, stats
+    return frame
+
+
+def run_backtest(
+    bank,
+    x,
+    realized,
+    universe_masks: Dict[str, np.ndarray],
+    space: Optional[BacktestSpace] = None,
+    weights_var=None,
+    sink=None,
+    output_dir=None,
+    tile_cells: Optional[int] = None,
+    route: Optional[str] = None,
+    min_months: Optional[int] = None,
+    bootstrap: int = 1,
+    seed: int = 0,
+    block: Optional[int] = None,
+) -> Tuple[pd.DataFrame, Dict[str, object]]:
+    """Run one backtest sweep over a bank, streaming cell rows to a sink.
+
+    ``x`` is the (T, N, P) lagged-characteristic tensor in the BANK'S
+    union column order (the tensor the bank was contracted from);
+    ``realized`` the (T, N) return panel the forecasts are scored
+    against; ``universe_masks`` maps each of the space's universe names
+    to its (T, N) membership mask; ``weights_var`` the (T, N)
+    value-weight variable (market equity) — required iff the space
+    includes ``"vw"``. ``bootstrap`` counts draws including the point
+    estimate; above 1 each cell's spread series is block-bootstrapped
+    over origins (``spread_boot_se`` column).
+
+    Returns ``(sink.finish(), stats)`` where ``stats`` carries the
+    ledger proof (``panel_contractions`` — must be 0), the resolved
+    route/sink, and the solve/memo counts."""
+    from fm_returnprediction_tpu.specgrid.solve import contraction_counts
+
+    if space is None:
+        space = backtest_space(bank)
+    expect = tuple((s, u) for s in space.sets for u in space.universes)
+    if expect != tuple(bank.pair_labels):
+        raise ValueError(
+            f"space pair product {expect} does not address the bank's "
+            f"pair axis {tuple(bank.pair_labels)}"
+        )
+    missing = [u for u in space.universes if u not in universe_masks]
+    if missing:
+        raise KeyError(f"universe masks missing for {missing}")
+    if "vw" in space.weightings and weights_var is None:
+        raise ValueError(
+            "space includes 'vw' weighting but no weights_var was given "
+            "— a value-weighted portfolio needs the weight panel"
+        )
+    route = resolve_backtest_route(route)
+    sink_obj = resolve_backtest_sink(sink, output_dir=output_dir)
+    sink_name = resolve_backtest_sink_name(sink_obj)
+    if int(bootstrap) < 1:
+        raise ValueError("bootstrap counts the point estimate; must be >= 1")
+
+    x_dev = jnp.asarray(x)
+    realized_dev = jnp.asarray(realized)
+    realized_host = np.asarray(realized, float)
+    weights_dev = None if weights_var is None else jnp.asarray(weights_var)
+
+    before = contraction_counts()
+    path_memo: Dict[tuple, object] = {}
+    pair_memo: Dict[tuple, dict] = {}
+    path_solves = 0
+    predict_calls = 0
+    n_tiles = 0
+
+    for tile in space.tiles(tile_cells):
+        n_tiles += 1
+        rows = []
+        for cell in tile.cells():
+            pkey = space.path_key(cell.index)
+            if pkey not in path_memo:
+                path_memo.clear()  # one-slot: cells are contiguous in pkey
+                path_memo[pkey] = backtest_paths(
+                    bank, scheme=cell.scheme, estimator=cell.estimator,
+                    min_months=min_months, route=route,
+                )
+                path_solves += 1
+            paths = path_memo[pkey]
+
+            mkey = (pkey, cell.pair)
+            if mkey not in pair_memo:
+                pair_memo.clear()  # one-slot: weighting is innermost
+                er, er_valid = predict_er(
+                    paths, x_dev, jnp.asarray(universe_masks[cell.universe]),
+                    cell.pair,
+                )
+                predict_calls += 1
+                er_dev = jnp.asarray(er)
+                ev_dev = jnp.asarray(er_valid)
+                r2 = float(oos_r2(er_dev, ev_dev, realized_dev))
+                ic, rank_ic, _ = ic_series(er_dev, ev_dev, realized_dev)
+                ic = np.asarray(ic)
+                rank_ic = np.asarray(rank_ic)
+                ic_mean, ic_se, ic_t, ic_n = series_inference(
+                    ic, nw_lags=space.nw_lags)
+                rk_mean, _, rk_t, _ = series_inference(
+                    rank_ic, nw_lags=space.nw_lags)
+                pair_memo[mkey] = {
+                    "er": er_dev, "er_valid": ev_dev, "oos_r2": r2,
+                    "ic_mean": ic_mean, "ic_nw_se": ic_se, "ic_tstat": ic_t,
+                    "ic_months": ic_n, "rank_ic_mean": rk_mean,
+                    "rank_ic_tstat": rk_t,
+                    "forecast_months": int(np.asarray(er_valid)
+                                           .any(axis=1).sum()),
+                    "suspect_months": int(paths.suspect[cell.pair].sum()),
+                }
+            m = pair_memo[mkey]
+
+            port = quantile_sorts(
+                m["er"], m["er_valid"], realized_dev,
+                weights=weights_dev if cell.weighting == "vw" else None,
+                n_quantiles=space.n_quantiles, min_obs=space.min_obs,
+                nw_lags=space.nw_lags,
+                value_weighted=(cell.weighting == "vw"),
+            )
+            spread_series = np.asarray(port.spread_series)
+            row = {
+                "cell": cell.index,
+                "scheme": cell.scheme,
+                "estimator": cell.estimator.label,
+                "set": cell.set_name,
+                "universe": cell.universe,
+                "weighting": cell.weighting,
+                "route": paths.route,
+                "quantiles": space.n_quantiles,
+                "oos_r2": m["oos_r2"],
+                "ic_mean": m["ic_mean"],
+                "ic_nw_se": m["ic_nw_se"],
+                "ic_tstat": m["ic_tstat"],
+                "ic_months": m["ic_months"],
+                "rank_ic_mean": m["rank_ic_mean"],
+                "rank_ic_tstat": m["rank_ic_tstat"],
+                "bottom_ret": float(np.asarray(port.mean_returns)[0]),
+                "top_ret": float(np.asarray(port.mean_returns)[-1]),
+                "spread": float(port.spread),
+                "spread_nw_se": float(port.spread_nw_se),
+                "spread_tstat": float(port.spread_tstat),
+                "spread_turnover": _finite_mean(
+                    np.asarray(port.spread_turnover)),
+                "n_months": int(port.n_months),
+                "forecast_months": m["forecast_months"],
+                "suspect_months": m["suspect_months"],
+            }
+            if int(bootstrap) > 1:
+                valid = np.isfinite(spread_series)
+                point, boot_se, _ = bootstrap_series(
+                    spread_series, valid=valid, draws=int(bootstrap),
+                    seed=seed, block=block, nw_lags=space.nw_lags,
+                )
+                row["spread_boot_se"] = float(boot_se[0])
+                row["spread_boot_point"] = float(point[0])
+            rows.append(row)
+        sink_obj.consume(pd.DataFrame(rows))
+
+    after = contraction_counts()
+    stats = {
+        "cells": len(space),
+        "tiles": n_tiles,
+        "route": route,
+        "sink": sink_name,
+        "schemes": list(space.schemes),
+        "weightings": list(space.weightings),
+        "quantiles": space.n_quantiles,
+        "bootstrap": int(bootstrap),
+        "path_solves": path_solves,
+        "predict_calls": predict_calls,
+        "rows_seen": sink_obj.rows_seen,
+        # THE LEDGER PROOF: a banked sweep re-aggregates Grams and runs
+        # O(N·P) prediction einsums — the panel-contraction counters
+        # must not move. 0 or the sweep touched the (T, N, P) panel.
+        "panel_contractions": sum(
+            after.get(k, 0) - before.get(k, 0)
+            for k in ("specs_contracted", "pairs_contracted")
+        ),
+    }
+    return sink_obj.finish(), stats

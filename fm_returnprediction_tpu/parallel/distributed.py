@@ -265,7 +265,15 @@ class _ExchangeServer:
                     full_reply = pickle.dumps((seq_done, ordered))
                     ack_reply = (pickle.dumps((seq_done, []))
                                  if root_done is not None else full_reply)
-                    for r, c in list(self._conns.items()):
+                    # rank 0 last: it embeds this server, and an error raised
+                    # off ITS reply (e.g. barrier tag skew) may close() the
+                    # exchange — every remote rank's reply must already be
+                    # in the kernel by then or they see EOF instead of the
+                    # real diagnostic
+                    with self._lock:
+                        conns = sorted(self._conns.items(),
+                                       key=lambda kv: kv[0] == 0)
+                    for r, c in conns:
                         reply = (full_reply
                                  if root_done is None or r == root_done
                                  else ack_reply)

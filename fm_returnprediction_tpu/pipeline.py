@@ -131,6 +131,10 @@ class PipelineResult:
     # the spec-grid robustness sweep (specgrid.run_scenarios): one tidy
     # row per (model, universe, window, winsor, weight, predictor)
     specgrid_scenarios: Optional[pd.DataFrame] = None
+    # the rolling-origin backtest sweep (backtest.run_backtest_scenarios):
+    # one row per (scheme, estimator, model, universe, weighting) cell
+    # with OOS R², IC, spread and turnover — answered from the Gram bank
+    backtest_table: Optional[pd.DataFrame] = None
     # the fitted artifacts the online service consumes (serving.state):
     # lagged rolling-mean slopes/intercepts, support bounds, additive OLS
     # sufficient statistics — so serving never re-runs the fit
@@ -390,6 +394,11 @@ def run_pipeline(
     specgrid_cells: Optional[int] = None,
     specgrid_sink: Optional[str] = None,
     specgrid_estimator: Optional[str] = None,
+    make_backtest: bool = False,
+    backtest_schemes: Optional[str] = None,
+    backtest_route: Optional[str] = None,
+    backtest_quantiles: Optional[int] = None,
+    backtest_sink: Optional[str] = None,
     bootstrap_replicates: int = 10_000,
     use_mesh: Optional[bool] = None,
     checkpoint_dir=None,
@@ -412,6 +421,16 @@ def run_pipeline(
     ``specgrid_estimator`` swaps the per-cell estimator (grammar
     ``"fwl:c1+c2[@se]"``/``"absorb:..."``/``"iv:..."``/``"pooled[:se]"``;
     ``None`` follows ``FMRP_SPECGRID_ESTIMATOR``, default OLS@NW).
+
+    ``make_backtest`` runs the rolling-origin backtest sweep
+    (``backtest.run_backtest_scenarios``): the scenario panel is
+    contracted ONCE into a Gram bank, then every (scheme × model ×
+    universe × weighting) cell answers from the bank — coefficient paths
+    via the prefix-sum scan route (``backtest_route``, default
+    ``FMRP_BACKTEST_ROUTE``/auto), quantile portfolios at
+    ``backtest_quantiles`` deciles streamed through ``backtest_sink``.
+    ``backtest_schemes`` is the comma list (``"expanding,rolling120"``;
+    ``None`` follows ``FMRP_BACKTEST_SCHEMES``).
 
     ``checkpoint_dir`` arms per-stage checkpoint-resume
     (``resilience.StageCheckpointer``): each reporting stage (Table 1,
@@ -491,6 +510,11 @@ def run_pipeline(
             specgrid_cells=specgrid_cells,
             specgrid_sink=specgrid_sink,
             specgrid_estimator=specgrid_estimator,
+            make_backtest=make_backtest,
+            backtest_schemes=backtest_schemes,
+            backtest_route=backtest_route,
+            backtest_quantiles=backtest_quantiles,
+            backtest_sink=backtest_sink,
             bootstrap_replicates=bootstrap_replicates,
             use_mesh=use_mesh,
             checkpoint_dir=checkpoint_dir,
@@ -514,6 +538,11 @@ def _run_pipeline_guarded(
     specgrid_cells,
     specgrid_sink,
     specgrid_estimator,
+    make_backtest,
+    backtest_schemes,
+    backtest_route,
+    backtest_quantiles,
+    backtest_sink,
     bootstrap_replicates,
     use_mesh,
     checkpoint_dir,
@@ -802,6 +831,38 @@ def _run_pipeline_guarded(
                     audit,
                 )
 
+    backtest_table = None
+    if make_backtest:
+        from fm_returnprediction_tpu.backtest import run_backtest_scenarios
+        from fm_returnprediction_tpu.backtest.sinks import (
+            resolve_backtest_sink_name,
+        )
+
+        with timer.stage("backtest"):
+            # the rolling-origin sweep on the banked Gram stats: the
+            # panel is contracted once, every cell answers from the bank
+            # (the stats' panel_contractions delta is the ledger proof);
+            # knobs resolve argument > FMRP_BACKTEST_* env > default
+            backtest_table = _frame_stage(
+                "backtest",
+                lambda: run_backtest_scenarios(
+                    panel, subset_masks, factors_dict,
+                    schemes=backtest_schemes, route=backtest_route,
+                    n_quantiles=backtest_quantiles, sink=backtest_sink,
+                    estimator=specgrid_estimator,
+                    output_dir=output_dir,
+                ),
+            )
+            if guard and resolve_backtest_sink_name(backtest_sink) == "frame":
+                # non-frame sinks emit their own schema (leaderboard,
+                # moments, part manifest, metric aggregate) — the
+                # tidy-frame contract only applies to the full frame
+                backtest_table = _contracts.screen_artifact(
+                    "backtest", backtest_table,
+                    _contracts.backtest_rules(blocking="quarantine"),
+                    audit,
+                )
+
     bootstrap_table = None
     if make_bootstrap:
         from fm_returnprediction_tpu.parallel import as_flat_mesh
@@ -833,6 +894,10 @@ def _run_pipeline_guarded(
             if specgrid_scenarios is not None:
                 specgrid_scenarios.to_csv(
                     Path(output_dir) / "specgrid_scenarios.csv", index=False
+                )
+            if backtest_table is not None:
+                backtest_table.to_csv(
+                    Path(output_dir) / "backtest.csv", index=False
                 )
             if bootstrap_table is not None:
                 from fm_returnprediction_tpu.reporting.bootstrap_table import (
@@ -878,6 +943,8 @@ def _run_pipeline_guarded(
                 sentinel.check(
                     "specgrid_scenarios", summarize_frame(specgrid_scenarios)
                 )
+            if backtest_table is not None:
+                sentinel.check("backtest", summarize_frame(backtest_table))
             if serving_state is not None:
                 sentinel.check("serving_state", summarize_arrays({
                     "coef": serving_state.coef,
@@ -936,6 +1003,11 @@ def _run_pipeline_guarded(
                     if csv.exists():
                         _rart.put_files("specgrid_scenarios", fp, [csv],
                                         registry=_registry)
+                if backtest_table is not None and output_dir is not None:
+                    csv = Path(output_dir) / "backtest.csv"
+                    if csv.exists():
+                        _rart.put_files("backtest", fp, [csv],
+                                        registry=_registry)
                 if audit_dir is not None:
                     manifest = Path(audit_dir) / MANIFEST_NAME
                     if manifest.exists():
@@ -964,6 +1036,7 @@ def _run_pipeline_guarded(
         bootstrap_table=bootstrap_table,
         serving_state=serving_state,
         specgrid_scenarios=specgrid_scenarios,
+        backtest_table=backtest_table,
         audit=audit,
     )
 
@@ -1018,6 +1091,38 @@ def _main() -> None:
              "'iv:endog~z1+z2' | 'pooled[:se]' (default follows "
              "FMRP_SPECGRID_ESTIMATOR; Table-2/figure parity surfaces "
              "keep rejecting non-OLS loudly)",
+    )
+    parser.add_argument(
+        "--backtest", action="store_true",
+        help="also run the rolling-origin backtest sweep on the Gram "
+             "bank (scheme × model × universe × weighting: OOS R², IC, "
+             "quantile-portfolio spreads, turnover) and save backtest.csv",
+    )
+    parser.add_argument(
+        "--backtest-schemes", default=None, metavar="LIST",
+        help="comma list of estimation-path schemes, e.g. "
+             "'expanding,rolling120' (default follows "
+             "FMRP_BACKTEST_SCHEMES)",
+    )
+    parser.add_argument(
+        "--backtest-route", default=None,
+        choices=["auto", "scan", "refit"],
+        help="coefficient-path route: prefix-sum scan program (auto/"
+             "scan) or the per-origin full-refit differential oracle "
+             "(default follows FMRP_BACKTEST_ROUTE)",
+    )
+    parser.add_argument(
+        "--backtest-quantiles", type=int, default=None, metavar="D",
+        help="portfolio sort buckets, >= 2 (default follows "
+             "FMRP_BACKTEST_QUANTILES, normally 10)",
+    )
+    parser.add_argument(
+        "--backtest-sink", default=None,
+        choices=["frame", "topk", "summary", "parquet", "metrics"],
+        help="backtest streaming sink: full per-cell frame (default), "
+             "top-k-by-|spread_tstat| leaderboard, running moments, "
+             "parquet part spill, or the per-(scheme,weighting) metrics "
+             "aggregate (default follows FMRP_BACKTEST_SINK)",
     )
     parser.add_argument(
         "--no-guard", action="store_true",
@@ -1102,6 +1207,14 @@ def _main() -> None:
         specgrid_cells=args.specgrid_cells,
         specgrid_sink=args.specgrid_sink,
         specgrid_estimator=args.specgrid_estimator,
+        make_backtest=(args.backtest or args.backtest_schemes is not None
+                       or args.backtest_route is not None
+                       or args.backtest_quantiles is not None
+                       or args.backtest_sink is not None),
+        backtest_schemes=args.backtest_schemes,
+        backtest_route=args.backtest_route,
+        backtest_quantiles=args.backtest_quantiles,
+        backtest_sink=args.backtest_sink,
         bootstrap_replicates=args.bootstrap or 10_000,
         checkpoint_dir=args.checkpoint_dir,
         guard=False if args.no_guard else None,

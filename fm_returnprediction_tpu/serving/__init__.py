@@ -62,6 +62,7 @@ from fm_returnprediction_tpu.serving.loadgen import (
     LoadGen,
     LoadPhase,
     capacity_model,
+    portfolio_consumer,
     query_with_retry,
 )
 from fm_returnprediction_tpu.serving.replica_proc import (
@@ -113,6 +114,7 @@ __all__ = [
     "LoadGen",
     "LoadPhase",
     "capacity_model",
+    "portfolio_consumer",
     "query_with_retry",
     "RecoveryReport",
     "recover_journal",

@@ -58,6 +58,7 @@ __all__ = [
     "LoadPhase",
     "LoadGen",
     "capacity_model",
+    "portfolio_consumer",
 ]
 
 #: the shared client-side policy: small budget, fast first backoff —
@@ -329,6 +330,148 @@ def _retry_count() -> int:
         "fmrp_retry_attempts_total",
         help="retryable attempt failures across every layer",
     ).value)
+
+
+def portfolio_consumer(
+    fleet,
+    months: Sequence[int],
+    rows: np.ndarray,
+    valid: Optional[np.ndarray] = None,
+    n_quantiles: int = 5,
+    workers: int = 4,
+    retry: bool = True,
+    timeout: float = 30.0,
+) -> dict:
+    """The backtest's portfolio-construction phase run as a FLEET CLIENT:
+    every E[r] that feeds a sort is a quote served THROUGH the front door
+    (admission, routing, microbatching, brownout), not a batch matmul the
+    consumer ran itself.
+
+    ``months`` (M,) are the formation months; ``rows`` (M, N, P) the
+    per-month feature cross-sections; ``valid`` (M, N) the quotable mask
+    (default: rows with all-finite features). Worker threads stream the
+    M·N quotes (:func:`query_with_retry` when ``retry``), then portfolios
+    form HOST-SIDE with the exact ``backtest.portfolio`` conventions —
+    ``np.quantile`` linear breakpoints at the interior quantiles, bucket =
+    count of breakpoints STRICTLY below the quote (ties deterministic),
+    long = top bucket / short = bottom, equal weights, one-way turnover
+    ``0.5·Σ|Δw|`` per leg across consecutive formed months.
+
+    The report extends the :class:`LoadGen` phase schema (rows/s,
+    p50/p99, shed/degraded counts) with the formed-portfolio series and
+    carries the raw ``quotes`` (M, N) array so a differential test can
+    pin the fleet-served panel bit-identical to the batch executor's."""
+    months = np.asarray(months, dtype=np.int64)
+    rows = np.asarray(rows)
+    if rows.ndim != 3 or len(months) != rows.shape[0]:
+        raise ValueError("rows must be (M, N, P) aligned with months")
+    m_months, n_firms, _ = rows.shape
+    if valid is None:
+        valid = np.isfinite(rows).all(axis=-1)
+    valid = np.asarray(valid, bool)
+    if n_quantiles < 2:
+        raise ValueError("n_quantiles must be >= 2")
+
+    quotes = np.full((m_months, n_firms), np.nan)
+    outcome = np.zeros((m_months, n_firms), dtype=np.int8)
+    lat = np.full(m_months * n_firms, np.nan)
+
+    todo = [(mi, fi) for mi in range(m_months) for fi in range(n_firms)
+            if valid[mi, fi]]
+    t0 = time.perf_counter()
+
+    def one(k: int, mi: int, fi: int) -> None:
+        tq = time.perf_counter()
+        try:
+            if retry:
+                out = query_with_retry(fleet, int(months[mi]), rows[mi, fi],
+                                       timeout=timeout)
+            else:
+                out = fleet.query(int(months[mi]), rows[mi, fi],
+                                  timeout=timeout)
+        except (ServiceOverloadError, RetryExhaustedError):
+            outcome[mi, fi] = 3
+            return
+        except Exception:  # noqa: BLE001 — counted, not fatal
+            outcome[mi, fi] = 5
+            return
+        lat[k] = time.perf_counter() - tq
+        quotes[mi, fi] = float(out)
+        outcome[mi, fi] = 2 if isinstance(out, DegradedQuote) else 1
+
+    chunks = [todo[w::workers] for w in range(workers)]
+
+    def worker(w: int, chunk) -> None:
+        base = w
+        for j, (mi, fi) in enumerate(chunk):
+            one(base + j * workers, mi, fi)
+
+    threads = [
+        threading.Thread(target=worker, args=(w, c), daemon=True)
+        for w, c in enumerate(chunks) if c
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+
+    # host-side formation on whatever quotes came back finite — the same
+    # tie-deterministic bucket convention as backtest.portfolio
+    q_interior = np.arange(1, n_quantiles) / n_quantiles
+    formed = []
+    long_w = np.zeros((m_months, n_firms))
+    short_w = np.zeros((m_months, n_firms))
+    for mi in range(m_months):
+        good = np.isfinite(quotes[mi])
+        if good.sum() < n_quantiles:
+            continue
+        vals = quotes[mi, good]
+        bp = np.quantile(vals, q_interior)  # linear interpolation
+        bucket = (bp[None, :] < quotes[mi, good, None]).sum(axis=1)
+        gi = np.flatnonzero(good)
+        top = gi[bucket == n_quantiles - 1]
+        bot = gi[bucket == 0]
+        if not len(top) or not len(bot):
+            continue
+        long_w[mi, top] = 1.0 / len(top)
+        short_w[mi, bot] = 1.0 / len(bot)
+        formed.append(mi)
+    turnovers = [
+        0.5 * (np.abs(long_w[b] - long_w[a]).sum()
+               + np.abs(short_w[b] - short_w[a]).sum()) / 2.0
+        for a, b in zip(formed, formed[1:])
+    ]
+
+    n = len(todo)
+    answered = int((outcome == 1).sum() + (outcome == 2).sum())
+    lats = lat[np.isfinite(lat)]
+    return {
+        "phase": "portfolio_consumer",
+        "n": n,
+        "ok": int((outcome == 1).sum()),
+        "degraded": int((outcome == 2).sum()),
+        "shed": int((outcome == 3).sum()),
+        "errors": int((outcome == 5).sum()),
+        "wall_s": round(wall, 4),
+        "rows_per_s": round(answered / wall, 1) if wall > 0 else None,
+        "p50_ms": (round(float(np.percentile(lats, 50) * 1e3), 3)
+                   if len(lats) else None),
+        "p99_ms": _p99(lat),
+        "months_requested": int(m_months),
+        "months_formed": len(formed),
+        "long_size_mean": (round(float(np.mean(
+            [(long_w[mi] > 0).sum() for mi in formed])), 2)
+            if formed else None),
+        "short_size_mean": (round(float(np.mean(
+            [(short_w[mi] > 0).sum() for mi in formed])), 2)
+            if formed else None),
+        "turnover_mean": (round(float(np.mean(turnovers)), 4)
+                          if turnovers else None),
+        "quotes": quotes,
+        "long_weights": long_w,
+        "short_weights": short_w,
+    }
 
 
 def capacity_model(fleet, probe_repeats: int = 5) -> dict:

@@ -48,6 +48,26 @@ def main(argv=None) -> int:
                              "follows FMRP_SPECGRID_ESTIMATOR; the "
                              "Table-2/figure parity surfaces keep "
                              "rejecting non-OLS loudly)")
+    parser.add_argument("--backtest-schemes", default=None, metavar="LIST",
+                        help="backtest task estimation-path schemes, a "
+                             "comma list like 'expanding,rolling120' "
+                             "(default follows FMRP_BACKTEST_SCHEMES)")
+    parser.add_argument("--backtest-route", default=None,
+                        choices=["auto", "scan", "refit"],
+                        help="backtest coefficient-path route: prefix-sum "
+                             "scan program or the per-origin full-refit "
+                             "differential oracle (default follows "
+                             "FMRP_BACKTEST_ROUTE)")
+    parser.add_argument("--backtest-quantiles", type=int, default=None,
+                        metavar="D",
+                        help="backtest portfolio sort buckets, >= 2 "
+                             "(default follows FMRP_BACKTEST_QUANTILES)")
+    parser.add_argument("--backtest-sink", default=None,
+                        choices=["frame", "topk", "summary", "parquet",
+                                 "metrics"],
+                        help="backtest task streaming sink (default "
+                             "follows FMRP_BACKTEST_SINK, else the full "
+                             "per-cell frame)")
     parser.add_argument("--notebooks", action="store_true",
                         help="include the notebook conversion/execution tasks")
     parser.add_argument("--db", default=None, help="state db path")
@@ -99,7 +119,11 @@ def main(argv=None) -> int:
     tasks = build_tasks(synthetic=args.synthetic,
                         specgrid_cells=args.specgrid_cells,
                         specgrid_sink=args.specgrid_sink,
-                        specgrid_estimator=args.specgrid_estimator)
+                        specgrid_estimator=args.specgrid_estimator,
+                        backtest_schemes=args.backtest_schemes,
+                        backtest_route=args.backtest_route,
+                        backtest_quantiles=args.backtest_quantiles,
+                        backtest_sink=args.backtest_sink)
     if args.notebooks:
         tasks += build_notebook_tasks()
     db = args.db or Path(config("BASE_DIR")) / ".fmrp-task-db.sqlite"

@@ -24,6 +24,8 @@ Stages (task name → targets):
   panel checkpoint changes
 - ``specgrid``    → ``specgrid_scenarios.csv`` in OUTPUT_DIR — the
   Gram-contraction robustness sweep (``specgrid.run_scenarios``)
+- ``backtest``    → ``backtest.csv`` in OUTPUT_DIR — the rolling-origin
+  backtest sweep on the Gram bank (``backtest.run_backtest_scenarios``)
 - ``latex``       → compiled report PDF (``pdflatex`` run twice,
   continue-on-error, ``src/calc_Lewellen_2014.py:1197-1209``)
 
@@ -42,12 +44,14 @@ from fm_returnprediction_tpu.taskgraph.engine import Task
 __all__ = [
     "build_tasks", "build_notebook_tasks",
     "PANEL_FILE", "FACTORS_FILE", "SERVING_FILE", "SPECGRID_FILE",
+    "BACKTEST_FILE",
 ]
 
 PANEL_FILE = "lewellen_panel.npz"
 FACTORS_FILE = "factors_dict.json"
 SERVING_FILE = "serving_state.npz"
 SPECGRID_FILE = "specgrid_scenarios.csv"
+BACKTEST_FILE = "backtest.csv"
 
 
 def _raw_paths(raw_dir: Path) -> List[Path]:
@@ -470,6 +474,119 @@ def _specgrid(processed_dir: Path, output_dir: Path,
     _primary_writes("specgrid_saved", _save)
 
 
+BACKTEST_KNOBS_FILE = "backtest.knobs.json"
+
+
+def _backtest_effective_knobs(schemes: Optional[str],
+                              route: Optional[str],
+                              quantiles: Optional[int],
+                              sink: Optional[str]) -> dict:
+    """The knobs that shape the backtest artifact, RESOLVED the same way
+    the sweep resolves them (argument > ``FMRP_BACKTEST_*`` env >
+    default) — a route change swaps the program family, a scheme or
+    quantile change changes every number, a sink change changes the
+    schema. Tile width is excluded (tiling is pinned bit-identical)."""
+    from fm_returnprediction_tpu.backtest import (
+        resolve_backtest_route,
+        resolve_backtest_sink_name,
+        resolve_quantiles,
+        resolve_schemes,
+    )
+
+    return {
+        "schemes": [name for name, _ in resolve_schemes(schemes)],
+        "route": resolve_backtest_route(route),
+        "quantiles": resolve_quantiles(quantiles),
+        "sink": resolve_backtest_sink_name(sink),
+    }
+
+
+def _backtest_knobs_unchanged(output_dir: Path,
+                              schemes: Optional[str],
+                              route: Optional[str],
+                              quantiles: Optional[int],
+                              sink: Optional[str]) -> bool:
+    """``uptodate`` check (the specgrid sidecar pattern): the cached CSV
+    only counts as current when the knobs it was BUILT under match this
+    invocation's effective knobs — a change in either direction re-runs.
+    A missing sidecar reads as a default-knob build."""
+    want = _backtest_effective_knobs(schemes, route, quantiles, sink)
+    try:
+        with open(Path(output_dir) / BACKTEST_KNOBS_FILE) as f:
+            have = json.load(f)
+    except (OSError, ValueError):
+        have = _default_backtest_knobs()
+    return have == want
+
+
+def _default_backtest_knobs() -> dict:
+    """What a pre-sidecar artifact must be assumed to be: built under the
+    library defaults, NOT under whatever env happens to be set now."""
+    from fm_returnprediction_tpu.backtest.paths import (
+        DEFAULT_QUANTILES,
+        DEFAULT_SCHEMES,
+    )
+
+    return {
+        "schemes": [s.strip() for s in DEFAULT_SCHEMES.split(",")],
+        "route": "auto",
+        "quantiles": DEFAULT_QUANTILES,
+        "sink": "frame",
+    }
+
+
+def _backtest(processed_dir: Path, output_dir: Path,
+              schemes: Optional[str] = None,
+              route: Optional[str] = None,
+              quantiles: Optional[int] = None,
+              sink: Optional[str] = None) -> None:
+    """Panel checkpoint → rolling-origin backtest sweep CSV.
+
+    Contracts the scenario panel once into a Gram bank, then answers the
+    scheme × model × universe × weighting backtest product from it
+    (``backtest.run_backtest_scenarios`` — coefficient paths via the
+    prefix-sum scan route, quantile portfolios, OOS R²/IC/spread/turnover
+    per cell). Compute is replicated on every process (same contract as
+    ``_reports``); only the primary writes."""
+    from fm_returnprediction_tpu.backtest import run_backtest_scenarios
+    from fm_returnprediction_tpu.backtest.sinks import (
+        resolve_backtest_sink_name,
+    )
+    from fm_returnprediction_tpu.panel.dense import DensePanel
+    from fm_returnprediction_tpu.panel.subsets import compute_subset_masks
+
+    panel = DensePanel.load(processed_dir / PANEL_FILE)
+    _guard_panel(panel, "backtest")
+    with open(processed_dir / FACTORS_FILE) as f:
+        factors_dict = json.load(f)
+    masks = compute_subset_masks(panel)
+    frame = run_backtest_scenarios(
+        panel, masks, factors_dict, schemes=schemes, route=route,
+        n_quantiles=quantiles, sink=sink, output_dir=output_dir,
+    )
+
+    from fm_returnprediction_tpu.guard import checks as _guard_checks
+    from fm_returnprediction_tpu.guard import contracts as _contracts
+
+    if _guard_checks.guard_active() \
+            and resolve_backtest_sink_name(sink) == "frame":
+        _contracts.enforce(
+            _contracts.evaluate(_contracts.backtest_rules(), frame),
+            context="backtest",
+        )
+    output_dir.mkdir(parents=True, exist_ok=True)
+
+    def _save() -> None:
+        frame.to_csv(output_dir / BACKTEST_FILE, index=False)
+        # sidecar: the knobs this artifact was built under, read by the
+        # task's uptodate check (_backtest_knobs_unchanged)
+        with open(output_dir / BACKTEST_KNOBS_FILE, "w") as f:
+            json.dump(_backtest_effective_knobs(
+                schemes, route, quantiles, sink), f)
+
+    _primary_writes("backtest_saved", _save)
+
+
 def _parity(raw_dir: Path, output_dir: Path) -> None:
     """Real-cache Table 1 vs the published Lewellen oracle; records the full
     diff, then raises on any out-of-tolerance cell."""
@@ -513,6 +630,10 @@ def build_tasks(
     specgrid_cells: Optional[int] = None,
     specgrid_sink: Optional[str] = None,
     specgrid_estimator: Optional[str] = None,
+    backtest_schemes: Optional[str] = None,
+    backtest_route: Optional[str] = None,
+    backtest_quantiles: Optional[int] = None,
+    backtest_sink: Optional[str] = None,
 ) -> List[Task]:
     """Assemble the DAG against the configured directory tree."""
     raw_dir = Path(raw_dir or config("RAW_DATA_DIR"))
@@ -597,6 +718,30 @@ def build_tasks(
                 )
             ],
             doc="Panel checkpoint → Gram spec-grid robustness sweep CSV",
+        ),
+        Task(
+            name="backtest",
+            actions=[lambda: _backtest(processed_dir, output_dir,
+                                       schemes=backtest_schemes,
+                                       route=backtest_route,
+                                       quantiles=backtest_quantiles,
+                                       sink=backtest_sink)],
+            # reads only the panel checkpoint + factors — a reports-only
+            # refresh must not re-run the backtest sweep
+            file_dep=[processed_dir / PANEL_FILE, processed_dir / FACTORS_FILE],
+            targets=[output_dir / BACKTEST_FILE],
+            task_dep=["build_panel"],
+            # knob-aware staleness (the specgrid sidecar pattern): the
+            # artifact only counts as current when the knobs it was built
+            # under match this invocation's effective --backtest-*/
+            # FMRP_BACKTEST_* knobs — a change in EITHER direction re-runs
+            uptodate=[
+                lambda: _backtest_knobs_unchanged(
+                    output_dir, backtest_schemes, backtest_route,
+                    backtest_quantiles, backtest_sink,
+                )
+            ],
+            doc="Panel checkpoint → rolling-origin backtest sweep CSV",
         ),
         Task(
             name="latex",

@@ -1,0 +1,615 @@
+"""Backtest subsystem differentials (ISSUE 18).
+
+The contracts under test:
+
+- the SCAN route (batched per-month solve + masked prefix sums) matches
+  the per-origin full-refit ORACLE exactly — f64 ≤ 1e-13 / f32 ≤ 1e-6 —
+  for expanding AND rolling windows, under OLS AND the FWL estimator;
+- out-of-sample predictions are strictly past (origin t−1 forecasts
+  month t; month 0 never forecasts) and reproduce the coefficient-path
+  einsum by hand;
+- OOS R² / IC / rank-IC device kernels match their numpy host oracles;
+- quantile assignment matches a pandas-qcut-style numpy oracle on the
+  same linear-interpolation breakpoints, INCLUDING tie months (equal
+  forecasts land in the same bucket deterministically); per-bucket
+  returns, counts and one-way turnover match the oracle too;
+- the circular-block bootstrap's draw 0 is the never-resampled point
+  estimate (≡ ``series_inference``'s mean);
+- a full sweep (2 schemes × ew/vw) answers ENTIRELY from the bank:
+  the panel-contraction ledger delta is 0;
+- the loadgen portfolio consumer's fleet-served quotes are bit-identical
+  to the batch executor's predictions, with a clean journal replay;
+- every non-composing input is rejected LOUDLY (iv/absorb/pooled
+  estimators, bad schemes/routes/sinks/weightings, vw without weights);
+- the ``FMRP_BACKTEST_*`` knobs resolve argument > env > default.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fm_returnprediction_tpu.backtest import (
+    MetricsSink,
+    backtest_paths,
+    backtest_space,
+    bootstrap_series,
+    ic_series,
+    ic_series_np,
+    oos_r2,
+    oos_r2_np,
+    parse_scheme,
+    predict_er,
+    quantile_sorts,
+    resolve_backtest_route,
+    resolve_backtest_sink,
+    resolve_backtest_sink_name,
+    resolve_quantiles,
+    resolve_schemes,
+    run_backtest,
+    run_backtest_scenarios,
+    series_inference,
+)
+from fm_returnprediction_tpu.backtest.space import BacktestSpace
+from fm_returnprediction_tpu.specgrid.cellspace import CellSpace
+from fm_returnprediction_tpu.specgrid.grambank import build_bank
+
+pytestmark = [pytest.mark.backtest]
+
+X64 = bool(jax.config.jax_enable_x64)
+TOL = 1e-13 if X64 else 1e-6        # scan-vs-refit (summation order only)
+ORACLE_TOL = 1e-10 if X64 else 1e-5  # device kernel vs float64 host oracle
+
+
+def _panel(seed=0, t=30, n=140, p=4):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((t, n, p))
+    x[rng.random(x.shape) < 0.06] = np.nan
+    beta = rng.standard_normal(p) * 0.1
+    y = np.nansum(x * beta, axis=-1) + 0.3 * rng.standard_normal((t, n))
+    y[rng.random(y.shape) < 0.1] = np.nan
+    masks = {
+        "All": np.ones((t, n), bool),
+        "Big": (rng.random(n) > 0.35)[None, :] & np.ones((t, n), bool),
+    }
+    return y, x, masks
+
+
+@pytest.fixture(scope="module")
+def bank():
+    y, x, masks = _panel()
+    names = tuple(f"c{i}" for i in range(x.shape[-1]))
+    space = CellSpace(
+        regressor_sets=(("m2", names[:2]), ("mfull", names)),
+        universes=("All", "Big"),
+        windows=(("full", None),),
+        nw_lags=4, min_months=8,
+    )
+    bk = build_bank(y, x, masks, space, fingerprint="test-backtest")
+    return bk, (y, x, masks)
+
+
+# -- scan route ≡ refit oracle ----------------------------------------------
+
+@pytest.mark.parametrize("scheme", ["expanding", "rolling12"])
+@pytest.mark.parametrize("estimator", [None, "fwl:c0"])
+def test_scan_matches_refit_oracle(bank, scheme, estimator):
+    """The fused prefix-sum program and the per-origin full-refit loop
+    are the same numbers up to summation order — exact by Gram
+    additivity, for both window schemes and both composing estimators."""
+    bk, _ = bank
+    scan = backtest_paths(bk, scheme, estimator=estimator, route="scan",
+                          min_months=5)
+    refit = backtest_paths(bk, scheme, estimator=estimator, route="refit",
+                           min_months=5)
+    assert scan.route == "scan" and refit.route == "refit"
+    assert scan.path.shape == refit.path.shape
+    np.testing.assert_array_equal(np.isnan(scan.path), np.isnan(refit.path))
+    np.testing.assert_allclose(scan.path, refit.path, atol=TOL,
+                               equal_nan=True)
+    np.testing.assert_allclose(scan.count, refit.count, atol=TOL)
+    np.testing.assert_array_equal(scan.month_valid, refit.month_valid)
+    np.testing.assert_allclose(scan.beta, refit.beta, atol=TOL)
+    np.testing.assert_array_equal(scan.col_sel, refit.col_sel)
+    # paths exist somewhere (the panel is dense enough at this shape)
+    assert np.isfinite(scan.path).any()
+
+
+def test_rolling_path_is_prefix_difference(bank):
+    """A rolling-W origin equals the masked mean over exactly the last W
+    valid-month slots — pin one origin by hand against the per-month
+    leaves the scan route itself returns."""
+    bk, _ = bank
+    w = 12
+    paths = backtest_paths(bk, f"rolling{w}", route="scan", min_months=5)
+    k, origin = 0, bk.n_months - 1
+    lo = origin - w + 1
+    sel = paths.month_valid[k, lo:origin + 1]
+    want = paths.beta[k, lo:origin + 1][sel].mean(axis=0)
+    np.testing.assert_allclose(paths.path[k, origin], want, atol=ORACLE_TOL)
+    assert paths.count[k, origin] == sel.sum()
+
+
+def test_fwl_paths_differ_from_ols_and_disclose_label(bank):
+    bk, _ = bank
+    ols = backtest_paths(bk, "expanding", route="scan", min_months=5)
+    fwl = backtest_paths(bk, "expanding", estimator="fwl:c0", route="scan",
+                         min_months=5)
+    assert fwl.estimator_label == "fwl[c0]"
+    assert ols.estimator_label == "ols"
+    # the partialled solve drops the control from the solved selection
+    assert fwl.col_sel.sum() < ols.col_sel.sum()
+    # under FWL the residualized intercept is exactly 0 where defined
+    finite = np.isfinite(fwl.path[..., 0])
+    assert finite.any()
+    np.testing.assert_allclose(fwl.path[..., 0][finite], 0.0, atol=TOL)
+
+
+# -- prediction alignment ----------------------------------------------------
+
+def test_predict_er_is_strictly_past(bank):
+    """Month t's forecast is origin t−1's coefficient path applied to
+    month t's characteristics; month 0 has no origin and never
+    forecasts."""
+    bk, (y, x, masks) = bank
+    paths = backtest_paths(bk, "expanding", route="scan", min_months=5)
+    pair = 1
+    er, er_valid = predict_er(paths, x, masks["Big"], pair)
+    assert not er_valid[0].any()
+    sel = paths.col_sel[pair]
+    t_probe = bk.n_months - 1
+    rows = np.flatnonzero(er_valid[t_probe])
+    assert rows.size
+    coef = paths.path[pair, t_probe - 1]
+    want = coef[0] + x[t_probe][rows][:, sel] @ coef[1:][sel]
+    np.testing.assert_allclose(er[t_probe, rows], want, atol=ORACLE_TOL)
+    # rows outside the universe or with a non-finite SELECTED predictor
+    # never forecast
+    assert not er_valid[:, ~masks["Big"][0].astype(bool)].any() \
+        or masks["Big"].all()
+    bad = ~np.isfinite(x[..., sel]).all(axis=-1)
+    assert not (er_valid & bad).any()
+
+
+# -- evaluation oracles ------------------------------------------------------
+
+def test_oos_r2_matches_numpy_oracle(bank):
+    bk, (y, x, masks) = bank
+    paths = backtest_paths(bk, "expanding", route="scan", min_months=5)
+    er, er_valid = predict_er(paths, x, masks["All"], pair=1)
+    got = float(oos_r2(jnp.asarray(er), jnp.asarray(er_valid),
+                       jnp.asarray(y)))
+    want = oos_r2_np(er, er_valid, y)
+    assert np.isfinite(want)
+    np.testing.assert_allclose(got, want, atol=ORACLE_TOL)
+
+
+def test_ic_series_matches_numpy_oracle_with_ties():
+    """Pearson and rank IC vs the host mirror — the forecast panel is
+    QUANTIZED so months carry heavy ties, pinning the ordinal (stable
+    double-argsort) rank convention on both sides."""
+    rng = np.random.default_rng(7)
+    t, n = 25, 60
+    er = np.round(rng.standard_normal((t, n)), 1)  # many exact ties
+    realized = 0.4 * er + rng.standard_normal((t, n))
+    er_valid = rng.random((t, n)) > 0.15
+    realized[rng.random((t, n)) < 0.1] = np.nan
+    ic, rank_ic, good = ic_series(jnp.asarray(er), jnp.asarray(er_valid),
+                                  jnp.asarray(realized), min_obs=10)
+    ic_np, rank_np = ic_series_np(er, er_valid, realized, min_obs=10)
+    np.testing.assert_array_equal(np.isnan(np.asarray(ic)), np.isnan(ic_np))
+    np.testing.assert_allclose(np.asarray(ic), ic_np, atol=ORACLE_TOL,
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(rank_ic), rank_np,
+                               atol=ORACLE_TOL, equal_nan=True)
+    assert np.asarray(good).sum() > t // 2
+
+
+def test_series_inference_mean_and_tstat():
+    rng = np.random.default_rng(3)
+    series = rng.standard_normal(40) + 0.5
+    series[[4, 17]] = np.nan
+    mean, se, tstat, n = series_inference(series, nw_lags=4)
+    ok = np.isfinite(series)
+    assert n == ok.sum()
+    np.testing.assert_allclose(mean, series[ok].mean(), atol=ORACLE_TOL)
+    np.testing.assert_allclose(tstat, mean / se, atol=ORACLE_TOL)
+
+
+# -- portfolio sorts vs numpy oracle ----------------------------------------
+
+def _sorts_np(er, er_valid, realized, n_q, min_obs, weights=None):
+    """Host oracle for ``quantile_sorts``: per-month np.quantile (linear)
+    interior breakpoints, bucket = breakpoints strictly below the value
+    (the pandas-qcut-style tie-deterministic assignment), normalized
+    holdings, one-way turnover."""
+    t, n = er.shape
+    ok = np.asarray(er_valid, bool) & np.isfinite(realized)
+    if weights is not None:
+        ok = ok & np.isfinite(weights) & (weights > 0)
+    month_valid = ok.sum(axis=1) >= min_obs
+    qret = np.full((t, n_q), np.nan)
+    counts = np.zeros((t, n_q), int)
+    wnorm = np.zeros((t, n_q, n))
+    qs = np.arange(1, n_q) / n_q
+    for m in range(t):
+        rows = np.flatnonzero(ok[m])
+        if not rows.size:
+            continue
+        vals = er[m, rows]
+        bp = np.quantile(vals, qs)
+        bucket = (vals[:, None] > bp[None, :]).sum(axis=1)
+        for d in range(n_q):
+            sel = rows[bucket == d]
+            counts[m, d] = sel.size
+            if not sel.size:
+                continue
+            w = np.ones(sel.size) if weights is None else weights[m, sel]
+            wn = w / w.sum()
+            wnorm[m, d, sel] = wn
+            if month_valid[m]:
+                qret[m, d] = float(wn @ realized[m, sel])
+    turnover = np.full((t, n_q), np.nan)
+    for m in range(1, t):
+        if not (month_valid[m] and month_valid[m - 1]):
+            continue
+        for d in range(n_q):
+            if counts[m, d] and counts[m - 1, d]:
+                turnover[m, d] = 0.5 * np.abs(
+                    wnorm[m, d] - wnorm[m - 1, d]).sum()
+    return qret, counts, month_valid, turnover
+
+
+@pytest.mark.parametrize("value_weighted", [False, True])
+def test_quantile_sorts_match_numpy_oracle(value_weighted):
+    """Per-bucket returns, counts and turnover vs the host oracle — the
+    forecast panel is quantized so TIE MONTHS (values sitting exactly on
+    a breakpoint) are exercised, and the assignment must still agree."""
+    rng = np.random.default_rng(11)
+    t, n, n_q = 24, 90, 5
+    er = np.round(rng.standard_normal((t, n)), 1)
+    realized = 0.3 * er + rng.standard_normal((t, n))
+    er_valid = rng.random((t, n)) > 0.1
+    realized[rng.random((t, n)) < 0.08] = np.nan
+    weights = np.abs(rng.lognormal(size=(t, n))) + 0.1
+    weights[rng.random((t, n)) < 0.05] = np.nan  # VW drops unweightables
+
+    port = quantile_sorts(
+        jnp.asarray(er), jnp.asarray(er_valid), jnp.asarray(realized),
+        weights=jnp.asarray(weights) if value_weighted else None,
+        n_quantiles=n_q, min_obs=20,
+        value_weighted=value_weighted,
+    )
+    qret, counts, month_valid, turnover = _sorts_np(
+        er, er_valid, realized, n_q, min_obs=20,
+        weights=weights if value_weighted else None,
+    )
+    # ties are real at this quantization: some month has a duplicated
+    # forecast value spanning a would-be bucket edge
+    assert any(np.unique(er[m, er_valid[m]]).size < er_valid[m].sum()
+               for m in range(t))
+    np.testing.assert_array_equal(np.asarray(port.month_valid), month_valid)
+    np.testing.assert_array_equal(np.asarray(port.counts), counts)
+    np.testing.assert_allclose(np.asarray(port.quantile_returns), qret,
+                               atol=ORACLE_TOL, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(port.turnover), turnover,
+                               atol=ORACLE_TOL, equal_nan=True)
+    # summary internal consistency: spread series/mean/tstat tie together
+    spread_series = np.asarray(port.spread_series)
+    usable = month_valid & (counts > 0).all(axis=1)
+    sv = usable & np.isfinite(spread_series)
+    np.testing.assert_allclose(
+        spread_series[month_valid],
+        (qret[:, -1] - qret[:, 0])[month_valid],
+        atol=ORACLE_TOL, equal_nan=True)
+    np.testing.assert_allclose(float(port.spread), spread_series[sv].mean(),
+                               atol=ORACLE_TOL)
+    assert int(port.n_months) == sv.sum()
+    np.testing.assert_allclose(
+        float(port.spread_tstat),
+        float(port.spread) / float(port.spread_nw_se), atol=ORACLE_TOL)
+
+
+def test_equal_forecasts_share_a_bucket():
+    """Tie determinism directly: a month whose values are all drawn from
+    3 distinct levels puts every copy of a level in one bucket."""
+    t, n, n_q = 4, 30, 3
+    rng = np.random.default_rng(5)
+    levels = np.array([-1.0, 0.0, 1.0])
+    er = levels[rng.integers(0, 3, size=(t, n))]
+    realized = rng.standard_normal((t, n))
+    ok = np.ones((t, n), bool)
+    port = quantile_sorts(jnp.asarray(er), jnp.asarray(ok),
+                          jnp.asarray(realized), n_quantiles=n_q, min_obs=5)
+    counts = np.asarray(port.counts)
+    assert (counts.sum(axis=1) == n).all()
+    for m in range(t):
+        # buckets are monotone in the forecast and a level is NEVER
+        # split: every bucket-count prefix sum must land on a level-group
+        # boundary of the sorted cross-section
+        sizes = [(er[m] == lev).sum() for lev in levels]
+        boundaries = set(np.concatenate([[0], np.cumsum(sizes)]))
+        assert set(np.cumsum(counts[m])) <= boundaries
+
+
+# -- bootstrap ---------------------------------------------------------------
+
+def test_bootstrap_draw0_is_the_point_estimate():
+    rng = np.random.default_rng(9)
+    series = rng.standard_normal(36) * 0.02 + 0.01
+    series[[0, 13]] = np.nan
+    point, boot_se, draw_means = bootstrap_series(series, draws=16, seed=3,
+                                                  block=6)
+    mean, _, _, _ = series_inference(series)
+    np.testing.assert_allclose(point[0], mean,
+                               atol=1e-12 if X64 else 1e-6)
+    assert draw_means.shape == (15, 1)
+    assert np.isfinite(boot_se).all() and (boot_se > 0).all()
+    # a multi-column series shares one gather plan
+    two = np.stack([series, 2 * series], axis=1)
+    p2, se2, dm2 = bootstrap_series(two, draws=16, seed=3, block=6)
+    assert dm2.shape == (15, 2)
+    np.testing.assert_allclose(p2[1], 2 * p2[0], atol=1e-12 if X64 else 1e-5)
+    # draws=1 is the bare point, no resamples
+    p1, se1, dm1 = bootstrap_series(series, draws=1)
+    np.testing.assert_allclose(p1[0], mean, atol=1e-12 if X64 else 1e-6)
+    assert dm1.shape == (0, 1) and np.isnan(se1).all()
+    with pytest.raises(ValueError, match="draws"):
+        bootstrap_series(series, draws=0)
+
+
+# -- the sweep: bank-answered, ledger-proven ---------------------------------
+
+def test_sweep_answers_from_bank_with_zero_contractions(bank):
+    """A full 2-scheme × 2-weighting sweep emits one row per cell and
+    never contracts the (T, N, P) panel — the acceptance ledger proof."""
+    bk, (y, x, masks) = bank
+    rng = np.random.default_rng(2018)
+    weights = np.abs(rng.lognormal(size=y.shape)) + 0.1
+    space = backtest_space(bk, schemes="expanding,rolling12",
+                           weightings=("ew", "vw"), n_quantiles=5,
+                           min_obs=20)
+    frame, stats = run_backtest(bk, x, y, masks, space=space,
+                                weights_var=weights, min_months=5,
+                                bootstrap=8, seed=1)
+    assert stats["panel_contractions"] == 0
+    assert len(frame) == len(space) == stats["rows_seen"]
+    # one path solve per (scheme, estimator) digit — the one-slot memo
+    assert stats["path_solves"] == len(space.schemes)
+    assert stats["predict_calls"] == len(space.schemes) * space.n_pairs
+    for col in ("cell", "scheme", "set", "universe", "weighting", "oos_r2",
+                "ic_mean", "ic_tstat", "rank_ic_mean", "spread",
+                "spread_tstat", "spread_turnover", "n_months",
+                "spread_boot_se"):
+        assert col in frame.columns, col
+    assert set(frame["scheme"]) == {"expanding", "rolling12"}
+    assert set(frame["weighting"]) == {"ew", "vw"}
+    assert frame["cell"].is_unique
+    assert np.isfinite(frame["spread"]).all()
+    assert np.isfinite(frame["spread_boot_se"]).all()
+    # turnover is a [0, 1] fraction wherever defined
+    tau = frame["spread_turnover"].to_numpy()
+    assert ((tau >= 0) & (tau <= 1))[np.isfinite(tau)].all()
+
+
+def test_metrics_sink_aggregates_per_group(bank):
+    """The O(1) metrics sink reproduces a pandas groupby of the full
+    frame — mean/std per (scheme, weighting) plus the |spread_tstat|
+    best cell with the lower-index tie-break."""
+    bk, (y, x, masks) = bank
+    rng = np.random.default_rng(2018)
+    weights = np.abs(rng.lognormal(size=y.shape)) + 0.1
+    space = backtest_space(bk, schemes="expanding,rolling12",
+                           weightings=("ew", "vw"), n_quantiles=5,
+                           min_obs=20)
+    frame, _ = run_backtest(bk, x, y, masks, space=space,
+                            weights_var=weights, min_months=5)
+    sink = MetricsSink()
+    sink.consume(frame)
+    out = sink.finish().set_index(["scheme", "weighting"])
+    assert len(out) == 4
+    grouped = frame.groupby(["scheme", "weighting"])
+    for key, grp in grouped:
+        row = out.loc[key]
+        assert row["cells"] == len(grp)
+        np.testing.assert_allclose(row["spread_mean"], grp["spread"].mean(),
+                                   atol=ORACLE_TOL)
+        np.testing.assert_allclose(row["spread_std"], grp["spread"].std(),
+                                   atol=ORACLE_TOL)
+        best = grp.loc[grp["spread_tstat"].abs().idxmax()]
+        assert row["best_cell"] == best["cell"]
+
+
+def test_scenarios_entrypoint_vw_reduction_and_stats():
+    """``run_backtest_scenarios`` (the pipeline's stage): with a weight
+    column VW cells run; without one they reduce to EW with the
+    reduction disclosed; a VW-only request without weights is loud."""
+    from fm_returnprediction_tpu.models.lewellen import ModelSpec
+
+    y, x, masks = _panel(seed=21, t=24, n=80, p=3)
+    names = ["c0", "c1", "c2"]
+    me = np.abs(np.random.default_rng(4).lognormal(size=y.shape)) + 0.1
+
+    class _MiniPanel:
+        def __init__(self, with_me):
+            self.mask = masks["All"]
+            self.months = np.arange(y.shape[0])
+            self.var_names = ["retx"] + names + (["me"] if with_me else [])
+
+        def var(self, name):
+            return {"retx": y, "me": me}[name]
+
+        def select(self, cols):
+            return x[:, :, [names.index(c) for c in cols]]
+
+    variables = {"V0": "c0", "V1": "c1", "V2": "c2"}
+    models = [ModelSpec("Model A", ["V0", "V1"]),
+              ModelSpec("Model B", ["V0", "V1", "V2"])]
+    frame, stats = run_backtest_scenarios(
+        _MiniPanel(True), masks, variables, models=models,
+        schemes="expanding,rolling8", n_quantiles=4, min_obs=15,
+        min_months=5, return_stats=True,
+    )
+    assert stats["panel_contractions"] == 0
+    assert not stats["weighting_reduced"]
+    assert set(frame["weighting"]) == {"ew", "vw"}
+    assert len(frame) == 2 * 2 * 2 * 2  # scheme × model × universe × wgt
+
+    reduced, rstats = run_backtest_scenarios(
+        _MiniPanel(False), masks, variables, models=models,
+        schemes="expanding", n_quantiles=4, min_obs=15, min_months=5,
+        return_stats=True,
+    )
+    assert rstats["weighting_reduced"]
+    assert set(reduced["weighting"]) == {"ew"}
+
+    with pytest.raises(ValueError, match="weight column"):
+        run_backtest_scenarios(_MiniPanel(False), masks, variables,
+                               models=models, weightings=("vw",),
+                               min_months=5)
+
+
+# -- fleet-served portfolio consumer -----------------------------------------
+
+def test_portfolio_consumer_quotes_match_batch_executor(tmp_path):
+    """Every quote the loadgen portfolio consumer received THROUGH the
+    fleet's front door is bit-identical to the batch executor's answer
+    for the same (month, features), the journal replays clean, and the
+    formed long/short books follow the tie-deterministic convention."""
+    from fm_returnprediction_tpu.serving import (
+        BucketedExecutor,
+        ServingFleet,
+        build_serving_state,
+        portfolio_consumer,
+        replay_journal,
+    )
+
+    t, n, p = 48, 40, 3
+    rng = np.random.default_rng(2015)
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = np.array([0.05, -0.02, 0.01], np.float32)
+    y = (x @ beta + 0.02 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.1
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    x = np.where(mask[..., None], x, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=16, min_periods=8)
+    months = np.flatnonzero(state.have_coef())[-3:]
+    assert months.size == 3
+
+    journal = tmp_path / "consumer.jsonl"
+    # min_bucket=2 keeps a timing-dependent singleton microbatch off the
+    # scalar bucket-1 program, whose reduction rounds one ULP differently
+    # from every vectorized bucket — buckets >= 2 are one bit-identical
+    # family, which is exactly the contract this differential pins
+    with ServingFleet(state, 2, max_batch=16, max_latency_ms=1.0,
+                      min_bucket=2, journal=journal) as fleet:
+        report = portfolio_consumer(fleet, months, x[months], n_quantiles=4)
+    assert report["phase"] == "portfolio_consumer"
+    assert report["shed"] == 0 and report["errors"] == 0
+    assert report["ok"] + report["degraded"] == report["n"]
+    replay = replay_journal(journal)
+    assert replay.clean, (replay.dropped, replay.duplicated, replay.invalid)
+
+    # batch oracle: the executor answers the same (month, row) pairs
+    valid = np.isfinite(x[months]).all(axis=-1)
+    ex = BucketedExecutor(state, max_batch=64)
+    want = np.full((months.size, n), np.nan)
+    for i, m in enumerate(months):
+        rows = np.flatnonzero(valid[i])
+        got = ex.run(np.full(rows.size, m, np.int32), x[m, rows])
+        want[i, rows] = got
+    np.testing.assert_array_equal(report["quotes"], want)
+
+    # formed books: long = top bucket, short = bottom, EW, disjoint
+    assert report["months_formed"] == months.size
+    lw, sw = report["long_weights"], report["short_weights"]
+    for i in range(months.size):
+        assert not (lw[i] > 0)[sw[i] > 0].any()
+        np.testing.assert_allclose(lw[i].sum(), 1.0, atol=1e-9)
+        np.testing.assert_allclose(sw[i].sum(), 1.0, atol=1e-9)
+        top = lw[i] > 0
+        assert np.nanmin(report["quotes"][i][top]) >= \
+            np.nanmax(report["quotes"][i][sw[i] > 0])
+    assert report["turnover_mean"] is not None
+    assert 0.0 <= report["turnover_mean"] <= 1.0
+
+
+# -- loud rejections ---------------------------------------------------------
+
+def test_non_composing_estimators_rejected_loudly(bank):
+    bk, _ = bank
+    for est in ("pooled", "iv:c0~c1", "absorb:c1"):
+        with pytest.raises(ValueError, match="not available here"):
+            backtest_paths(bk, "expanding", estimator=est)
+        with pytest.raises(ValueError, match="slope path"):
+            backtest_space(bk, estimators=(est,))
+
+
+def test_fwl_controls_must_be_banked_in_every_pair(bank):
+    bk, _ = bank
+    # c2 is contracted only into the mfull pairs, not the m2 pairs
+    with pytest.raises(ValueError, match="every banked pair"):
+        backtest_paths(bk, "expanding", estimator="fwl:c2")
+    with pytest.raises(KeyError, match="union"):
+        backtest_paths(bk, "expanding", estimator="fwl:zzz")
+
+
+def test_malformed_inputs_rejected_loudly(bank):
+    bk, (y, x, masks) = bank
+    with pytest.raises(ValueError, match="expanding.*rolling"):
+        parse_scheme("weekly")
+    with pytest.raises(ValueError, match="W >= 1"):
+        parse_scheme("rolling0")
+    with pytest.raises(ValueError, match="route"):
+        resolve_backtest_route("bogus")
+    with pytest.raises(ValueError, match=">= 2"):
+        resolve_quantiles(1)
+    with pytest.raises(ValueError, match="repeat"):
+        resolve_schemes("expanding,expanding")
+    with pytest.raises(ValueError, match="unknown backtest sink"):
+        resolve_backtest_sink_name("bogus")
+    with pytest.raises(ValueError, match="weightings"):
+        BacktestSpace(schemes=("expanding",), sets=("m2",),
+                      universes=("All",), weightings=("equal",))
+    with pytest.raises(ValueError, match=">= 2"):
+        backtest_space(bk, n_quantiles=1)
+    space = backtest_space(bk, schemes="expanding", weightings=("vw",))
+    with pytest.raises(ValueError, match="weights_var"):
+        run_backtest(bk, x, y, masks, space=space)
+    with pytest.raises(KeyError, match="universe masks"):
+        run_backtest(bk, x, y, {"All": masks["All"]},
+                     space=backtest_space(bk, schemes="expanding",
+                                          weightings=("ew",)))
+
+
+# -- knob resolution ---------------------------------------------------------
+
+def test_backtest_knobs_resolve_arg_over_env_over_default(monkeypatch):
+    for var in ("FMRP_BACKTEST_ROUTE", "FMRP_BACKTEST_SCHEMES",
+                "FMRP_BACKTEST_QUANTILES", "FMRP_BACKTEST_SINK"):
+        monkeypatch.delenv(var, raising=False)
+    # defaults
+    assert resolve_backtest_route(None) == "auto"
+    assert resolve_schemes(None) == (("expanding", None), ("rolling120", 120))
+    assert resolve_quantiles(None) == 10
+    assert resolve_backtest_sink_name(None) == "frame"
+    # env wins over default
+    monkeypatch.setenv("FMRP_BACKTEST_ROUTE", "refit")
+    monkeypatch.setenv("FMRP_BACKTEST_SCHEMES", "rolling24")
+    monkeypatch.setenv("FMRP_BACKTEST_QUANTILES", "5")
+    monkeypatch.setenv("FMRP_BACKTEST_SINK", "metrics")
+    assert resolve_backtest_route(None) == "refit"
+    assert resolve_schemes(None) == (("rolling24", 24),)
+    assert resolve_quantiles(None) == 5
+    assert resolve_backtest_sink_name(None) == "metrics"
+    assert isinstance(resolve_backtest_sink(None), MetricsSink)
+    # explicit argument wins over env
+    assert resolve_backtest_route("scan") == "scan"
+    assert resolve_schemes("expanding") == (("expanding", None),)
+    assert resolve_quantiles(3) == 3
+    assert resolve_backtest_sink_name("frame") == "frame"
+    # a poisoned env is loud, not silently defaulted
+    monkeypatch.setenv("FMRP_BACKTEST_ROUTE", "nope")
+    with pytest.raises(ValueError, match="route"):
+        resolve_backtest_route(None)

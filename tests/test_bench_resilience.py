@@ -90,13 +90,15 @@ bench._bench_kernel = lambda fast: {}
 bench._bench_daily_fullscale = lambda fast: {}
 bench._bench_pallas = lambda fast: {}
 bench._bench_mesh8 = lambda fast: {}
-bench._bench_estimators = lambda fast: {}
 bench.main()
 """,
-        # keep the un-stubbed sections (serving, specgrid, resilience) at
-        # their fast shapes: this test pins emit-line mechanics, not their
-        # numbers, and the small/fuseprobe CPU ladders are fast-gated off
+        # keep the un-stubbed sections (serving, specgrid, estimators,
+        # resilience) at their fast shapes: this test pins emit-line
+        # mechanics, not their numbers, and the small/fuseprobe CPU
+        # ladders are fast-gated off. The backtest consumer leg stands up
+        # a second fleet — skipped via its own knob to bound child wall.
         FMRP_BENCH_FAST="1",
+        FMRP_BENCH_BACKTEST="0",
     )
     assert len(lines) == 1, proc.stdout + proc.stderr
     got = json.loads(lines[0])
