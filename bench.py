@@ -3410,6 +3410,96 @@ def _emit_line(extra: dict) -> None:
         )
 
 
+def _bench_topology(fast: bool):
+    """Topology-controller repair economics (ISSUE 19): what a member
+    death COSTS, measured on real OS processes.
+
+    - ``topology_detect_s`` — SIGKILL→classified-``killed`` latency
+      through the controller's probe ladder (pid poll; lower-better).
+    - ``topology_respawn_mttr_s`` — classification→serving-again wall
+      for one warm-pool respawn (kill_replica + replace + journal mark;
+      the registry makes it compile-free, which is what keeps MTTR in
+      the sub-second regime; lower-better, the regress-tracked series).
+    - ``topology_degraded_grid_cells_per_s`` — contraction throughput
+      on the DISCLOSED N-1 world after one of three grid workers dies
+      (the degraded merge is an exact partial sum over survivors;
+      higher-better) plus ``topology_degrade_recover_s``, the one-time
+      kill→respawn-world→first-merge cost.
+
+    FMRP_BENCH_TOPOLOGY=0 skips."""
+    if os.environ.get("FMRP_BENCH_TOPOLOGY", "1") == "0":
+        return {}
+    import signal as _signal
+    import tempfile
+    from pathlib import Path
+
+    from fm_returnprediction_tpu.serving import ServingFleet, \
+        build_serving_state
+    from fm_returnprediction_tpu.specgrid import multiproc
+    from fm_returnprediction_tpu.topology import (
+        TopologyController,
+        TopologySpec,
+    )
+
+    out = {}
+    tmp = Path(tempfile.mkdtemp(prefix="fmrp_bench_topo_"))
+    t, n, p = (36, 60, 4) if fast else (48, 120, 4)
+    y, x, subsets = _make_panel(t, n, p)
+    state = build_serving_state(y, x, subsets[0], window=t // 2,
+                                min_periods=t // 4)
+    month = int(np.nonzero(state.have_coef())[0][0])
+    qx = np.zeros(p, np.float32)
+    spec = TopologySpec(replicas=2, replica_mode="process",
+                        transport="shm")
+    fleet = ServingFleet(state, 2, replica_mode="process",
+                         transport="shm", journal=str(tmp / "j.jsonl"),
+                         registry_dir=str(tmp / "registry"),
+                         max_batch=16, max_latency_ms=2.0)
+    ctl = TopologyController(spec, fleet=fleet, ping_timeout_s=1.0)
+    try:
+        ctl.probe()  # arm the ring marks
+        victim = sorted(fleet.replica_states())[0]
+        pid = fleet.replica(victim).service.pid
+        with _timed("bench.topology_detect") as det:
+            os.kill(pid, _signal.SIGKILL)
+            while ctl.probe().get(victim) != "killed":
+                time.sleep(0.005)
+        with _timed("bench.topology_respawn") as rsp:
+            ctl.repair()
+            fleet.query(month, qx)  # serving again = repair complete
+        out["topology_detect_s"] = round(det.s, 4)
+        out["topology_respawn_mttr_s"] = round(rsp.s, 4)
+    finally:
+        ctl.close(close_pool=False)
+    leaked = ctl.sweep()
+    out["topology_leaked_segments"] = len(leaked["segments"])
+
+    # the degraded N-1 grid: price the disclosed world, not just prove it
+    gt, gn, gp = (48, 400, 6) if fast else (96, 1200, 6)
+    gy, gx, gsub = _make_panel(gt, gn, gp)
+    uni = np.stack([gsub[0]]).astype(bool)
+    uidx = np.zeros(1, np.int64)
+    col_sel = np.ones((1, gp), bool)
+    window = np.ones((1, gt), bool)
+    pool = multiproc.SpecGridWorkerPool(3, gy, gx, uni)
+    try:
+        pool.contract(uidx, col_sel, window)  # warm full world
+        reps = 2 if fast else 4
+        with _timed("bench.topology_degrade_recover") as rec:
+            pool.workers[1].kill()
+            pool.contract(uidx, col_sel, window)  # detect+respawn+merge
+        with _timed("bench.topology_degraded_warm") as wt:
+            for _ in range(reps):
+                pool.contract(uidx, col_sel, window)
+        out["topology_degrade_recover_s"] = round(rec.s, 4)
+        out["topology_degraded_grid_cells_per_s"] = round(
+            reps / wt.s, 3)
+        out["topology_degraded_ranks"] = list(pool.degraded_ranks)
+    finally:
+        pool.close()
+    return out
+
+
 def main() -> None:
     from fm_returnprediction_tpu.settings import enable_compilation_cache
     from fm_returnprediction_tpu.utils.timing import trace
@@ -3482,6 +3572,7 @@ def main() -> None:
     sections.append(_bench_backtest)  # _BACKTEST=0 handled in-section
     sections.append(_bench_multiproc)  # _MULTIPROC=0 handled in-section
     sections.append(_bench_transport)  # _TRANSPORT=0 handled in-section
+    sections.append(_bench_topology)  # _TOPOLOGY=0 handled in-section
     sections.append(_bench_resilience)  # _RESIL=0 handled in-section
     sections.append(_bench_guard)  # _GUARD=0 handled in-section
     sections.append(_bench_obs)  # _OBS=0 handled in-section

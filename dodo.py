@@ -272,3 +272,35 @@ def task_transport_parity():
         "verbosity": 2,
         "uptodate": [False],  # test-suite target: always re-run
     }
+
+
+def task_topology_smoke():
+    """The topology controller's suite as one named exit-1 gate
+    (``tests/test_topology.py``): declarative spec round-trips,
+    cross-process chaos propagation (proc-targeted ``FMRP_CHAOS_*``
+    env, 30/30 deterministic triggers), the shm commit seam (torn frame
+    = absent), fd/segment hygiene sweeps, broker connect retry +
+    rank-0-last fan-out repeats, the killed/hung/ring-stalled
+    classification ladder on real OS processes, SIGKILL-mid-send
+    exactly-once on both transports, any-shape journal recovery, the
+    degraded N-1 grid with its refusal knob, and broker re-election —
+    the pre-merge gate for anything touching ``topology/``, the
+    supervised fleet/pool lifecycles, or the chaos campaign. Sits
+    alongside ``robustness_smoke`` (fleet+chaos) and
+    ``multiprocess_smoke`` / ``transport_parity``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m topology -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "topology marker suite (inventory supervision, chaos "
+               "campaign, degraded grid, any-shape recovery) — exit-1 "
+               "on any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }

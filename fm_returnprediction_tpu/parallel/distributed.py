@@ -60,6 +60,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from fm_returnprediction_tpu.resilience.errors import InjectedFault
+from fm_returnprediction_tpu.resilience.faults import fault_site
+
 __all__ = [
     "DistConfig",
     "DistributedError",
@@ -77,6 +80,10 @@ __all__ = [
 ]
 
 _LEN = struct.Struct(">Q")
+
+# round-frame seq announcing a graceful client departure (vs a death,
+# which arrives as bare EOF and tears the whole exchange down)
+_BYE_SEQ = -1
 
 
 class DistributedError(RuntimeError):
@@ -168,7 +175,19 @@ def worker_env(rank: int, world: int, port: int,
     env["FMRP_DIST_PROCS"] = str(world)
     env["FMRP_DIST_PROC_ID"] = str(rank)
     env["FMRP_DIST_JAX"] = jax_collectives
+    # an active FaultPlan crosses the boundary with the worker: the child
+    # entrypoint installs it (install_plan_from_env), so chaos sites fire
+    # inside grid workers with the parent plan's determinism
+    from fm_returnprediction_tpu.resilience.faults import chaos_env
+
+    env.update(chaos_env())
     return env
+
+
+# retry-on allowlist for joining the exchange: a slow-starting rank 0 is
+# the EXPECTED cold-start shape (connection refused until its listener
+# binds), and the transient network errnos ride the same path
+_CONNECT_RETRY_ON = (ConnectionError, socket.timeout, OSError)
 
 
 # -- the exchange server (embedded in rank 0) --------------------------------
@@ -225,12 +244,24 @@ class _ExchangeServer:
     def _die(self, why: str) -> None:
         """One rank's death is everyone's: a blocked allgather can never
         complete, so every connection is torn down (peers see EOF and
-        raise) rather than letting the fleet hang in recv."""
+        raise) rather than letting the fleet hang in recv.
+
+        shutdown() BEFORE close(), and it is load-bearing: our own
+        reader threads sit blocked in recv() on these sockets, and a
+        bare close() only drops the fd-table entry — the kernel socket
+        stays referenced by the blocked syscall, no FIN ever goes out,
+        and every peer (including rank 0 itself) hangs its full recv
+        timeout instead of failing in milliseconds. shutdown() tears the
+        connection down immediately regardless of who is blocked on it."""
         with self._lock:
             if self._fail is None:
                 self._fail = why
             conns = list(self._conns.values())
         for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
@@ -240,6 +271,31 @@ class _ExchangeServer:
         try:
             while True:
                 rank_in, seq, payload, root = pickle.loads(_recv_frame(conn))
+                if seq == _BYE_SEQ:
+                    # graceful leave: the client announced it is done
+                    # (HostExchange.close) BEFORE closing its socket, so
+                    # this EOF-to-come is a departure, not a death —
+                    # tearing the world down here would race the fan-out
+                    # of a round the leaver already received (its peers
+                    # would see EOF in place of their real reply)
+                    with self._lock:
+                        self._conns.pop(rank, None)
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                # broker-death-mid-round chaos site: an injected failure
+                # here is the broker dying AFTER a rank posted its round
+                # and BEFORE the fan-out — _die() tears every rank down
+                # (typed DistributedError, never a hang) and the topology
+                # controller re-elects by respawning the world and
+                # fanning the round out again
+                fault_site("dist.broker_round")
                 with self._lock:
                     bucket = self._rounds.setdefault(int(seq), {})
                     bucket[int(rank_in)] = (payload, root)
@@ -278,7 +334,11 @@ class _ExchangeServer:
                                  if root_done is None or r == root_done
                                  else ack_reply)
                         _send_frame(c, reply, self._wlocks[r])
-        except (DistributedError, OSError, EOFError, pickle.PickleError):
+        except (DistributedError, OSError, EOFError, pickle.PickleError,
+                InjectedFault):
+            # InjectedFault: the dist.broker_round chaos site must keep
+            # the site's contract — typed teardown via _die, never a
+            # reader thread dying silently with every rank left blocked
             self._die(f"rank {rank} left the exchange")
 
     def close(self) -> None:
@@ -340,27 +400,65 @@ class HostExchange:
         self.last_round_s = 0.0
 
     def _connect(self) -> socket.socket:
-        deadline = time.monotonic() + self.timeout_s
-        last: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        """Join the exchange through the shared retry machinery
+        (``resilience.call_with_retry``): deterministic exponential
+        backoff seeded by rank (concurrent joiners spread out instead of
+        hammering the listener in lockstep), an attempt budget derived
+        from ``timeout_s`` by accumulating the policy's own backoff
+        schedule, and exhaustion surfaced as the typed
+        ``DistributedError`` with the retry evidence as ``__cause__`` —
+        never a raw ``ConnectionRefusedError`` in a peer's log."""
+        from fm_returnprediction_tpu.resilience.errors import (
+            RetryExhaustedError,
+        )
+        from fm_returnprediction_tpu.resilience.retry import (
+            RetryPolicy,
+            call_with_retry,
+        )
+
+        label = f"dist.connect.r{self.rank}"
+        policy = RetryPolicy(
+            max_attempts=2, backoff_s=0.05, multiplier=1.5,
+            max_backoff_s=2.0, jitter=0.1, retry_on=_CONNECT_RETRY_ON,
+            seed=self.rank,
+        )
+        # attempt budget = as many retries as the backoff schedule fits
+        # inside timeout_s (pure policy arithmetic — no clock reads, so
+        # the budget is the same on every run)
+        attempts, spent = 1, 0.0
+        while attempts < 256:
+            step = policy.delay_s(attempts, label)
+            if spent + step > self.timeout_s:
+                break
+            spent += step
+            attempts += 1
+        policy = dataclasses.replace(policy, max_attempts=max(attempts, 2))
+
+        def attempt() -> socket.socket:
+            sock = socket.create_connection(
+                (self.config.host, self.config.port),
+                timeout=self.timeout_s,
+            )
             try:
-                sock = socket.create_connection(
-                    (self.config.host, self.config.port), timeout=self.timeout_s
-                )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 _send_frame(sock, pickle.dumps({"rank": self.rank}))
                 ok = pickle.loads(_recv_frame(sock))
                 if not ok.get("ok") or ok.get("world") != self.world:
                     raise DistributedError(f"bad exchange handshake: {ok}")
                 sock.settimeout(self.timeout_s)
-                return sock
-            except (ConnectionError, socket.timeout, OSError) as exc:
-                last = exc
-                time.sleep(0.05)
-        raise DistributedError(
-            f"rank {self.rank} could not join exchange at "
-            f"{self.config.coordinator} within {self.timeout_s}s: {last!r}"
-        )
+            except BaseException:
+                sock.close()
+                raise
+            return sock
+
+        try:
+            return call_with_retry(attempt, policy, label=label)
+        except RetryExhaustedError as exc:
+            raise DistributedError(
+                f"rank {self.rank} could not join exchange at "
+                f"{self.config.coordinator} within {self.timeout_s}s "
+                f"({policy.max_attempts} attempts): {exc.__cause__!r}"
+            ) from exc
 
     # -- primitives --------------------------------------------------------
 
@@ -441,6 +539,15 @@ class HostExchange:
         return out
 
     def close(self) -> None:
+        # announce the departure before closing: the broker must be able
+        # to tell a finished rank from a dead one, or a fast leaver's EOF
+        # races the fan-out of the final round and surviving ranks read
+        # EOF where their reply (or its diagnostic) should have been
+        try:
+            bye = pickle.dumps((self.rank, _BYE_SEQ, b"", None))
+            _send_frame(self._sock, bye, self._wlock)
+        except (OSError, pickle.PickleError):
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -522,6 +629,14 @@ def initialize_distributed(
         cfg = config if config is not None else DistConfig.from_env()
         if cfg is None:
             return (0, 1)
+        # a parent FaultPlan that rode the spawn env installs here, before
+        # the exchange joins — chaos sites then fire inside this rank with
+        # the parent's determinism (no-op without FMRP_CHAOS_PLAN)
+        from fm_returnprediction_tpu.resilience.faults import (
+            install_plan_from_env,
+        )
+
+        install_plan_from_env()
         exchange = HostExchange(cfg)
         if _want_jax_collectives(cfg):
             from fm_returnprediction_tpu.parallel.multihost import (

@@ -62,7 +62,9 @@ import threading
 import time
 from dataclasses import dataclass
 from multiprocessing import shared_memory
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
+
+from fm_returnprediction_tpu.resilience.faults import fault_site
 
 __all__ = [
     "RingFullError",
@@ -70,8 +72,11 @@ __all__ = [
     "ShmRing",
     "attach_array",
     "attach_ring",
+    "owned_segments",
     "publish_array",
+    "release_segment",
     "shm_available",
+    "sweep_segments",
     "transport_instruments",
 ]
 
@@ -119,6 +124,85 @@ def _unregister(name: str) -> None:
         resource_tracker.unregister("/" + name, "shared_memory")
     except Exception:  # noqa: BLE001 — tracker variance across minors
         pass
+
+
+# -- owned-segment ledger (the fd/segment hygiene audit) ---------------------
+#
+# Every segment THIS process creates (ring or mapped array) is entered in a
+# module ledger at creation and struck at unlink. Normal teardown strikes
+# every entry; anything still listed after a crash path is a LEAK — a name
+# in /dev/shm with no owner left to unlink it. ``sweep_segments`` (the
+# topology controller's post-repair sweep) reaps those and counts them into
+# ``fmrp_topology_leaked_segments_total``, which the chaos suite asserts
+# stays zero across every kill/repair cycle.
+
+_SEG_LOCK = threading.Lock()
+_OWNED: set = set()
+
+
+def _ledger_add(name: str) -> None:
+    with _SEG_LOCK:
+        _OWNED.add(name)
+
+
+def _ledger_drop(name: str) -> None:
+    with _SEG_LOCK:
+        _OWNED.discard(name)
+
+
+def owned_segments() -> Tuple[str, ...]:
+    """Snapshot of segments this process created and has not yet
+    unlinked — live transports plus any leaks-in-waiting."""
+    with _SEG_LOCK:
+        return tuple(sorted(_OWNED))
+
+
+def release_segment(seg: shared_memory.SharedMemory) -> None:
+    """Owner-side disposal of a published segment: close, unlink, strike
+    the ledger entry. The one call every owner teardown path uses, so the
+    ledger's residue is exactly the leak set."""
+    name = seg.name
+    try:
+        seg.close()
+    except (OSError, BufferError):
+        pass
+    try:
+        seg.unlink()
+    except OSError:
+        _unregister(name)  # already gone: drop OUR tracker entry too
+    _ledger_drop(name)
+
+
+def sweep_segments() -> List[str]:
+    """Reap every still-ledgered segment: unlink the ones that still
+    exist and count them as leaks. Call AFTER tearing down everything
+    you own (the controller does, post-repair / post-campaign) — a live
+    fleet's segments read as leaks to this function by design, because
+    at sweep time nothing should be live."""
+    with _SEG_LOCK:
+        names = sorted(_OWNED)
+        _OWNED.clear()
+    leaked: List[str] = []
+    for name in names:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except (FileNotFoundError, OSError):
+            continue  # owner unlinked it without striking: not a leak
+        _unregister(name)
+        try:
+            seg.close()
+            seg.unlink()
+        except OSError:
+            continue
+        leaked.append(name)
+    if leaked:
+        from fm_returnprediction_tpu import telemetry
+
+        telemetry.registry().counter(
+            "fmrp_topology_leaked_segments_total",
+            help="shm segments still linked when the topology sweep ran",
+        ).inc(len(leaked))
+    return leaked
 
 
 def transport_instruments(transport: str, replica: str = "") -> dict:
@@ -185,6 +269,7 @@ class ShmRing:
             self._seg = shared_memory.SharedMemory(
                 name=name, create=True, size=size
             )
+            _ledger_add(self._seg.name)
             _HDR.pack_into(self._seg.buf, 0, _MAGIC, slots, slot_bytes,
                            0, 0, 0, 0, 0)
         else:
@@ -231,6 +316,19 @@ class ShmRing:
     def _slot_off(self, seq: int) -> int:
         return HEADER_BYTES + ((seq - 1) % self.slots) * self.slot_bytes
 
+    def watermark(self) -> Tuple[int, int]:
+        """(produced, consumed) as visible from THIS side: the local
+        write/read sequence paired with the shared tail word. On a writer
+        handle that is (frames committed, frames the peer acknowledged) —
+        the liveness probe's ring-progress watermark: a gap that fails to
+        drain between two probe samples classifies the peer as
+        RING-STALLED (pid alive, control plane answering, data plane
+        wedged), distinctly from killed or hung."""
+        with self._lock:
+            if self._closed or self._buf is None:
+                return (max(self._wseq, self._rseq), self._rseq)
+            return (max(self._wseq, self._rseq), self._tail())
+
     # -- writer ------------------------------------------------------------
 
     def send(self, payload: bytes, timeout_s: float = 5.0) -> None:
@@ -270,6 +368,10 @@ class ShmRing:
             data_off = off + SLOT_HEADER_BYTES
             self._buf[data_off:data_off + n] = payload
             struct.pack_into("<I", self._buf, off + 8, n)
+            # the exactly-once seam: payload and length are down, commit
+            # is not — a SIGKILL landing at this site (chaos campaign)
+            # must leave a frame the reader never observes
+            fault_site("shm.ring.commit")
             # commit LAST: the frame exists only once this word reads seq
             struct.pack_into("<Q", self._buf, off, seq)
             self._wseq = seq
@@ -384,6 +486,7 @@ class ShmRing:
                 # us to it) — still drop OUR tracker entry, or it warns
                 # about a "leaked" segment at interpreter exit
                 _unregister(self._seg.name)
+            _ledger_drop(self._seg.name)
 
     def __del__(self):  # best-effort: rings must not outlive the session
         try:
@@ -427,7 +530,8 @@ class ShmArraySpec:
 def publish_array(arr, name: Optional[str] = None
                   ) -> Tuple[shared_memory.SharedMemory, ShmArraySpec]:
     """Copy ``arr`` once into a named segment; the caller owns the
-    handle (keep it referenced, ``close()+unlink()`` when done)."""
+    handle (keep it referenced, :func:`release_segment` when done — it
+    strikes the hygiene ledger along with the unlink)."""
     import numpy as np
 
     arr = np.ascontiguousarray(arr)
@@ -435,6 +539,7 @@ def publish_array(arr, name: Optional[str] = None
     seg = shared_memory.SharedMemory(
         name=name, create=True, size=max(int(arr.nbytes), 1)
     )
+    _ledger_add(seg.name)
     view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)
     view[...] = arr
     del view

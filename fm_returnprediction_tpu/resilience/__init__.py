@@ -25,6 +25,7 @@ timeout/keep-going semantics live in ``taskgraph.engine``.
 from fm_returnprediction_tpu.resilience.errors import (
     ContractViolationError,
     CorruptArtifactError,
+    DegradedWorldError,
     DispatchTimeoutError,
     DriftDetectedError,
     IngestRejectedError,
@@ -36,7 +37,9 @@ from fm_returnprediction_tpu.resilience.errors import (
 from fm_returnprediction_tpu.resilience.faults import (
     FaultPlan,
     FaultSpec,
+    chaos_env,
     fault_site,
+    install_plan_from_env,
     truncate_file,
 )
 from fm_returnprediction_tpu.resilience.retry import (
@@ -55,10 +58,13 @@ __all__ = [
     "IngestRejectedError",
     "ContractViolationError",
     "DriftDetectedError",
+    "DegradedWorldError",
     "InjectedFault",
     "FaultPlan",
     "FaultSpec",
     "fault_site",
+    "chaos_env",
+    "install_plan_from_env",
     "truncate_file",
     "RetryPolicy",
     "call_with_retry",

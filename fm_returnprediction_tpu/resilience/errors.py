@@ -26,6 +26,7 @@ __all__ = [
     "ReplicaDeadError",
     "RecoveredInFlightError",
     "StateRolloverError",
+    "DegradedWorldError",
     "InjectedFault",
 ]
 
@@ -133,6 +134,19 @@ class StateRolloverError(ResilienceError):
     error on some replica). By protocol nothing has flipped yet — every
     replica is still serving the previous version — so the fleet remains
     consistent; the error names the replica and cause."""
+
+
+class DegradedWorldError(ResilienceError):
+    """A grid worker died and the run is configured exact-world-only
+    (``FMRP_TOPO_DEGRADED_GRID=0``): the pool REFUSES the disclosed N−1
+    merge rather than silently serving a partial sum. Carries the dead
+    shard ranks so the operator knows exactly which slice is missing;
+    with the knob at its default the pool degrades (exactly, by Gram
+    additivity over survivors) and discloses instead of raising."""
+
+    def __init__(self, message: str, *, dead_ranks=()):
+        super().__init__(message)
+        self.dead_ranks = tuple(dead_ranks)
 
 
 class InjectedFault(OSError):

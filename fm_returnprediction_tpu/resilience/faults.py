@@ -22,17 +22,33 @@ a seeded counter-keyed hash when ``probability`` is set — never by wall
 clock or global RNG state, so a failing chaos test replays exactly. The
 plan records every site visit (``calls``) and every triggered fault
 (``fired``) for assertions.
+
+CROSS-PROCESS propagation: the declarative subset of a plan (counts,
+probability, delay, sigkill, exc-by-type-name, corrupt=True — everything
+except live callables) serializes into ``FMRP_CHAOS_PLAN`` /
+``FMRP_CHAOS_SEED`` env vars via :func:`chaos_env`; every process spawner
+(``serving.replica_proc``, ``parallel.distributed.worker_env``) merges
+these into the child env, and each child entrypoint calls
+:func:`install_plan_from_env` before serving, so ``fault_site`` fires
+INSIDE replica / grid / broker processes with the same count-gated
+determinism. ``FaultSpec.proc`` targets one member of a spawned pool: a
+spec only installs in the child whose ``FMRP_DIST_PROC_ID`` /
+``FMRP_PROC_INDEX`` matches, so a pool-wide env kills exactly one rank.
 """
 
 from __future__ import annotations
 
+import builtins
 import dataclasses
 import hashlib
+import json
+import os
+import signal
 import threading
 import time
 from collections import Counter
 from pathlib import Path
-from typing import Callable, Dict, Optional, Union
+from typing import Callable, Dict, Mapping, Optional, Union
 
 from fm_returnprediction_tpu.resilience.errors import InjectedFault
 
@@ -40,6 +56,8 @@ __all__ = [
     "FaultSpec",
     "FaultPlan",
     "fault_site",
+    "chaos_env",
+    "install_plan_from_env",
     "truncate_file",
     "poison_nan_flood",
     "poison_scale_spike",
@@ -88,6 +106,15 @@ class FaultSpec:
                   corruption; ``True`` selects :func:`truncate_file`).
     mutate      : called with the site's ``payload`` operand, returns the
                   poisoned payload (e.g. NaN rows into an ingest).
+    sigkill     : SIGKILL the CURRENT process at the site — the real
+                  no-cleanup death (no finally blocks, no atexit). Only
+                  meaningful inside a spawned child (via env propagation);
+                  the site's placement picks the torn state left behind.
+    proc        : restrict env-propagated installation to the child whose
+                  process identity (``FMRP_DIST_PROC_ID`` for grid ranks,
+                  ``FMRP_PROC_INDEX`` for process replicas) equals this
+                  string — one member of a pool-wide env dies, the rest
+                  never see the spec.
     """
 
     times: int = 1
@@ -97,6 +124,8 @@ class FaultSpec:
     delay_s: float = 0.0
     corrupt: Union[None, bool, Callable[[Path], None]] = None
     mutate: Optional[Callable] = None
+    sigkill: bool = False
+    proc: Optional[str] = None
 
     def _make_exc(self, site: str) -> BaseException:
         if self.exc is None:
@@ -174,6 +203,8 @@ class FaultPlan:
         # effects OUTSIDE the lock: a delay must not serialize other sites
         if spec.delay_s:
             time.sleep(spec.delay_s)
+        if spec.sigkill:
+            os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, by design
         if spec.corrupt is not None and path is not None:
             corruptor = truncate_file if spec.corrupt is True else spec.corrupt
             corruptor(Path(path))
@@ -185,6 +216,124 @@ class FaultPlan:
                                     and not spec.delay_s):
             raise spec._make_exc(site)
         return payload
+
+
+# -- cross-process propagation ----------------------------------------------
+#
+# A FaultPlan is a parent-process object; spawned children (process
+# replicas, grid workers, broker hosts) import a FRESH module with no plan
+# installed. The pair below closes that gap: ``chaos_env()`` serializes the
+# declarative subset of the active plan into two env vars, every spawner
+# merges them into its child env, and each child entrypoint calls
+# ``install_plan_from_env()`` first thing — so the SAME count-gated
+# determinism holds inside the child. Live callables (mutate, custom
+# corruptors, exception factories) cannot ride env and stay parent-only;
+# a spec that carries one is silently skipped by serialization, never
+# half-shipped.
+
+_ENV_PLAN = "FMRP_CHAOS_PLAN"
+_ENV_SEED = "FMRP_CHAOS_SEED"
+
+
+def _spec_to_wire(spec: FaultSpec) -> Optional[dict]:
+    """The env-serializable subset of one spec, or None when it cannot
+    ride (live callables don't serialize; such specs stay parent-only)."""
+    if spec.mutate is not None:
+        return None
+    if spec.corrupt is not None and spec.corrupt is not True:
+        return None
+    exc_name: Optional[str] = None
+    if spec.exc is not None:
+        if not (isinstance(spec.exc, type)
+                and issubclass(spec.exc, BaseException)):
+            return None
+        exc_name = spec.exc.__name__
+    return {
+        "times": spec.times,
+        "skip": spec.skip,
+        "probability": spec.probability,
+        "delay_s": spec.delay_s,
+        "corrupt": spec.corrupt is True,
+        "sigkill": spec.sigkill,
+        "proc": spec.proc,
+        "exc": exc_name,
+    }
+
+
+def _resolve_exc(name: str) -> type:
+    """Exception type by name: builtins first (ConnectionError, OSError,
+    ...), then the resilience taxonomy (InjectedFault, ReplicaDeadError,
+    ...)."""
+    got = getattr(builtins, name, None)
+    if isinstance(got, type) and issubclass(got, BaseException):
+        return got
+    from fm_returnprediction_tpu.resilience import errors as _errors
+
+    got = getattr(_errors, name, None)
+    if isinstance(got, type) and issubclass(got, BaseException):
+        return got
+    raise ValueError(f"unknown exception type in chaos env: {name!r}")
+
+
+def chaos_env(plan: Optional[FaultPlan] = None) -> Dict[str, str]:
+    """Serialize ``plan`` (default: the active plan) into the env-var pair
+    spawners merge into a child env. Empty dict when no plan is active or
+    nothing in it serializes — so every spawner can
+    ``env.update(chaos_env())`` unconditionally at zero cost."""
+    plan = _ACTIVE if plan is None else plan
+    if plan is None:
+        return {}
+    wire = {
+        site: w
+        for site, spec in plan.specs.items()
+        if (w := _spec_to_wire(spec)) is not None
+    }
+    if not wire:
+        return {}
+    return {
+        _ENV_PLAN: json.dumps(wire, sort_keys=True),
+        _ENV_SEED: str(plan.seed),
+    }
+
+
+def install_plan_from_env(
+    environ: Optional[Mapping[str, str]] = None,
+) -> Optional[FaultPlan]:
+    """Child-process entrypoint hook: rebuild and install (for the process
+    lifetime) the plan the parent serialized with :func:`chaos_env`.
+
+    Specs carrying ``proc`` are filtered against THIS process's identity
+    (``FMRP_DIST_PROC_ID``, then ``FMRP_PROC_INDEX``); non-matching specs
+    are dropped, so a pool-wide env targets exactly one rank. Returns the
+    installed plan, or None when the env carries nothing for this process.
+    The plan is deliberately never exited — chaos lasts until the child
+    dies, which is the contract the campaign tests assert against.
+    """
+    env = os.environ if environ is None else environ
+    raw = env.get(_ENV_PLAN, "").strip()
+    if not raw:
+        return None
+    seed = int(env.get(_ENV_SEED, "0") or "0")
+    me = env.get("FMRP_DIST_PROC_ID") or env.get("FMRP_PROC_INDEX")
+    specs: Dict[str, FaultSpec] = {}
+    for site, w in json.loads(raw).items():
+        if w.get("proc") is not None and w["proc"] != me:
+            continue
+        specs[site] = FaultSpec(
+            times=int(w.get("times", 1)),
+            skip=int(w.get("skip", 0)),
+            probability=w.get("probability"),
+            exc=_resolve_exc(w["exc"]) if w.get("exc") else None,
+            delay_s=float(w.get("delay_s", 0.0)),
+            corrupt=True if w.get("corrupt") else None,
+            sigkill=bool(w.get("sigkill", False)),
+            proc=w.get("proc"),
+        )
+    if not specs:
+        return None
+    plan = FaultPlan(specs, seed=seed)
+    plan.__enter__()  # process-lifetime install; exited only by death
+    return plan
 
 
 # -- data-corruption payload mutators --------------------------------------

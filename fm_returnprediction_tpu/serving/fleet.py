@@ -444,6 +444,9 @@ class ServingFleet:
         else:
             self.brownout = None
         self._crashed = False
+        # set by TopologyController.__init__ when one adopts this fleet;
+        # the supervisor's scale verbs prefer it over direct fleet calls
+        self.topology = None
         try:
             for _ in range(n_replicas):
                 self._add_replica()

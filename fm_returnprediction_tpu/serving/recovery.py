@@ -77,7 +77,10 @@ class JournalRecovery:
     n_admitted: int
     n_done: int
     n_shed: int
-    last_size: Optional[int]   # fleet size from the latest topology mark
+    last_size: Optional[int]   # fleet size from the latest size mark
+    last_topology: Optional[dict] = None  # latest full ``topology`` mark
+    #   (the TopologySpec as a plain dict) — what lets the topology
+    #   controller rebuild ANY declared shape, not just a replica count
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,11 +184,17 @@ def recover_journal(path: Union[str, Path]) -> JournalRecovery:
     state: Dict[int, Tuple[str, Optional[str]]] = {}
     terminal: Dict[int, bool] = {}
     last_size: Optional[int] = None
+    last_topology: Optional[dict] = None
     for e in events:
         ev = e.get("ev")
         if ev == "mark":
             if e.get("size") is not None:
                 last_size = int(e["size"])
+            if e.get("label") == "topology" and e.get("topo"):
+                try:
+                    last_topology = json.loads(e["topo"])
+                except (json.JSONDecodeError, TypeError):
+                    pass  # a torn topo payload degrades to size-only
             continue
         req = e.get("req")
         if req is None:
@@ -229,4 +238,5 @@ def recover_journal(path: Union[str, Path]) -> JournalRecovery:
         n_done=replay.n_done,
         n_shed=replay.n_shed,
         last_size=last_size,
+        last_topology=last_topology,
     )

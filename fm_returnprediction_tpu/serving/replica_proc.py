@@ -227,6 +227,12 @@ class ProcessReplica:
         # themselves process_index=<k> (identity.py's generic knob)
         digits = "".join(c for c in rid if c.isdigit())
         env["FMRP_PROC_INDEX"] = digits or "0"
+        # an active FaultPlan crosses the spawn with the replica: the
+        # worker's main() installs it, so chaos sites (shm.ring.commit,
+        # replica verb stalls, ...) fire INSIDE the child deterministically
+        from fm_returnprediction_tpu.resilience.faults import chaos_env
+
+        env.update(chaos_env())
         repo_root = str(Path(__file__).resolve().parents[2])
         env["PYTHONPATH"] = repo_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""

@@ -30,6 +30,7 @@ def _serve(cfg: dict) -> None:
         recv_frame,
         send_frame,
     )
+    from fm_returnprediction_tpu.resilience.faults import fault_site
 
     rid = cfg["rid"]
     sock = socket.create_connection(("127.0.0.1", int(cfg["port"])),
@@ -105,6 +106,11 @@ def _serve(cfg: dict) -> None:
     def on_done(req_id: int, inner) -> None:
         exc = inner.exception()
         if exc is None:
+            # socket-transport seam site: a SIGKILL here dies with the
+            # result computed but never sent — the parent's requeue +
+            # journal replay must stay clean (the socket twin of the shm
+            # path's shm.ring.commit)
+            fault_site("replica.result_send")
             send({"op": "result", "id": req_id, "ok": True,
                   "value": float(inner.result())})
         else:
@@ -121,6 +127,10 @@ def _serve(cfg: dict) -> None:
         except Exception:  # noqa: BLE001 — parent gone: die quietly
             break
         op, req_id = msg.get("op"), msg.get("id")
+        # control-plane chaos site: an env-propagated delay_s here is a
+        # HUNG replica (pid alive, verbs not answering) — the liveness
+        # ladder must classify it distinctly from killed / ring-stalled
+        fault_site("replica.verb", payload=op)
         if op == "submit":
             from fm_returnprediction_tpu.serving.batcher import (
                 QueueFullError,
@@ -194,6 +204,13 @@ def _serve(cfg: dict) -> None:
 
 
 def main() -> None:
+    # chaos first: a parent FaultPlan that rode the spawn env must be
+    # live before any serving code runs, so even spawn-path sites fire
+    from fm_returnprediction_tpu.resilience.faults import (
+        install_plan_from_env,
+    )
+
+    install_plan_from_env()
     with open(sys.argv[1], "rb") as fh:
         cfg = pickle.load(fh)
     _serve(cfg)

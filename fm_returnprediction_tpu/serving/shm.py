@@ -69,15 +69,18 @@ import numpy as np
 
 from fm_returnprediction_tpu.parallel.shm import RingFullError, ShmRing
 from fm_returnprediction_tpu.resilience.errors import ServiceOverloadError
+from fm_returnprediction_tpu.resilience.faults import fault_site
 
 __all__ = [
     "FLEET_TRANSPORTS",
     "ShmReplicaChannel",
+    "open_doorbells",
     "pack_ack",
     "pack_results",
     "pack_submit",
     "resolve_fleet_transport",
     "serve_data_plane",
+    "sweep_doorbells",
     "unpack_frame",
 ]
 
@@ -341,16 +344,69 @@ def unpack_frame(frame: bytes):
 # -- parent side: the coalescing channel --------------------------------------
 
 
+# -- doorbell fd ledger (the fd half of the hygiene audit) --------------------
+#
+# Mirror of ``parallel.shm``'s owned-segment ledger: every eventfd this
+# process creates is entered at creation and struck at close. Normal
+# channel teardown strikes both bells; anything still listed after a
+# crash path is a leaked fd the topology sweep closes and counts into
+# ``fmrp_topology_leaked_fds_total``.
+
+_BELL_LOCK = threading.Lock()
+_BELLS: set = set()
+
+
+def open_doorbells() -> Tuple[int, ...]:
+    """Snapshot of doorbell fds this process created and has not yet
+    closed — live channels plus any leaks-in-waiting."""
+    with _BELL_LOCK:
+        return tuple(sorted(_BELLS))
+
+
+def sweep_doorbells() -> List[int]:
+    """Close every still-ledgered doorbell fd and count the ones that
+    were still open as leaks. Like ``parallel.shm.sweep_segments``: call
+    AFTER tearing down everything you own — a live channel's bells read
+    as leaks here by design."""
+    with _BELL_LOCK:
+        fds = sorted(_BELLS)
+        _BELLS.clear()
+    leaked: List[int] = []
+    for fd in fds:
+        try:
+            os.close(fd)
+        except OSError:
+            continue  # already closed without striking: not a leak
+        leaked.append(fd)
+    if leaked:
+        from fm_returnprediction_tpu import telemetry
+
+        telemetry.registry().counter(
+            "fmrp_topology_leaked_fds_total",
+            help="doorbell eventfds still open when the topology sweep ran",
+        ).inc(len(leaked))
+    return leaked
+
+
 def _make_doorbell() -> Optional[int]:
     """One eventfd doorbell (Linux; None elsewhere → the rings fall
     back to sleep-polling). Created inheritable-on-request: the spawn
-    passes it via ``pass_fds`` so the child sees the same fd number."""
+    passes it via ``pass_fds`` so the child sees the same fd number.
+
+    ``serving.shm.doorbell_fd`` is the doorbell-loss chaos site: an
+    injected OSError here is exactly what fd exhaustion looks like, and
+    the channel must degrade to the poll fallback (correct quotes,
+    higher latency), never fail."""
     if not hasattr(os, "eventfd"):
         return None
     try:
-        return os.eventfd(0)
+        fault_site("serving.shm.doorbell_fd")
+        fd = os.eventfd(0)
     except OSError:
         return None
+    with _BELL_LOCK:
+        _BELLS.add(fd)
+    return fd
 
 
 class ShmReplicaChannel:
@@ -528,6 +584,8 @@ class ShmReplicaChannel:
                     os.close(fd)
                 except OSError:
                     pass
+                with _BELL_LOCK:
+                    _BELLS.discard(fd)
         self._req_bell = self._resp_bell = None
 
 

@@ -17,6 +17,14 @@ if __name__ == "__main__":
     # BEFORE any jax init: the affinity bounds XLA's thread pools (the
     # fixed-compute-per-process knob the pool's cpus_per_worker sets)
     apply_cpu_affinity_from_env()
+    # chaos next: a parent FaultPlan that rode the spawn env (worker_env)
+    # must be live before the exchange joins, so grid-rank death /
+    # mid-merge sites fire inside this worker deterministically
+    from fm_returnprediction_tpu.resilience.faults import (
+        install_plan_from_env,
+    )
+
+    install_plan_from_env()
     from fm_returnprediction_tpu.specgrid.multiproc import worker_main
 
     worker_main(sys.argv[1])

@@ -336,11 +336,18 @@ def worker_main(paneldir: str) -> None:
     shard, answer contract jobs until the parent broadcasts stop.
     (Entry point: ``python -m fm_returnprediction_tpu.specgrid.mp_worker``.)"""
     from fm_returnprediction_tpu.parallel import distributed as dist
+    from fm_returnprediction_tpu.resilience.faults import fault_site
 
     rank, world = dist.initialize_distributed()
     ex = dist.host_exchange()
     assert ex is not None and rank >= 1, "worker ranks start at 1"
-    state = _WorkerState(Path(paneldir), rank, world - 1)
+    # shard identity is decoupled from exchange rank: a degraded respawn
+    # rebuilds a SMALLER world whose exchange ranks renumber 1..S, but
+    # each survivor must keep cutting its ORIGINAL firm slice — the pool
+    # pins both via env; absent (the normal full world) they coincide
+    shard_rank = int(os.environ.get("FMRP_GRID_SHARD_RANK", rank))
+    shard_procs = int(os.environ.get("FMRP_GRID_SHARD_PROCS", world - 1))
+    state = _WorkerState(Path(paneldir), shard_rank, shard_procs)
 
     from fm_returnprediction_tpu.parallel.shm import transport_instruments
 
@@ -349,6 +356,17 @@ def worker_main(paneldir: str) -> None:
     )
 
     def handle(job: dict) -> None:
+        # grid-rank-death-mid-merge chaos site: an env-propagated sigkill
+        # here (proc-targeted at one FMRP_DIST_PROC_ID) dies with the job
+        # received and the merge unposted — the broker tears the round
+        # down and the pool's degraded N−1 path takes over
+        fault_site("grid.rank_death")
+        # a respawned survivor receives the pool's CACHED center in the
+        # job: the partial sums stay exact w.r.t. the ORIGINAL full-world
+        # center (recomputing over survivors would silently shift every
+        # downstream stat, not just drop the dead shard's rows)
+        if job.get("center") is not None:
+            state._center = np.asarray(job["center"], dtype=state.dtype)
         # the global center is PANEL state, not job state: one sum_tree
         # round when the parent asks (the pool's first grid), cached
         # after — both transports, same rank-ordered fold, identical
@@ -410,7 +428,6 @@ class SpecGridWorkerPool:
             DistConfig,
             HostExchange,
             free_port,
-            worker_env,
         )
 
         if procs < 1:
@@ -483,43 +500,23 @@ class SpecGridWorkerPool:
                         np.asarray(row_weights))
         (self.paneldir / "meta.json").write_text(json.dumps(meta))
 
-        import jax
-
         port = free_port()
         world = self.procs + 1
-        repo_root = str(Path(__file__).resolve().parents[2])
-        self.workers: List[subprocess.Popen] = []
-        for rank in range(1, world):
-            env = worker_env(rank, world, port)
-            env["PYTHONPATH"] = repo_root + (
-                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
-                else ""
-            )
-            env["JAX_ENABLE_X64"] = "1" if jax.config.jax_enable_x64 else "0"
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            # the parent's virtual-device flag must not leak: a worker
-            # needs one device, not the test harness's forced eight
-            env.pop("XLA_FLAGS", None)
-            if self.cpus_per_worker:
-                # fixed compute per process (the pod model on one box):
-                # rank k owns its own core slice, applied by the worker
-                # BEFORE jax init so XLA's pools size to it. Modulo the
-                # box so an oversubscribed pool overlaps slices instead
-                # of asking for cores that do not exist.
-                c = int(self.cpus_per_worker)
-                ncpu = os.cpu_count() or 1
-                lo = ((rank - 1) * c) % ncpu
-                hi = min(lo + c - 1, ncpu - 1)
-                env["FMRP_PROC_CPUS"] = f"{lo}-{hi}"
-            if child_env:
-                env.update(child_env)
-            self.workers.append(subprocess.Popen(
-                [sys.executable, "-m",
-                 "fm_returnprediction_tpu.specgrid.mp_worker",
-                 str(self.paneldir)],
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True,
-            ))
+        self._repo_root = str(Path(__file__).resolve().parents[2])
+        self._child_env = dict(child_env or {})
+        # shard assignment per live worker, IN WORKER ORDER: the full
+        # world is the identity [1..procs]; a degraded respawn keeps the
+        # survivors' ORIGINAL shard ranks while exchange ranks renumber
+        self._shard_ranks: List[int] = list(range(1, world))
+        self.degraded_ranks: tuple = ()
+        self._allow_degraded = (os.environ.get(
+            "FMRP_TOPO_DEGRADED_GRID", "1").strip().lower()
+            not in ("0", "false", "no"))
+        self._need_center_ship = False
+        self.workers: List[subprocess.Popen] = [
+            self._spawn_worker(rank, world, port, rank)
+            for rank in range(1, world)
+        ]
         # rank 0: embeds the server; the constructor returning means every
         # worker joined (the pool's startup barrier)
         self.exchange = HostExchange(DistConfig(
@@ -552,100 +549,303 @@ class SpecGridWorkerPool:
             np.zeros((t, p), self.dtype), np.zeros((t, p), np.int64)
         )
 
+    def _spawn_worker(self, rank: int, world: int, port: int,
+                      shard_rank: int) -> subprocess.Popen:
+        """Spawn one mp_worker with exchange rank ``rank`` in a world of
+        ``world`` and the (possibly different) panel shard
+        ``shard_rank``. Both the constructor's full world and a degraded
+        respawn route through here so the env recipe cannot drift."""
+        from fm_returnprediction_tpu.parallel.distributed import worker_env
+
+        import jax
+
+        env = worker_env(rank, world, port)
+        env["PYTHONPATH"] = self._repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else ""
+        )
+        env["JAX_ENABLE_X64"] = "1" if jax.config.jax_enable_x64 else "0"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the parent's virtual-device flag must not leak: a worker
+        # needs one device, not the test harness's forced eight
+        env.pop("XLA_FLAGS", None)
+        # pin the shard identity: slices are always cut against the
+        # ORIGINAL proc count, so a survivor re-reads exactly the firms
+        # it owned before the world shrank (Gram additivity = the merged
+        # stats are the exact partial sum over surviving shards)
+        env["FMRP_GRID_SHARD_RANK"] = str(shard_rank)
+        env["FMRP_GRID_SHARD_PROCS"] = str(self.procs)
+        if self.cpus_per_worker:
+            # fixed compute per process (the pod model on one box):
+            # shard k owns its own core slice (stable across respawns),
+            # applied by the worker BEFORE jax init so XLA's pools size
+            # to it. Modulo the box so an oversubscribed pool overlaps
+            # slices instead of asking for cores that do not exist.
+            c = int(self.cpus_per_worker)
+            ncpu = os.cpu_count() or 1
+            lo = ((shard_rank - 1) * c) % ncpu
+            hi = min(lo + c - 1, ncpu - 1)
+            env["FMRP_PROC_CPUS"] = f"{lo}-{hi}"
+        if self._child_env:
+            env.update(self._child_env)
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "fm_returnprediction_tpu.specgrid.mp_worker",
+             str(self.paneldir)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
     # -- one grid contraction ---------------------------------------------
 
     def contract(self, uidx, col_sel, window, firm_chunk=None,
                  report: bool = False):
         """One firm-sharded contraction across the pool; returns the
-        merged ``SpecGramStats`` (numpy leaves) every rank agreed on."""
-        from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+        merged ``SpecGramStats`` (numpy leaves) every rank agreed on.
+
+        If a worker process dies mid-round (the exchange tears the whole
+        world down), the pool reaps the corpse, respawns the SURVIVING
+        shards as a smaller world, and re-runs the round — a disclosed
+        degraded N−1 merge (``degraded_ranks``): exact partial sums over
+        the surviving shards against the original center. Set
+        ``FMRP_TOPO_DEGRADED_GRID=0`` to refuse and raise
+        ``DegradedWorldError`` instead (exact-full-world-only runs).
+        """
+        from fm_returnprediction_tpu.parallel.distributed import (
+            DistributedError,
+        )
 
         uidx = np.asarray(uidx)
         col_sel = np.asarray(col_sel)
         window = np.asarray(window)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("worker pool is closed")
+            re_elected = False
+            while True:
+                try:
+                    return self._contract_locked(uidx, col_sel, window,
+                                                 firm_chunk, report)
+                except DistributedError as exc:
+                    dead = self._reap_dead_ranks()
+                    if dead:
+                        self._degrade_locked(dead, exc)
+                    elif not re_elected:
+                        # every worker exited cleanly-by-teardown and no
+                        # corpse shows a signal death: the BROKER died
+                        # mid-round (its _die tears the world down). The
+                        # shards are all intact, so re-election is a
+                        # FULL-world respawn on a fresh port with the
+                        # round fanned out again — once per contract;
+                        # a second broker failure surfaces as the error
+                        re_elected = True
+                        self._reelect_locked(exc)
+                    else:
+                        raise
+
+    def _contract_locked(self, uidx, col_sel, window, firm_chunk, report):
+        from fm_returnprediction_tpu.specgrid.grams import SpecGramStats
+
         s_specs = col_sel.shape[0]
         q = self.p + 1
         sig = (s_specs, col_sel.shape[1], window.shape[1],
                None if firm_chunk is None else int(firm_chunk))
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("worker pool is closed")
-            stagger = (self._registry_armed
-                       and sig not in self._warmed_signatures)
-            self._warmed_signatures.add(sig)
-            ex = self.exchange
-            center_round = self._center is None
-            shapes = _stats_leaf_shapes(s_specs, self.t, q)
-            stats_shm = None
-            if self.transport == "shm":
-                stats_shm = {
-                    "names": [seg.name for seg, _ in
-                              self._stats_segments(s_specs, shapes)],
-                    "shapes": [list(s) for s in shapes],
-                }
-            job = {
-                "op": "contract", "uidx": uidx, "col_sel": col_sel,
-                "window": window, "firm_chunk": firm_chunk,
-                "stagger": stagger, "report": report,
-                "center_round": center_round, "stats_shm": stats_shm,
+        stagger = (self._registry_armed
+                   and sig not in self._warmed_signatures)
+        self._warmed_signatures.add(sig)
+        ex = self.exchange
+        center_round = self._center is None
+        shapes = _stats_leaf_shapes(s_specs, self.t, q)
+        stats_shm = None
+        if self.transport == "shm":
+            stats_shm = {
+                "names": [seg.name for seg, _ in
+                          self._stats_segments(s_specs, shapes)],
+                "shapes": [list(s) for s in shapes],
             }
-            t0 = time.perf_counter()
-            bytes0 = self._transport_bytes()
-            ex.broadcast_obj(job, root=0)
-            if center_round:
-                # the center is panel state: ONE exchange round per pool
-                # (cached both sides), not one per grid — the additivity
-                # precondition's cost leaves the per-grid critical path
-                s, c = ex.sum_tree(self._zero_center)
-                self._center = (s / np.maximum(c, 1)).astype(self.dtype)
-            center = self._center
-            if stagger:
-                ex.barrier("mp_warm")
-            zero = lambda *shape: np.zeros(shape, self.dtype)  # noqa: E731
-            gram, moment, n_acc, ysum, yy = (
-                zero(s_specs, self.t, q, q), zero(s_specs, self.t, q),
-                zero(s_specs, self.t), zero(s_specs, self.t),
-                zero(s_specs, self.t),
-            )
-            if stats_shm is not None:
-                # completion acks only; the stats live in the mapped
-                # segments, summed here IN RANK ORDER (the same fold the
-                # frames route computes, so the routes agree bit-for-bit)
-                ex.gather_obj(None, root=0)
-                shm_bytes = 0
-                for seg, views in self._stats_segments(s_specs, shapes):
-                    for total, view in zip(
-                            (gram, moment, n_acc, ysum, yy), views):
-                        np.add(total, view, out=total)
-                        shm_bytes += view.nbytes
-                self.last_shm_bytes = shm_bytes
-                self._inst["bytes_in"].inc(shm_bytes)
-            else:
-                # gather the per-shard stats to THIS rank only and fold
-                # in rank order (deterministic; the parent contributes
-                # nothing — an exact identity under the sum)
-                parts = [p for p in ex.gather_obj(None, root=0)
-                         if p is not None]
-                for part in parts:
-                    np.add(gram, part[0], out=gram)
-                    np.add(moment, part[1], out=moment)
-                    np.add(n_acc, part[2], out=n_acc)
-                    np.add(ysum, part[3], out=ysum)
-                    np.add(yy, part[4], out=yy)
-                self.last_shm_bytes = 0
-            if report:
-                self.last_reports = [
-                    r for r in ex.allgather_obj(None) if r is not None
-                ]
-            self.last_merge_s = time.perf_counter() - t0
-            self.last_merge_bytes = self._transport_bytes() - bytes0
+        # freshly respawned survivors never saw the center round:
+        # ship the cached full-world center IN the job once so their
+        # partial sums stay exact w.r.t. the original centering
+        ship_center = self._need_center_ship and self._center is not None
+        job = {
+            "op": "contract", "uidx": uidx, "col_sel": col_sel,
+            "window": window, "firm_chunk": firm_chunk,
+            "stagger": stagger, "report": report,
+            "center_round": center_round, "stats_shm": stats_shm,
+            "center": self._center if ship_center else None,
+        }
+        t0 = time.perf_counter()
+        bytes0 = self._transport_bytes()
+        ex.broadcast_obj(job, root=0)
+        if ship_center:
+            self._need_center_ship = False
+        if center_round:
+            # the center is panel state: ONE exchange round per pool
+            # (cached both sides), not one per grid — the additivity
+            # precondition's cost leaves the per-grid critical path
+            s, c = ex.sum_tree(self._zero_center)
+            self._center = (s / np.maximum(c, 1)).astype(self.dtype)
+        center = self._center
+        if stagger:
+            ex.barrier("mp_warm")
+        zero = lambda *shape: np.zeros(shape, self.dtype)  # noqa: E731
+        gram, moment, n_acc, ysum, yy = (
+            zero(s_specs, self.t, q, q), zero(s_specs, self.t, q),
+            zero(s_specs, self.t), zero(s_specs, self.t),
+            zero(s_specs, self.t),
+        )
+        if stats_shm is not None:
+            # completion acks only; the stats live in the mapped
+            # segments, summed here IN RANK ORDER (the same fold the
+            # frames route computes, so the routes agree bit-for-bit)
+            ex.gather_obj(None, root=0)
+            shm_bytes = 0
+            for seg, views in self._stats_segments(s_specs, shapes):
+                for total, view in zip(
+                        (gram, moment, n_acc, ysum, yy), views):
+                    np.add(total, view, out=total)
+                    shm_bytes += view.nbytes
+            self.last_shm_bytes = shm_bytes
+            self._inst["bytes_in"].inc(shm_bytes)
+        else:
+            # gather the per-shard stats to THIS rank only and fold
+            # in rank order (deterministic; the parent contributes
+            # nothing — an exact identity under the sum)
+            parts = [p for p in ex.gather_obj(None, root=0)
+                     if p is not None]
+            for part in parts:
+                np.add(gram, part[0], out=gram)
+                np.add(moment, part[1], out=moment)
+                np.add(n_acc, part[2], out=n_acc)
+                np.add(ysum, part[3], out=ysum)
+                np.add(yy, part[4], out=yy)
+            self.last_shm_bytes = 0
+        if report:
+            self.last_reports = [
+                r for r in ex.allgather_obj(None) if r is not None
+            ]
+        self.last_merge_s = time.perf_counter() - t0
+        self.last_merge_bytes = self._transport_bytes() - bytes0
         return SpecGramStats(gram, moment, n_acc, ysum, yy, center)
+
+    def _reap_dead_ranks(self) -> List[int]:
+        """Reap the worker processes and name the shards that died BY
+        SIGNAL. Once any member dies mid-round the broker tears every
+        connection down, so the surviving workers exit too — but with a
+        ``DistributedError`` traceback (positive returncode). Only the
+        instigating corpse shows a signal death (negative returncode),
+        which is what makes the classification unambiguous."""
+        dead: List[int] = []
+        for shard, w in zip(self._shard_ranks, self.workers):
+            escalated = False
+            try:
+                w.communicate(timeout=10)
+            except (subprocess.TimeoutExpired, ValueError):
+                # OUR escalation kill is teardown, not a member death —
+                # it must not masquerade as a signal-dead shard
+                escalated = True
+                w.kill()
+                try:
+                    w.communicate(timeout=5)
+                except Exception:  # noqa: BLE001 — reaped best-effort
+                    pass
+            rc = w.returncode
+            if rc is not None and rc < 0 and not escalated:
+                dead.append(shard)
+        return dead
+
+    def _degrade_locked(self, dead: List[int], cause: Exception) -> None:
+        """Shrink the world to the surviving shards and respawn.
+
+        The merged stats of the degraded world are the EXACT partial sum
+        over surviving shards (Gram additivity under the original
+        center) — disclosed, never silent: ``degraded_ranks`` names the
+        missing shards and ``fmrp_topology_degraded_grid_total`` counts
+        the events. ``FMRP_TOPO_DEGRADED_GRID=0`` refuses instead."""
+        from fm_returnprediction_tpu import telemetry
+        from fm_returnprediction_tpu.parallel.distributed import (
+            DistConfig,
+            HostExchange,
+            free_port,
+        )
+        from fm_returnprediction_tpu.resilience.errors import (
+            DegradedWorldError,
+        )
+
+        survivors = [r for r in self._shard_ranks if r not in dead]
+        if not survivors or not self._allow_degraded:
+            why = ("no shard survives" if not survivors else
+                   "FMRP_TOPO_DEGRADED_GRID=0 refuses a partial world")
+            raise DegradedWorldError(
+                f"grid shard(s) {sorted(dead)} died mid-merge; {why}",
+                dead_ranks=sorted(dead),
+            ) from cause
+        self.degraded_ranks = tuple(
+            sorted(set(self.degraded_ranks) | set(dead))
+        )
+        self._respawn_world_locked(survivors)
+        telemetry.registry().counter(
+            "fmrp_topology_degraded_grid_total",
+            help="grid rounds re-run on a disclosed degraded N-1 world",
+        ).inc()
+
+    def _reelect_locked(self, cause: Exception) -> None:
+        """Broker re-election: the embedded exchange server died but
+        every shard is intact, so the SAME shard set respawns behind a
+        fresh broker (new port) and the interrupted round fans out again
+        — no degradation, no silent loss, counted distinctly."""
+        from fm_returnprediction_tpu import telemetry
+
+        self._respawn_world_locked(list(self._shard_ranks))
+        telemetry.registry().counter(
+            "fmrp_topology_broker_reelections_total",
+            help="grid exchange brokers replaced after mid-round death",
+        ).inc()
+
+    def _respawn_world_locked(self, shards: List[int]) -> None:
+        """Tear down the dead world and stand up ``shards`` behind a
+        fresh exchange (the one respawn recipe degrade and re-election
+        share)."""
+        from fm_returnprediction_tpu.parallel.distributed import (
+            DistConfig,
+            HostExchange,
+            free_port,
+        )
+
+        try:
+            self.exchange.close()
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
+        # the response segments are sized per live worker: release the
+        # old set (striking the hygiene ledger) and let the next round
+        # build one sized to the new world
+        for entries in self._stats_segs.values():
+            for seg, views in entries:
+                del views
+                self._release_segment(seg)
+        self._stats_segs.clear()
+        self._shard_ranks = list(shards)
+        # respawned processes are cold: stagger again when the registry
+        # is armed, and ship the cached center in the next job
+        self._warmed_signatures.clear()
+        self._need_center_ship = self._center is not None
+        port = free_port()
+        world = len(shards) + 1
+        self.workers = [
+            self._spawn_worker(i, world, port, shard)
+            for i, shard in enumerate(shards, start=1)
+        ]
+        self.exchange = HostExchange(DistConfig(
+            coordinator=f"127.0.0.1:{port}", num_processes=world,
+            process_id=0,
+        ))
 
     def _stats_segments(self, s_specs: int, shapes):
         """Per-worker mapped response segments for this S-signature,
         created once and reused across grid calls (the tile engine's
         repeated same-shape contracts). Returns [(segment, leaf views),
-        ...] in WORKER RANK ORDER — the fold order of the merge."""
+        ...] in WORKER RANK ORDER — the fold order of the merge. Sized
+        to the LIVE world (survivors only, after a degrade)."""
         from fm_returnprediction_tpu.parallel.shm import publish_array
 
         cached = self._stats_segs.get(s_specs)
@@ -653,7 +853,7 @@ class SpecGridWorkerPool:
             return cached
         n_items = sum(int(np.prod(s)) for s in shapes)
         entries = []
-        for _ in range(self.procs):
+        for _ in range(len(self._shard_ranks)):
             seg, _spec = publish_array(np.zeros(n_items, self.dtype))
             flat = np.ndarray((n_items,), dtype=self.dtype, buffer=seg.buf)
             entries.append((seg, _stats_leaf_views(flat, shapes)))
@@ -693,14 +893,11 @@ class SpecGridWorkerPool:
 
     @staticmethod
     def _release_segment(seg) -> None:
-        try:
-            seg.close()
-        except (OSError, BufferError):
-            pass
-        try:
-            seg.unlink()
-        except OSError:
-            pass
+        # route through the owned-segment ledger so teardown strikes the
+        # hygiene bookkeeping (a segment released here is not a leak)
+        from fm_returnprediction_tpu.parallel.shm import release_segment
+
+        release_segment(seg)
 
     def __enter__(self) -> "SpecGridWorkerPool":
         return self
