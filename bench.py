@@ -1439,6 +1439,112 @@ def _transport_counter_delta(before: dict, after: dict, transport: str
     return d
 
 
+def _transport_timeline(state, mon, rows, n_workers, root, shape):
+    """Distributed-observability drive (ISSUE 20): one telemetry-ARMED
+    pipelined shm fleet pass at the transport shape. Every process —
+    router + each replica child — exports into one shared trace dir
+    (per-process ``events.pK.jsonl`` filenames); the timeline CLI merges
+    them into ONE Perfetto document and prints the per-hop table; the
+    router-side hop share becomes the regress-gated series
+    (``fleet_router_hop_share_pct`` @ shape @ device — lower = the
+    router ceiling receding, the number ROADMAP item 2 wants before
+    sharding the router). The rest of the table rides as nested
+    attribution (reported, never gated)."""
+    import glob as _glob
+    import subprocess
+    import sys as _sys
+    import threading as _threading
+
+    from fm_returnprediction_tpu import telemetry
+    from fm_returnprediction_tpu.serving import ServingFleet
+    from fm_returnprediction_tpu.telemetry import timeline as _tl
+
+    trace_dir = os.path.join(root, "obs_trace")
+    journal = os.path.join(root, "journal_obs.jsonl")
+    n_q = min(len(mon), 512)
+    # arming rides the ENV so the spawned children inherit it through
+    # trace_env(); the router arms through the same knobs
+    os.environ["FMRP_TELEMETRY"] = "1"
+    os.environ["FMRP_TRACE_DIR"] = trace_dir
+    try:
+        with telemetry.tracing(trace_dir):
+            fleet = ServingFleet(
+                state, 2, replica_mode="process", transport="shm",
+                max_batch=64, max_latency_ms=1.0, journal=journal,
+            )
+            try:
+                fleet.query(int(mon[0]), rows[0])  # warm the path
+
+                def worker(k0, k1):
+                    futs = []
+                    for k in range(k0, k1):
+                        try:
+                            futs.append(fleet.submit(int(mon[k]), rows[k]))
+                        except Exception:  # noqa: BLE001 — sheds pass
+                            pass
+                        if len(futs) >= 64:
+                            for f in futs:
+                                try:
+                                    f.result(timeout=30)
+                                except Exception:  # noqa: BLE001
+                                    pass
+                            futs = []
+                    for f in futs:
+                        try:
+                            f.result(timeout=30)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+                chunk = max(n_q // n_workers, 1)
+                threads = [
+                    _threading.Thread(
+                        target=worker,
+                        args=(w * chunk,
+                              n_q if w == n_workers - 1
+                              else min((w + 1) * chunk, n_q)),
+                    )
+                    for w in range(n_workers)
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                fleet.drain()
+            finally:
+                fleet.close()
+    finally:
+        os.environ.pop("FMRP_TELEMETRY", None)
+        os.environ.pop("FMRP_TRACE_DIR", None)
+    # the children flush their events.pK.jsonl from their atexit hooks;
+    # close() reaped the processes, but give the writes a beat to land
+    deadline = time.perf_counter() + 10.0
+    while (len(_glob.glob(os.path.join(trace_dir, "events*.jsonl"))) < 3
+           and time.perf_counter() < deadline):
+        time.sleep(0.05)
+    # the operator command, end to end: merged timeline.json + table
+    cli = subprocess.run(
+        [_sys.executable, "-m",
+         "fm_returnprediction_tpu.telemetry.timeline", journal, trace_dir],
+        capture_output=True, text=True, timeout=180,
+    )
+    report = _tl.analyze(trace_dir, journal_path=journal)
+    return {
+        "fleet_router_hop_shape": shape,
+        "fleet_router_hop_share_pct": report["router_share_pct"],
+        "fleet_timeline": {
+            "attributed_pct": report["attributed_pct"],
+            "processes": report["processes"],
+            "requests": report["requests"],
+            "e2e_p50_ms": report["e2e_p50_ms"],
+            "hop_p50_ms": {
+                name.split(".", 1)[1]: h["p50_ms"]
+                for name, h in report["hops"].items()
+            },
+            "cli_rc": cli.returncode,
+        },
+    }
+
+
 def _bench_transport(fast: bool):
     """The process fleet's data plane, socket vs shared-memory rings
     (ISSUE 15): the same blocking 8-worker drive as the
@@ -1658,6 +1764,11 @@ def _bench_transport(fast: bool):
             )
         finally:
             recovered.close()
+
+        # -- distributed observability: merged timeline + per-hop table ----
+        out.update(_transport_timeline(
+            state, mon, rows, n_workers, root, out["transport_shape"]
+        ))
     return out
 
 
@@ -3500,6 +3611,37 @@ def _bench_topology(fast: bool):
     return out
 
 
+# env gate → the metric-key prefix that section publishes: a round that
+# turns a section off records ``{"<section>": {"disabled": "<why>"}}`` in
+# the artifact so the regress sentinel can tell a decision from a hole
+_SECTION_GATES = {
+    "FMRP_BENCH_PIPE": "pipeline",
+    "FMRP_BENCH_REAL": "real_pipeline",
+    "FMRP_BENCH_PANEL": "panel_build",
+    "FMRP_BENCH_KERNEL": "kernel",
+    "FMRP_BENCH_KERNELS": "kernels",
+    "FMRP_BENCH_DAILY": "daily",
+    "FMRP_BENCH_PALLAS": "pallas",
+    "FMRP_BENCH_SERVING": "serving",
+    "FMRP_BENCH_FLEET": "fleet",
+    "FMRP_BENCH_FLEET_CAPACITY": "fleet_capacity",
+    "FMRP_BENCH_SPECGRID": "specgrid",
+    "FMRP_BENCH_SPECGRID_SCALE": "specgrid_scale",
+    "FMRP_BENCH_GRID_FACTORIZED": "grid_factorized",
+    "FMRP_BENCH_ESTIMATORS": "estimators",
+    "FMRP_BENCH_BACKTEST": "backtest",
+    "FMRP_BENCH_MULTIPROC": "multiproc",
+    "FMRP_BENCH_TRANSPORT": "transport",
+    "FMRP_BENCH_TOPOLOGY": "topology",
+    "FMRP_BENCH_RESIL": "resilience",
+    "FMRP_BENCH_GUARD": "guard",
+    "FMRP_BENCH_REGISTRY": "registry",
+    "FMRP_BENCH_OBS": "obs",
+    "FMRP_BENCH_FUSEPROBE": "fuseprobe",
+    "FMRP_BENCH_MESH8": "mesh8",
+}
+
+
 def main() -> None:
     from fm_returnprediction_tpu.settings import enable_compilation_cache
     from fm_returnprediction_tpu.utils.timing import trace
@@ -3629,6 +3771,17 @@ def main() -> None:
                 section_cache_growth[section.__name__] = delta.grew
     if section_cache_growth:
         extra["section_cache_growth"] = section_cache_growth
+
+    # deliberately-disabled sections land in the artifact as EXPLICIT
+    # objects, not silence — the regress sentinel discloses them (never
+    # gates) instead of reading absence as coverage (the r08/r09
+    # noise-flappers were env-gated off with no record of the decision)
+    for env_key, section in _SECTION_GATES.items():
+        if os.environ.get(env_key, "1") == "0":
+            extra[section] = {
+                "disabled": f"{env_key}=0 (deliberately disabled "
+                            "this round)"
+            }
 
     bench_done.set()
     extra["jax_cache_after"] = _jax_cache_stats()

@@ -304,3 +304,32 @@ def task_topology_smoke():
         "verbosity": 2,
         "uptodate": [False],  # test-suite target: always re-run
     }
+
+
+def task_obs_smoke():
+    """The distributed-observability plane's suite as one named exit-1
+    gate (``-m obs``): cross-process trace propagation with shm-vs-socket
+    span parity, fleet-wide metric aggregation staying monotone across a
+    kill + respawn, the SIGKILL-surviving flight annex (commit-last
+    double buffer, 30/30 deterministic chaos rounds), the torn-totals
+    snapshot lock on ``/metrics``, regress.py's disabled-section
+    disclosure, and the per-hop timeline merge/analyze path the bench's
+    router-ceiling series rides on. The pre-merge gate for anything
+    touching ``telemetry/`` or the process seams it instruments. Sits
+    alongside ``robustness_smoke`` and ``topology_smoke``."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return {
+        "actions": [
+            f"cd {repo} && {sys.executable} -m pytest tests/ -q "
+            "-m obs -p no:cacheprovider"
+        ],
+        "file_dep": [],
+        "targets": [],
+        "doc": "obs marker suite (trace propagation, metric aggregation "
+               "monotonicity, annex harvest, timeline merge) — exit-1 "
+               "on any failure",
+        "verbosity": 2,
+        "uptodate": [False],  # test-suite target: always re-run
+    }

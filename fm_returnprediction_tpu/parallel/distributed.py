@@ -181,6 +181,13 @@ def worker_env(rank: int, world: int, port: int,
     from fm_returnprediction_tpu.resilience.faults import chaos_env
 
     env.update(chaos_env())
+    # trace context crosses with it too (FMRP_TRACE_* / FMRP_TELEMETRY):
+    # worker spans parent onto the spawning request span and export into
+    # the shared trace dir under per-process filenames, so the timeline
+    # merge shows grid workers as named rows beside the router
+    from fm_returnprediction_tpu.telemetry.distributed import trace_env
+
+    trace_env(env)
     return env
 
 
@@ -228,6 +235,18 @@ class _ExchangeServer:
                         raise DistributedError(f"duplicate rank {rank}")
                     self._conns[rank] = conn
                     self._wlocks[rank] = threading.Lock()
+                # the monotonic-offset exchange rides the join hello:
+                # rank 0 records every peer's epoch anchor, the evidence
+                # the timeline merge uses to align clocks exactly
+                if hello.get("anchor_ns") is not None:
+                    from fm_returnprediction_tpu.telemetry.distributed import (
+                        register_peer,
+                    )
+
+                    register_peer(
+                        f"rank{rank}", pid=hello.get("pid"),
+                        anchor_ns=hello.get("anchor_ns"), kind="rank",
+                    )
             # all present: release everyone (the startup barrier)
             ok = pickle.dumps({"ok": True, "world": self.world})
             for rank, conn in self._conns.items():
@@ -441,7 +460,12 @@ class HostExchange:
             )
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                _send_frame(sock, pickle.dumps({"rank": self.rank}))
+                from fm_returnprediction_tpu.telemetry import spans as _spans
+
+                _send_frame(sock, pickle.dumps({
+                    "rank": self.rank, "pid": os.getpid(),
+                    "anchor_ns": _spans.EPOCH_ANCHOR_NS,
+                }))
                 ok = pickle.loads(_recv_frame(sock))
                 if not ok.get("ok") or ok.get("world") != self.world:
                     raise DistributedError(f"bad exchange handshake: {ok}")

@@ -97,6 +97,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from fm_returnprediction_tpu import telemetry
+from fm_returnprediction_tpu.telemetry import distributed as _obs
+from fm_returnprediction_tpu.telemetry import spans as _spans
 from fm_returnprediction_tpu.resilience.errors import (
     DispatchTimeoutError,
     IngestRejectedError,
@@ -372,6 +374,16 @@ class ServingFleet:
         # go negative because a replica died)
         self._agg_prior = {"n_done": 0, "n_rejected": 0, "n_failed": 0,
                            "dispatch_timeouts": 0}
+        # fleet-wide metric aggregation: process children ship registry
+        # deltas on the stats heartbeat; the aggregator folds them into
+        # the scrape with {proc=} labels under the SAME dead-replica
+        # discipline as _agg_prior (fold on departure, so a scraper's
+        # rate() never goes negative across a kill)
+        self.aggregator = _obs.MetricAggregator()
+        # rid → flight-recorder tail harvested from the replica's shm
+        # annex at death (the post-mortem evidence the topology
+        # controller attaches to its probe verdicts)
+        self.flights: Dict[str, dict] = {}
         self._ring = HashRing(vnodes=vnodes)
         self._generation = 0
         self._req_counter = 0
@@ -526,6 +538,9 @@ class ServingFleet:
                 registry_dir=reg_dir,
                 transport=self._transport,
             )
+            # the stats heartbeat doubles as the metric-aggregation wire:
+            # deltas the child attaches route straight into the fold
+            service.metrics_sink = self.aggregator.ingest
             if service.warm_report is not None:
                 self.warm_reports[rid] = service.warm_report
             return service
@@ -558,6 +573,18 @@ class ServingFleet:
         if rep.folded:
             return
         rep.folded = True
+        # aggregated child series fold the same way (monotone families
+        # move to proc="departed"; no-op for thread replicas, which never
+        # shipped a delta)
+        self.aggregator.fold_dead(rep.rid)
+        # post-mortem: the flight tail the child mirrored into its shm
+        # annex (ProcessReplica caches it at death; never raises)
+        harvest = getattr(rep.service, "harvest_flight", None)
+        if harvest is not None:
+            flight = harvest()
+            if flight is not None:
+                with self._lock:
+                    self.flights[rep.rid] = flight
         try:
             s = rep.service.stats()
         except Exception:  # noqa: BLE001 — a corpse that can't report
@@ -838,6 +865,10 @@ class ServingFleet:
         state version knows. ``key`` opts into affinity routing (same key
         → same replica while membership holds); default is per-request
         spread."""
+        # request-timeline origin: hop.admit runs entry → handed to the
+        # routing layer; t0 also anchors the fleet.request e2e span the
+        # terminal callback closes (zero = unarmed, every stamp no-ops)
+        t0 = time.perf_counter_ns() if _spans.active() else 0
         with self._lock:
             self._req_counter += 1
             req = self._req_counter
@@ -878,9 +909,14 @@ class ServingFleet:
                         reason="brownout_shed",
                     )
                 self._serve_degraded(req, month, x, rung, outer)
+                if t0:
+                    _spans.record_span("fleet.request", t0, cat="request",
+                                       req=req, route=rung)
                 return outer
+            if t0:
+                _spans.record_span("hop.admit", t0, req=req)
             self._route_and_submit(req, month, x, key or str(req), outer,
-                                   tried=frozenset(), attempt=0)
+                                   tried=frozenset(), attempt=0, t0=t0)
         except Exception as exc:
             # admitted but terminal at submit time — unroutable (all
             # queues refused), malformed, or an exception out of a chaos
@@ -902,7 +938,7 @@ class ServingFleet:
 
     def _route_and_submit(self, req: int, month, x, key: str,
                           outer: Future, tried: frozenset,
-                          attempt: int) -> None:
+                          attempt: int, t0: int = 0) -> None:
         tried = set(tried)
         while True:
             with self._lock:
@@ -949,7 +985,7 @@ class ServingFleet:
             rep.inflight += 1
         inner.add_done_callback(
             lambda fut: self._on_inner_done(req, month, x, key, outer,
-                                            rid, tried, attempt, fut)
+                                            rid, tried, attempt, fut, t0)
         )
         # chaos: kill the replica this request is now IN FLIGHT on — the
         # callback's requeue path is what makes that survivable. The site
@@ -987,8 +1023,8 @@ class ServingFleet:
             outer.set_result(quote)
 
     def _on_inner_done(self, req: int, month, x, key: str, outer: Future,
-                       rid: str, tried: set, attempt: int, inner: Future
-                       ) -> None:
+                       rid: str, tried: set, attempt: int, inner: Future,
+                       t0: int = 0) -> None:
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is not None and rep.inflight > 0:
@@ -996,6 +1032,10 @@ class ServingFleet:
         exc = inner.exception()
         if exc is None:
             self._jrnl("done", req)
+            if t0:
+                # the e2e request span the per-hop table divides into
+                _spans.record_span("fleet.request", t0, cat="request",
+                                   req=req, replica=rid)
             self._finish()
             if not outer.cancelled():
                 outer.set_result(inner.result())
@@ -1009,11 +1049,14 @@ class ServingFleet:
             try:
                 self._route_and_submit(req, month, x, key, outer,
                                        tried=frozenset(tried | {rid}),
-                                       attempt=attempt + 1)
+                                       attempt=attempt + 1, t0=t0)
                 return
             except Exception as requeue_exc:  # noqa: BLE001 — delivered
                 exc = requeue_exc
         self._jrnl("error", req, error=repr(exc)[:200])
+        if t0:
+            _spans.record_span("fleet.request", t0, cat="request", req=req,
+                               replica=rid, error=type(exc).__name__)
         self._finish()
         if not outer.cancelled():
             outer.set_exception(exc)
@@ -1267,9 +1310,17 @@ class ServingFleet:
             k: v for k, v in self.stats().items()
             if isinstance(v, (int, float)) and not isinstance(v, bool)
         }
-        return telemetry.prometheus_text(
-            extra=flat, extra_prefix="fmrp_fleet_service_"
-        )
+        from fm_returnprediction_tpu.telemetry import metrics as _metrics
+
+        # ONE snapshot-lock hold across the registry render AND the
+        # aggregated child fold: a kill_replica folding mid-scrape can
+        # no longer tear the exposition (live series gone, departed fold
+        # not yet rendered → fleet totals dip then recover)
+        with _metrics.SNAPSHOT_LOCK:
+            text = telemetry.prometheus_text(
+                extra=flat, extra_prefix="fmrp_fleet_service_"
+            )
+            return text + self.aggregator.prometheus_text()
 
     def start_metrics_server(self, port: int = 0, host: str = "127.0.0.1"):
         """Serve :meth:`prometheus_metrics` over HTTP (``GET /metrics``);

@@ -127,10 +127,21 @@ class RequestJournal:
     def append(self, ev: str, req: Optional[int] = None, **fields) -> int:
         """Write one event line; returns its ``seq``. No-op (returns -1)
         after close — a late done-callback racing a shutdown must not
-        crash the flusher thread that carries it."""
+        crash the flusher thread that carries it.
+
+        ``FMRP_OBS_JOURNAL_TS=1`` stamps each record with ``t_ns``
+        (``perf_counter_ns`` — the span clock, so the timeline CLI can
+        join journal FSM records against merged traces on one axis).
+        OFF by default: journal bytes stay deterministic, which the
+        replay/recovery tests compare."""
         record = {"ev": str(ev)}
         if req is not None:
             record["req"] = int(req)
+        if os.environ.get("FMRP_OBS_JOURNAL_TS", "").strip().lower() in (
+                "1", "true", "yes", "on"):
+            import time
+
+            record["t_ns"] = time.perf_counter_ns()
         for k, v in sorted(fields.items()):
             if v is not None:
                 record[k] = v

@@ -70,6 +70,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 from concurrent.futures import Future
 from pathlib import Path
 from typing import Dict, Optional
@@ -78,6 +79,7 @@ from fm_returnprediction_tpu.parallel.distributed import (
     recv_frame,
     send_frame,
 )
+from fm_returnprediction_tpu.telemetry import spans as _spans
 from fm_returnprediction_tpu.registry.warm import WarmReport
 from fm_returnprediction_tpu.resilience.errors import ReplicaDeadError
 from fm_returnprediction_tpu.serving.batcher import QueueFullError
@@ -161,10 +163,22 @@ class ProcessReplica:
             resolve_fleet_transport,
         )
 
+        from fm_returnprediction_tpu.telemetry import (
+            distributed as _obs,
+        )
+
         self.replica_id = rid
         self.transport = resolve_fleet_transport(transport)
         self._call_timeout_s = float(call_timeout_s)
         self._dead: Optional[str] = None
+        # post-mortem flight annex: parent-owned shm mailbox the child
+        # mirrors its flight tail into — harvestable through SIGKILL
+        self.annex = (_obs.FlightAnnex.create(rid)
+                      if _obs.annex_enabled() else None)
+        self.last_flight: Optional[dict] = None
+        self.anchor_ns: Optional[int] = None
+        #: set by the fleet: callable(rid, delta) feeding its aggregator
+        self.metrics_sink = None
         self._wlock = threading.Lock()
         self._idlock = threading.Lock()
         self._next_id = 0
@@ -205,6 +219,8 @@ class ProcessReplica:
             "service_kwargs": kwargs,
             "shm": (self._channel.describe()
                     if self._channel is not None else None),
+            "annex": (self.annex.describe()
+                      if self.annex is not None else None),
         }
         fd, cfg_path = tempfile.mkstemp(suffix=".pkl", prefix=f"{rid}_cfg_",
                                         dir=str(scratch))
@@ -233,6 +249,10 @@ class ProcessReplica:
         from fm_returnprediction_tpu.resilience.faults import chaos_env
 
         env.update(chaos_env())
+        # trace context crosses the spawn: telemetry arming + trace dir
+        # + the spawning span's identity (FMRP_TRACE_REMOTE), so the
+        # child's root spans name their router parent
+        _obs.trace_env(env)
         repo_root = str(Path(__file__).resolve().parents[2])
         env["PYTHONPATH"] = repo_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
@@ -253,6 +273,7 @@ class ProcessReplica:
         except (socket.timeout, OSError, EOFError) as exc:
             self.proc.kill()
             self._stop_channel()
+            self._release_annex()
             raise ReplicaSpawnError(
                 f"replica {rid} never said hello within {spawn_timeout_s}s "
                 f"({exc!r}); log: {self._log_tail()}"
@@ -262,6 +283,7 @@ class ProcessReplica:
         if not hello.get("ok"):
             self.proc.kill()
             self._stop_channel()
+            self._release_annex()
             raise ReplicaSpawnError(
                 f"replica {rid} failed to start: {hello.get('error')}; "
                 f"log: {self._log_tail()}"
@@ -269,6 +291,12 @@ class ProcessReplica:
         conn.settimeout(None)
         self._sock = conn
         self.pid = int(hello["pid"])
+        # clock alignment: the child's epoch anchor rides the hello —
+        # recorded router-side as the monotonic-offset exchange evidence
+        # the timeline merge verifies against
+        self.anchor_ns = hello.get("anchor_ns")
+        _obs.register_peer(rid, pid=self.pid, anchor_ns=self.anchor_ns,
+                           kind="replica")
         warm = hello.get("warm")
         self.warm_report: Optional[WarmReport] = (
             WarmReport(**{**warm, "programs": tuple(warm["programs"])})
@@ -298,6 +326,31 @@ class ProcessReplica:
                 self._channel.stop()
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 pass
+
+    def harvest_flight(self) -> Optional[dict]:
+        """Read the child's last committed flight mirror out of the shm
+        annex and cache it on this handle — callable before OR after the
+        child is dead (SIGKILL included: the annex is parent-owned shm,
+        and the mirror protocol commits last, so a kill mid-mirror
+        leaves the previous tail whole). Returns the cached flight."""
+        if self.annex is not None:
+            flight = None
+            try:
+                flight = self.annex.harvest()
+            except Exception:  # noqa: BLE001 — a torn annex reads absent
+                flight = None
+            if flight is not None:
+                self.last_flight = flight
+        return self.last_flight
+
+    def _release_annex(self) -> None:
+        if self.annex is None:
+            return
+        try:
+            self.annex.release()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        self.annex = None
 
     def _send(self, msg: dict) -> None:
         if self._dead is not None:
@@ -342,6 +395,10 @@ class ProcessReplica:
             pending = list(self._pending.values())
             self._pending.clear()
             self._n_inflight = 0
+        # post-mortem harvest FIRST, then release the segment — the
+        # flight stays cached on the handle for the topology verdict
+        self.harvest_flight()
+        self._release_annex()
         self._stop_channel()
         try:
             if getattr(self, "_sock", None) is not None:
@@ -369,6 +426,9 @@ class ProcessReplica:
                 self._inst["bytes_in"].inc(len(raw))
                 msg = pickle.loads(raw)
                 op = msg.get("op")
+                if _spans.active() and msg.get("t_ns"):
+                    _spans.record_span("hop.transport_resp",
+                                       msg["t_ns"], op=op)
                 with self._idlock:
                     entry = self._pending.get(msg.get("id"))
                 if entry is None:
@@ -379,6 +439,8 @@ class ProcessReplica:
                     self._pop(entry["id"])
                     entry["accept"].set_exception(self._reject_exc(msg))
                 elif op == "result":
+                    t_recv = (time.perf_counter_ns()
+                              if _spans.active() else 0)
                     self._pop(entry["id"])
                     if not entry["accept"].done():
                         entry["accept"].set_result(None)
@@ -388,6 +450,9 @@ class ProcessReplica:
                         entry["future"].set_exception(
                             self._unpickle_exc(msg)
                         )
+                    if t_recv:
+                        _spans.record_span("hop.complete", t_recv,
+                                           req=entry["id"])
         except Exception as exc:  # noqa: BLE001 — EOF/OSError: child died
             self._mark_dead(
                 f"replica {self.replica_id} process died "
@@ -519,9 +584,22 @@ class ProcessReplica:
                     f"replica process is dead: {exc}") from exc
             return entry["future"]
         entry = self._register("submit")
+        msg = {"op": "submit", "id": entry["id"], "month": month, "x": x}
+        if _spans.active():
+            # socket-mode parity with the shm frame header stamps: send
+            # time + the submitting span's identity ride the dict
+            cur = _spans.current_span()
+            msg["t_ns"] = time.perf_counter_ns()
+            if cur is not None:
+                msg["trace"] = (cur.trace_id, cur.span_id)
         try:
-            self._send({"op": "submit", "id": entry["id"],
-                        "month": month, "x": x})
+            self._send(msg)
+            if msg.get("t_ns") and _spans.active():
+                # hop.coalesce, socket flavor: message built → bytes on
+                # the wire (pickle + the write-lock wait) — the same
+                # enqueue→transport-handoff seam the shm strip measures
+                _spans.record_span("hop.coalesce", msg["t_ns"],
+                                   req=entry["id"])
             entry["accept"].result(timeout=self._call_timeout_s)
         except ReplicaDeadError as exc:
             self._pop(entry["id"])
@@ -547,6 +625,15 @@ class ProcessReplica:
 
     def stats(self) -> dict:
         out = dict(self._call("stats"))
+        # the stats heartbeat doubles as the metric-aggregation wire:
+        # the child attaches its delta-encoded registry snapshot and the
+        # fleet's aggregator (metrics_sink) folds it under {proc=rid}
+        delta = out.pop("metrics_delta", None)
+        if delta and self.metrics_sink is not None:
+            try:
+                self.metrics_sink(self.replica_id, delta)
+            except Exception:  # noqa: BLE001 — stats must stay a probe
+                pass
         out["proc_pid"] = self.pid
         out["proc_inflight"] = self.inflight
         out["transport"] = self.transport

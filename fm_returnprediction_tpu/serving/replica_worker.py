@@ -22,6 +22,7 @@ import pickle
 import socket
 import sys
 import threading
+import time
 from dataclasses import asdict
 
 
@@ -31,6 +32,8 @@ def _serve(cfg: dict) -> None:
         send_frame,
     )
     from fm_returnprediction_tpu.resilience.faults import fault_site
+    from fm_returnprediction_tpu.telemetry import distributed as _obs
+    from fm_returnprediction_tpu.telemetry import spans as _spans
 
     rid = cfg["rid"]
     sock = socket.create_connection(("127.0.0.1", int(cfg["port"])),
@@ -75,8 +78,30 @@ def _serve(cfg: dict) -> None:
     except Exception as exc:  # noqa: BLE001 — the parent needs the why
         send({"op": "hello", "ok": False, "error": repr(exc)[:500]})
         raise
+    # anchor_ns is this process's perf_counter→epoch offset: the
+    # monotonic-offset exchange that lets the router's timeline merge
+    # align every child's spans onto one clock exactly
     send({"op": "hello", "ok": True, "rid": rid, "pid": os.getpid(),
-          "warm": warm})
+          "warm": warm, "anchor_ns": _spans.EPOCH_ANCHOR_NS})
+
+    # flight annex: attach the parent-owned shm mailbox and mirror the
+    # flight tail at every seam — the tail survives SIGKILL there
+    annex = None
+    if cfg.get("annex"):
+        try:
+            annex = _obs.FlightAnnex.attach(cfg["annex"])
+        except Exception:  # noqa: BLE001 — observability must not kill
+            annex = None
+
+    def mirror(reason: str) -> None:
+        if annex is None:
+            return
+        try:
+            annex.mirror_flight(reason)
+        except Exception:  # noqa: BLE001 — mirrors are best-effort
+            pass
+
+    mirror("hello")
 
     # shm data plane (FMRP_FLEET_TRANSPORT=shm): submits/results ride
     # the rings the parent created; this socket keeps the control verbs
@@ -103,23 +128,35 @@ def _serve(cfg: dict) -> None:
 
     prepared = {}  # one slot: the fleet serializes rollovers
 
-    def on_done(req_id: int, inner) -> None:
+    def on_done(req_id: int, inner, t_recv: int = 0) -> None:
         exc = inner.exception()
+        if t_recv:
+            _spans.record_span("hop.solve", t_recv, req=req_id)
+        t_send = time.perf_counter_ns() if _spans.active() else 0
         if exc is None:
             # socket-transport seam site: a SIGKILL here dies with the
             # result computed but never sent — the parent's requeue +
             # journal replay must stay clean (the socket twin of the shm
             # path's shm.ring.commit)
             fault_site("replica.result_send")
-            send({"op": "result", "id": req_id, "ok": True,
-                  "value": float(inner.result())})
+            msg = {"op": "result", "id": req_id, "ok": True,
+                   "value": float(inner.result())}
+            if t_send:
+                msg["t_ns"] = t_send
+            send(msg)
         else:
             try:
                 blob = pickle.dumps(exc)
             except Exception:  # noqa: BLE001 — unpicklable: repr travels
                 blob = None
-            send({"op": "result", "id": req_id, "ok": False,
-                  "exc": blob, "error": repr(exc)[:300]})
+            msg = {"op": "result", "id": req_id, "ok": False,
+                   "exc": blob, "error": repr(exc)[:300]}
+            if t_send:
+                msg["t_ns"] = t_send
+            send(msg)
+        if t_send:
+            _spans.record_span("hop.result_send", t_send, req=req_id)
+        mirror("result")
 
     while True:
         try:
@@ -136,6 +173,10 @@ def _serve(cfg: dict) -> None:
                 QueueFullError,
             )
 
+            t_recv = time.perf_counter_ns() if _spans.active() else 0
+            if t_recv and msg.get("t_ns"):
+                _spans.record_span("hop.transport_req", msg["t_ns"],
+                                   t_recv, req=req_id)
             try:
                 inner = service.submit(msg["month"], msg["x"])
             except QueueFullError as qe:
@@ -157,12 +198,17 @@ def _serve(cfg: dict) -> None:
                 continue
             send({"op": "accept", "id": req_id})
             inner.add_done_callback(
-                lambda fut, i=req_id: on_done(i, fut)
+                lambda fut, i=req_id, t0=t_recv: on_done(i, fut, t0)
             )
             continue
         try:
             if op == "stats":
                 value = service.stats()
+                if _obs.metrics_enabled():
+                    # the heartbeat doubles as the metric-aggregation
+                    # wire: ship the registry series that changed
+                    value = dict(value)
+                    value["metrics_delta"] = _obs.registry_delta()
             elif op == "drain":
                 value = service.batcher.drain()
             elif op == "prepare":
@@ -186,6 +232,7 @@ def _serve(cfg: dict) -> None:
             else:
                 raise ValueError(f"unknown verb {op!r}")
             send({"op": "result", "id": req_id, "ok": True, "value": value})
+            mirror(f"verb:{op}")
         except Exception as exc:  # noqa: BLE001 — verbs fail loudly
             try:
                 blob = pickle.dumps(exc)
@@ -193,6 +240,7 @@ def _serve(cfg: dict) -> None:
                 blob = None
             send({"op": "result", "id": req_id, "ok": False,
                   "exc": blob, "error": repr(exc)[:300]})
+            mirror(f"verb:{op}:error")
     if shm_stop is not None:
         shm_stop.set()
         for ring in shm_rings:
@@ -209,8 +257,14 @@ def main() -> None:
     from fm_returnprediction_tpu.resilience.faults import (
         install_plan_from_env,
     )
+    from fm_returnprediction_tpu.telemetry.distributed import (
+        install_remote_context_from_env,
+    )
 
     install_plan_from_env()
+    # remote trace context second: every root span this process opens
+    # carries the router's spawning span as remote_trace/remote_parent
+    install_remote_context_from_env()
     with open(sys.argv[1], "rb") as fh:
         cfg = pickle.load(fh)
     _serve(cfg)

@@ -35,7 +35,10 @@ drain, prepare/commit, close) stays on the socket it already has:
   never commits while the journal's recovery path closes the admitted
   request out — the exactly-once proof holds unchanged on this path.
 
-Frame grammar (all little-endian, one frame per ring slot):
+Frame grammar (all little-endian, one frame per ring slot; every
+header additionally carries the distributed-observability stamps
+``u64 t_send_ns · u64 trace_id · u64 parent_span`` — zeros when
+telemetry is unarmed, read via :func:`frame_meta`):
 
 ==========  =================================================================
 ``SUBMIT``  u32 kind=1 · u32 count · u64 tail_len · ids u64[c] ·
@@ -62,6 +65,7 @@ import os
 import pickle
 import struct
 import threading
+import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -70,10 +74,12 @@ import numpy as np
 from fm_returnprediction_tpu.parallel.shm import RingFullError, ShmRing
 from fm_returnprediction_tpu.resilience.errors import ServiceOverloadError
 from fm_returnprediction_tpu.resilience.faults import fault_site
+from fm_returnprediction_tpu.telemetry import spans as _spans
 
 __all__ = [
     "FLEET_TRANSPORTS",
     "ShmReplicaChannel",
+    "frame_meta",
     "open_doorbells",
     "pack_ack",
     "pack_results",
@@ -87,7 +93,36 @@ __all__ = [
 FLEET_TRANSPORTS = ("shm", "socket")
 
 KIND_SUBMIT, KIND_ACK, KIND_RESULT = 1, 2, 3
-_FRAME_HDR = struct.Struct("<IIQ")  # kind, count, tail_len
+#: kind, count, tail_len, t_send_ns, trace_id, parent_span — the last
+#: three are the distributed-observability stamps: the sender's
+#: monotonic send time (CLOCK_MONOTONIC is box-wide, so the receiver
+#: reads transport latency directly) and the sending span's identity so
+#: child work parents onto the router's request trace. All three are
+#: zero when telemetry is unarmed — the observability plane costs one
+#: constant-fold struct pack on the unarmed hot path.
+_FRAME_HDR = struct.Struct("<IIQQQQ")
+
+
+def _hdr(kind: int, count: int, tail_len: int) -> bytes:
+    if _spans.active():
+        cur = _spans.current_span()
+        return _FRAME_HDR.pack(
+            kind, count, tail_len, time.perf_counter_ns(),
+            cur.trace_id if cur is not None else 0,
+            cur.span_id if cur is not None else 0,
+        )
+    return _FRAME_HDR.pack(kind, count, tail_len, 0, 0, 0)
+
+
+def frame_meta(frame: bytes) -> dict:
+    """The header's observability stamps (all zero on frames packed
+    while telemetry was unarmed). ``unpack_frame`` deliberately does
+    NOT return these — decoding rows and reading stamps are different
+    consumers."""
+    kind, count, _, t_send_ns, trace_id, parent_span = \
+        _FRAME_HDR.unpack_from(frame, 0)
+    return {"kind": kind, "count": count, "t_send_ns": t_send_ns,
+            "trace_id": trace_id, "parent_span": parent_span}
 
 # row dtype codes (dcodes column)
 _DT_F32, _DT_F64, _DT_PICKLED = 0, 1, 2
@@ -161,7 +196,7 @@ def pack_submit(rows: Sequence[Tuple[int, object, object]]) -> bytes:
             code = _DT_F32 if dt == _F32 else _DT_F64
             body = struct.pack("<QqIB", rid, int(month), x.shape[0],
                                code) + x.tobytes()
-            return _FRAME_HDR.pack(KIND_SUBMIT, 1, 0) + body
+            return _hdr(KIND_SUBMIT, 1, 0) + body
     ids = np.fromiter((r[0] for r in rows), np.uint64, c)
     # the i64 column is for REAL ints only — np.fromiter would silently
     # truncate a float month (7.5 → 7: a wrong-month quote where the
@@ -203,7 +238,7 @@ def pack_submit(rows: Sequence[Tuple[int, object, object]]) -> bytes:
         ids.tobytes(), months.tobytes(), widths.tobytes(), dcodes.tobytes(),
         *payload, tail,
     ))
-    return _FRAME_HDR.pack(KIND_SUBMIT, c, len(tail)) + body
+    return _hdr(KIND_SUBMIT, c, len(tail)) + body
 
 
 def pack_ack(ids: Sequence[int], statuses: Sequence[int],
@@ -215,7 +250,7 @@ def pack_ack(ids: Sequence[int], statuses: Sequence[int],
     ids_a = np.asarray(ids, np.uint64)
     st = np.asarray(statuses, np.uint8)
     tail = pickle.dumps(evidence) if evidence else b""
-    return (_FRAME_HDR.pack(KIND_ACK, c, len(tail))
+    return (_hdr(KIND_ACK, c, len(tail))
             + ids_a.tobytes() + st.tobytes() + tail)
 
 
@@ -262,12 +297,14 @@ def pack_results(entries: Sequence[Tuple[int, bool, object]]) -> bytes:
         ms.tobytes(), errs.tobytes(), routes.tobytes(), precs.tobytes(),
         tail,
     ))
-    return _FRAME_HDR.pack(KIND_RESULT, c, len(tail)) + body
+    return _hdr(KIND_RESULT, c, len(tail)) + body
 
 
 def unpack_frame(frame: bytes):
-    """→ ``(kind, rows)``; rows decode per the frame grammar above."""
-    kind, c, tail_len = _FRAME_HDR.unpack_from(frame, 0)
+    """→ ``(kind, rows)``; rows decode per the frame grammar above.
+    The header's observability stamps are skipped — ``frame_meta``
+    reads those."""
+    kind, c, tail_len = _FRAME_HDR.unpack_from(frame, 0)[:3]
     off = _FRAME_HDR.size
     tail = pickle.loads(frame[len(frame) - tail_len:]) if tail_len else None
     if kind == KIND_SUBMIT:
@@ -483,11 +520,16 @@ class ShmReplicaChannel:
                      if fd is not None)
 
     def submit_row(self, req_id: int, month, x) -> None:
+        # hop.coalesce: row enqueued → its frame on the ring (the
+        # combining wait plus the ring write, measured per row)
+        t0 = time.perf_counter_ns() if _spans.active() else 0
         with self._plock:
             if self._stop:
                 raise RuntimeError("shm channel is stopped")
             self._pending.append((req_id, month, x))
         self._flush()
+        if t0:
+            _spans.record_span("hop.coalesce", t0, req=req_id)
 
     def _take_batch(self) -> List[Tuple[int, object, object]]:
         """Drain pending rows into one frame-sized batch, bounded by the
@@ -563,12 +605,23 @@ class ShmReplicaChannel:
                 frame = self.resp_ring.recv(timeout_s=0.2)
                 if frame is None:
                     continue
+                t_recv = time.perf_counter_ns() if _spans.active() else 0
+                if t_recv:
+                    meta = frame_meta(frame)
+                    _spans.record_span("hop.transport_resp",
+                                       meta["t_send_ns"], t_recv,
+                                       rows=meta["count"])
                 kind, rows = unpack_frame(frame)
                 if kind == KIND_ACK:
                     for rid, status, evidence in rows:
                         self._on_ack(rid, status, evidence)
                 elif kind == KIND_RESULT:
                     self._on_results(rows)
+                    if t_recv:
+                        # hop.complete: result frame received → futures
+                        # resolved (callbacks run inline above)
+                        _spans.record_span("hop.complete", t_recv,
+                                           rows=len(rows))
         except Exception as exc:  # noqa: BLE001 — a dead plane fails all
             if not self._stop:
                 self._on_dead(f"shm data plane failed: {exc!r}")
@@ -634,10 +687,11 @@ class _ResultCoalescer:
         self._thread.start()
 
     def push(self, req_id: int, ok: bool, value) -> None:
+        t_push = time.perf_counter_ns() if _spans.active() else 0
         with self._cv:
             if self._stop:
                 return
-            self._pending.append((req_id, ok, value))
+            self._pending.append((req_id, ok, value, t_push))
             self._cv.notify()
 
     def _loop(self) -> None:
@@ -647,13 +701,20 @@ class _ResultCoalescer:
                     self._cv.wait(timeout=0.5)
                 if self._stop and not self._pending:
                     return
-                batch = self._pending[:self._max_rows]
+                taken = self._pending[:self._max_rows]
                 del self._pending[:self._max_rows]
+            batch = [(rid, ok, val) for rid, ok, val, _ in taken]
+            t_first = min((t for *_, t in taken if t), default=0)
             stopping = lambda: self._stop  # noqa: E731
             attempt_s = min(1.0, self._send_timeout_s)
             try:
                 _send_until_stopped(self._ring, pack_results(batch),
                                     stopping, attempt_s)
+                if t_first:
+                    # hop.result_send: first completion in the batch →
+                    # its result frame committed on the ring
+                    _spans.record_span("hop.result_send", t_first,
+                                       rows=len(batch))
             except ValueError:
                 # over-capacity frame (a batch of failures whose pickled
                 # tails add up): HALVE and retry, never drop a healthy
@@ -726,6 +787,7 @@ def serve_data_plane(service, req_ring: ShmRing, resp_ring: ShmRing,
                 if nxt is None:
                     break
                 frames.append(nxt)
+            t_recv = time.perf_counter_ns() if _spans.active() else 0
             rows: List[Tuple[int, object, object]] = []
             for fr in frames:
                 # PER-FRAME isolation: one undecodable frame (a pickle
@@ -737,6 +799,15 @@ def serve_data_plane(service, req_ring: ShmRing, resp_ring: ShmRing,
                 except Exception:  # noqa: BLE001 — skip the bad frame
                     continue
                 if kind == KIND_SUBMIT:
+                    if t_recv:
+                        # hop.transport_req: router's send stamp → this
+                        # child decoded the frame (ring wait + wire)
+                        meta = frame_meta(fr)
+                        _spans.record_span(
+                            "hop.transport_req", meta["t_send_ns"],
+                            t_recv, rows=meta["count"],
+                            trace=meta["trace_id"],
+                        )
                     rows.extend(frame_rows)
             if not rows:
                 continue
@@ -748,15 +819,21 @@ def serve_data_plane(service, req_ring: ShmRing, resp_ring: ShmRing,
                 # failure must reach the callers as per-row errors, not
                 # kill the serve thread and blackhole the replica
                 outs = [("err", exc)] * len(rows)
+
+            def _done(fut, rid, t0):
+                ok = fut.exception() is None
+                if t0:
+                    # hop.solve: rows decoded → this future resolved
+                    # (batcher queue wait + the solve itself)
+                    _spans.record_span("hop.solve", t0, req=rid)
+                results.push(rid, ok,
+                             fut.result() if ok else fut.exception())
+
             rej_ids, rej_statuses, evidence = [], [], {}
             for (rid, _, _), (ok, val) in zip(rows, outs):
                 if ok == "ok":
                     val.add_done_callback(
-                        lambda fut, i=rid: results.push(
-                            i, fut.exception() is None,
-                            fut.result() if fut.exception() is None
-                            else fut.exception(),
-                        )
+                        lambda fut, i=rid, t0=t_recv: _done(fut, i, t0)
                     )
                     continue
                 if isinstance(val, QueueFullError):

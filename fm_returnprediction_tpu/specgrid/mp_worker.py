@@ -25,6 +25,14 @@ if __name__ == "__main__":
     )
 
     install_plan_from_env()
+    # remote trace context (FMRP_TRACE_REMOTE, also via worker_env): root
+    # spans opened here carry the parent's spawning span as
+    # remote_trace/remote_parent, so merged timelines stay one tree
+    from fm_returnprediction_tpu.telemetry.distributed import (
+        install_remote_context_from_env,
+    )
+
+    install_remote_context_from_env()
     from fm_returnprediction_tpu.specgrid.multiproc import worker_main
 
     worker_main(sys.argv[1])

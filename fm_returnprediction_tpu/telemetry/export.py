@@ -37,6 +37,7 @@ from fm_returnprediction_tpu.telemetry import spans as _spans
 
 __all__ = [
     "flat_metrics",
+    "build_info",
     "span_record",
     "event_record",
     "program_record",
@@ -48,10 +49,30 @@ __all__ = [
     "serve_metrics_http",
     "JSONL_NAME",
     "CHROME_TRACE_NAME",
+    "jsonl_name",
+    "chrome_trace_name",
 ]
 
 JSONL_NAME = "events.jsonl"
 CHROME_TRACE_NAME = "trace.json"
+
+
+def _proc_tag() -> str:
+    from fm_returnprediction_tpu.telemetry import identity as _identity
+
+    k = _identity.process_index()
+    return "" if k is None else f".p{k}"
+
+
+def jsonl_name() -> str:
+    """``events.jsonl`` — or ``events.p{K}.jsonl`` under a multi-process
+    identity, so N children sharing one ``FMRP_TRACE_DIR`` never
+    overwrite each other's export (the timeline merge globs both)."""
+    return f"events{_proc_tag()}.jsonl"
+
+
+def chrome_trace_name() -> str:
+    return f"trace{_proc_tag()}.json"
 
 
 def _ts_us(t_ns: int) -> float:
@@ -62,13 +83,59 @@ def _ts_us(t_ns: int) -> float:
 def flat_metrics() -> dict:
     """The registry snapshot as one flat ``name{k=v,...} → value`` dict —
     the shared shape of the JSONL ``metrics`` line and the flight
-    recorder's ``metrics`` field."""
+    recorder's ``metrics`` field. The whole flatten happens under
+    ``metrics.SNAPSHOT_LOCK`` (shared with the fleet aggregator's fold)
+    so a concurrent child delta can never render torn totals."""
     out = {}
-    for name, series in _metrics.registry().collect().items():
-        for key, value in sorted(series.items()):
-            label = ",".join(f"{k}={v}" for k, v in key)
-            out[f"{name}{{{label}}}" if label else name] = value
+    with _metrics.SNAPSHOT_LOCK:
+        for name, series in _metrics.registry().collect().items():
+            for key, value in sorted(series.items()):
+                label = ",".join(f"{k}={v}" for k, v in key)
+                out[f"{name}{{{label}}}" if label else name] = value
     return out
+
+
+_BUILD_INFO: Optional[dict] = None
+
+
+def build_info() -> dict:
+    """Label set for the ``fmrp_build_info`` info-gauge: jax/jaxlib
+    versions, backend, x64 flag, and a short sha of the package
+    ``__init__.py`` (code salt) — enough to attribute a scrape from a
+    mixed fleet to an exact environment. Computed once per process; the
+    backend label is read from env so rendering a scrape never
+    initializes a JAX backend."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        try:
+            import jax
+
+            jax_version = str(getattr(jax, "__version__", "unknown"))
+            x64 = "1" if jax.config.jax_enable_x64 else "0"
+        except Exception:  # pragma: no cover - jax always present in-repo
+            jax_version = "unavailable"
+            x64 = os.environ.get("JAX_ENABLE_X64", "0") or "0"
+        try:
+            import jaxlib
+
+            jaxlib_version = str(getattr(jaxlib, "__version__", "unknown"))
+        except Exception:  # pragma: no cover
+            jaxlib_version = "unavailable"
+        import hashlib
+
+        try:
+            pkg_init = Path(__file__).resolve().parents[1] / "__init__.py"
+            salt = hashlib.sha256(pkg_init.read_bytes()).hexdigest()[:8]
+        except OSError:  # pragma: no cover - package always readable
+            salt = "unknown"
+        _BUILD_INFO = {
+            "jax": jax_version,
+            "jaxlib": jaxlib_version,
+            "backend": os.environ.get("JAX_PLATFORMS", "") or "default",
+            "x64": x64,
+            "code_salt": salt,
+        }
+    return _BUILD_INFO
 
 
 def _clean(attrs: dict) -> dict:
@@ -156,6 +223,9 @@ def write_jsonl(path, include_metrics: bool = True) -> Path:
         "spans": stats["spans"],
         "events": stats["events"],
         "dropped": stats["dropped"],
+        # this process's perf_counter→epoch anchor: the timeline merge
+        # re-anchors every process's raw stamps onto ONE anchor with it
+        "anchor_ns": _spans.EPOCH_ANCHOR_NS,
     }
     # per-process identity (multi-process runs): merged jsonl files stay
     # attributable; absent when unarmed, keeping exports byte-identical
@@ -334,9 +404,18 @@ def _program_trace_events(pid: int) -> List[dict]:
 def write_chrome_trace(path) -> Path:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    from fm_returnprediction_tpu.telemetry import identity as _identity
+
     doc = {
         "traceEvents": chrome_trace_events(),
         "displayTimeUnit": "ms",
+        # Perfetto ignores otherData; the timeline merge reads it to
+        # re-anchor this process's stamps onto the router's clock
+        "otherData": {
+            "anchor_ns": _spans.EPOCH_ANCHOR_NS,
+            "pid": os.getpid(),
+            "process_index": _identity.process_index(),
+        },
     }
     tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
     tmp.write_text(json.dumps(doc, sort_keys=True))
@@ -348,8 +427,8 @@ def export_all(trace_dir) -> tuple:
     """Write ``events.jsonl`` + ``trace.json`` into ``trace_dir``; returns
     the two paths. Idempotent: whole-file rewrites from the collector."""
     trace_dir = Path(trace_dir)
-    jsonl = write_jsonl(trace_dir / JSONL_NAME)
-    chrome = write_chrome_trace(trace_dir / CHROME_TRACE_NAME)
+    jsonl = write_jsonl(trace_dir / jsonl_name())
+    chrome = write_chrome_trace(trace_dir / chrome_trace_name())
     return jsonl, chrome
 
 
@@ -389,20 +468,34 @@ def serve_metrics_http(render, port: int = 0, host: str = "127.0.0.1",
 
 def prometheus_text(extra: Optional[dict] = None,
                     extra_prefix: str = "") -> str:
-    """The registry in Prometheus text format, optionally followed by
-    ``extra`` numeric gauges (an ``ERService`` renders its ``stats()``
-    dict through this — bools as 0/1, non-numerics skipped)."""
-    text = _metrics.registry().to_prometheus()
-    if not extra:
-        return text
-    lines = [text.rstrip("\n")]
-    for key in sorted(extra):
-        value = extra[key]
-        if isinstance(value, bool):
-            value = int(value)
-        if not isinstance(value, (int, float)) or value != value:
-            continue  # skip None/lists/NaN
-        name = _metrics.sanitize(f"{extra_prefix}{key}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {value}")
-    return "\n".join(lines) + "\n"
+    """The registry in Prometheus text format, followed by an
+    ``fmrp_build_info`` info-gauge and optionally ``extra`` numeric
+    gauges (an ``ERService`` renders its ``stats()`` dict through this —
+    bools as 0/1, non-numerics skipped). The whole exposition renders
+    under ``metrics.SNAPSHOT_LOCK`` so a scrape concurrent with a child
+    delta ingest never shows torn fleet totals."""
+    with _metrics.SNAPSHOT_LOCK:
+        text = _metrics.registry().to_prometheus()
+        lines = [text.rstrip("\n")] if text.strip() else []
+        info = build_info()
+        labels = ",".join(
+            f'{k}="{_metrics.escape_label_value(v)}"'
+            for k, v in sorted(info.items())
+        )
+        lines.append(
+            "# HELP fmrp_build_info Build/environment identity"
+            " (constant 1)."
+        )
+        lines.append("# TYPE fmrp_build_info gauge")
+        lines.append(f"fmrp_build_info{{{labels}}} 1")
+        if extra:
+            for key in sorted(extra):
+                value = extra[key]
+                if isinstance(value, bool):
+                    value = int(value)
+                if not isinstance(value, (int, float)) or value != value:
+                    continue  # skip None/lists/NaN
+                name = _metrics.sanitize(f"{extra_prefix}{key}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"

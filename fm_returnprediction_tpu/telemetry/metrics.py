@@ -58,7 +58,19 @@ __all__ = [
     "jax_cache_stats",
     "record_trace",
     "DEFAULT_LATENCY_BUCKETS",
+    "SNAPSHOT_LOCK",
 ]
+
+# The fleet-wide snapshot lock (re-entrant): every REGISTRY-WIDE read
+# that will be rendered to a consumer — ``to_prometheus``, the exporters'
+# ``flat_metrics``, the flight recorder — and every fold/ingest the
+# distributed aggregator performs (``telemetry.distributed``) serializes
+# here, so a scrape can never interleave with a child metric delta or a
+# dead-replica fold and render torn fleet totals. It lives HERE (not in
+# ``distributed``) so ``metrics``/``export`` need no import of the
+# distributed plane; single increments never take it — only whole-registry
+# snapshots and aggregator mutations do.
+SNAPSHOT_LOCK = threading.RLock()
 
 # seconds — tuned for host-side serving latencies (sub-ms to tens of s)
 DEFAULT_LATENCY_BUCKETS = (
@@ -367,7 +379,15 @@ class MetricsRegistry:
         return total
 
     def collect(self) -> Dict[str, Dict[LabelKey, object]]:
-        """name → {labelkey → value} for every family."""
+        """name → {labelkey → value} for every family.
+
+        Held under :data:`SNAPSHOT_LOCK` for the whole walk: the
+        distributed aggregator's ingest/fold mutations serialize on the
+        same lock, so one collect is one consistent cut of the fleet."""
+        with SNAPSHOT_LOCK:
+            return self._collect_locked()
+
+    def _collect_locked(self) -> Dict[str, Dict[LabelKey, object]]:
         with self._lock:
             fams = {
                 name: (fam, list(fam.series.items()))

@@ -79,13 +79,23 @@ class BenchRound:
     # ``*_shape`` string leaves ("kernel_shape": "T720_N6000_B10000"):
     # the section-size disclosures every bench section publishes
     device: Optional[str] = None  # the round's ``extra.device`` platform
+    # deliberately-disabled sections: ``{"<section>": {"disabled": why}}``
+    # in the round meta — disclosed by the sentinel, never gated (the
+    # r08/r09 noise-flappers were silently omitted; silence reads as
+    # "covered", an explicit object reads as what it is)
+    disabled: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float],
-             shapes: Optional[Dict[str, str]] = None) -> None:
+             shapes: Optional[Dict[str, str]] = None,
+             disabled: Optional[Dict[str, str]] = None) -> None:
     if isinstance(obj, dict):
+        why = obj.get("disabled")
+        if isinstance(why, str) and disabled is not None and prefix:
+            disabled[prefix] = why
         for k, v in obj.items():
-            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out, shapes)
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out, shapes,
+                     disabled)
     elif isinstance(obj, bool):
         return  # bools are flags, not measurements
     elif isinstance(obj, (int, float)) and math.isfinite(obj):
@@ -114,7 +124,8 @@ def load_round(path) -> Optional[BenchRound]:
         n = int(m.group(1)) if m else 10**9
     values: Dict[str, float] = {}
     shapes: Dict[str, str] = {}
-    _flatten("", payload.get("extra") or {}, values, shapes)
+    disabled: Dict[str, str] = {}
+    _flatten("", payload.get("extra") or {}, values, shapes, disabled)
     value = payload.get("value")
     metric = str(payload["metric"])
     if isinstance(value, (int, float)) and not isinstance(value, bool):
@@ -128,6 +139,7 @@ def load_round(path) -> Optional[BenchRound]:
         values=values,
         shapes=shapes,
         device=str(device) if isinstance(device, str) else None,
+        disabled=disabled,
     )
 
 
@@ -230,6 +242,9 @@ class RegressionReport:
     rounds: Tuple[str, ...]
     latest: str
     verdicts: Tuple[MetricVerdict, ...]
+    # latest round's deliberately-disabled sections: (section, why) —
+    # disclosure only; nothing under a disabled section ever gates
+    disabled: Tuple[Tuple[str, str], ...] = ()
 
     def by_status(self, status: str) -> List[MetricVerdict]:
         return [v for v in self.verdicts if v.status == status]
@@ -249,6 +264,7 @@ class RegressionReport:
             "latest": self.latest,
             "ok": self.ok,
             "counts": {s: len(self.by_status(s)) for s in STATUSES},
+            "disabled": {k: v for k, v in self.disabled},
             "verdicts": [v.to_json() for v in self.verdicts],
         }
 
@@ -261,6 +277,10 @@ class RegressionReport:
         lines.append(
             "  " + "  ".join(f"{s}={n}" for s, n in counts.items() if n)
         )
+        for section, why in self.disabled:
+            lines.append(
+                f"  - disabled  {section}: {why} (disclosed, never gated)"
+            )
         show = {"regressed", "improved"} | ({"ok", "new", "missing", "skipped"}
                                             if verbose else set())
         for v in self.verdicts:
@@ -315,6 +335,15 @@ def analyze(
     latest = rounds[-1]
     series = build_series(rounds)
     verdicts: List[MetricVerdict] = []
+
+    def _disabled_why(key: str) -> Optional[str]:
+        bare = key.split("@", 1)[0]
+        for section, why in latest.disabled.items():
+            if bare == section or bare.startswith((f"{section}.",
+                                                   f"{section}_")):
+                return why
+        return None
+
     for key in sorted(series):
         points = series[key]
         history = tuple(points)
@@ -328,6 +357,15 @@ def analyze(
             ))
             continue
         if not in_latest:
+            why = _disabled_why(key)
+            if why is not None:
+                # its section is explicitly disabled in the latest
+                # round: disclosed absence, never a "missing" finding
+                verdicts.append(MetricVerdict(
+                    key, "skipped", None, None, None, dirn, history,
+                    note=f"section disabled: {why}",
+                ))
+                continue
             verdicts.append(MetricVerdict(
                 key, "missing", None,
                 (min(prior) if dirn == "lower" else max(prior)) if prior else None,
@@ -369,6 +407,7 @@ def analyze(
         rounds=tuple(r.label for r in rounds),
         latest=latest.label,
         verdicts=tuple(verdicts),
+        disabled=tuple(sorted(latest.disabled.items())),
     )
 
 

@@ -61,6 +61,7 @@ __all__ = [
     "enabled",
     "span",
     "event",
+    "record_span",
     "capture",
     "attach",
     "device_sync",
@@ -72,6 +73,8 @@ __all__ = [
     "reset",
     "trace_dir",
     "set_trace_dir",
+    "remote_context",
+    "set_remote_context",
     "annotation_factory",
     "set_annotation_factory",
 ]
@@ -103,6 +106,31 @@ _DROPPED = 0
 _CURRENT: ContextVar[Optional["Span"]] = ContextVar(
     "fmrp_current_span", default=None
 )
+
+# Remote trace context (telemetry.distributed): a CHILD process spawned
+# inside a router request records the parent's (trace_id, span_id) here —
+# every ROOT span this process opens then carries ``remote_trace``/
+# ``remote_parent`` attrs, which is how the timeline merge parents child
+# spans onto the router's request span without coordinating span-ID
+# allocation across processes (Chrome X events are keyed by pid/tid/ts;
+# the ids only need to be meaningful as a join key in ``args``).
+_REMOTE_CTX: Optional[tuple] = None  # (trace_id, span_id) of the remote parent
+
+
+def remote_context() -> Optional[tuple]:
+    """The installed remote parent ``(trace_id, span_id)``, or None."""
+    return _REMOTE_CTX
+
+
+def set_remote_context(trace_id: Optional[int],
+                       span_id: Optional[int] = None) -> None:
+    """Install (or clear, with ``None``) the remote parent context — the
+    child-side half of cross-process trace propagation
+    (``telemetry.distributed.install_remote_context_from_env``)."""
+    global _REMOTE_CTX
+    _REMOTE_CTX = None if trace_id is None else (int(trace_id),
+                                                 int(span_id or 0))
+
 
 # When a jax.profiler capture is live (telemetry.perf.profiling), this is
 # jax.profiler.TraceAnnotation: every armed span also annotates the device
@@ -179,6 +207,12 @@ class Span:
         if parent is None:
             self.trace_id = self.span_id
             self.parent_id = None
+            if _REMOTE_CTX is not None:
+                # a root span in a child process parents onto the remote
+                # (router-side) request span by ATTRIBUTE, not by id — see
+                # the _REMOTE_CTX note above
+                attrs = {**attrs, "remote_trace": _REMOTE_CTX[0],
+                         "remote_parent": _REMOTE_CTX[1]}
         else:
             self.trace_id = parent.trace_id
             self.parent_id = parent.span_id
@@ -299,6 +333,24 @@ def event(name: str, cat: str = "event", **attrs) -> None:
             _DROPPED += 1
         else:
             _EVENTS.append(rec)
+
+
+def record_span(name: str, t0_ns: int, t1_ns: Optional[int] = None,
+                cat: str = "hop", **attrs) -> Optional[Span]:
+    """Collect an ALREADY-FINISHED interval from explicit
+    ``perf_counter_ns`` stamps — the distributed hop instrument: a stamp
+    taken when a frame was packed on one side of a process boundary
+    becomes a span when the frame is unpacked on the other (valid because
+    ``perf_counter_ns`` is CLOCK_MONOTONIC, shared across processes on
+    one box). No-op returning None when telemetry is off or the start
+    stamp is unset (0 marks an unstamped frame from an unarmed peer)."""
+    if not _ENABLED or not t0_ns:
+        return None
+    s = Span(name, cat, attrs)
+    s.t0_ns = int(t0_ns)
+    s.t1_ns = int(t1_ns if t1_ns is not None else time.perf_counter_ns())
+    _collect_span(s)
+    return s
 
 
 def current_span() -> Optional[Span]:

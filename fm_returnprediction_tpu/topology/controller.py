@@ -122,6 +122,37 @@ class TopologyController:
     def _mark(self, label: str, **fields) -> None:
         self.fleet._jrnl_mark(label, **fields)
 
+    # -- post-mortem flight harvest ----------------------------------------
+
+    def flight(self, rid: str) -> Optional[dict]:
+        """The flight-recorder tail the member mirrored into its shm
+        annex: the fleet's cached harvest for departed replicas (the
+        kill path harvests through SIGKILL), a live read otherwise.
+        None when the member never mirrored (annex off / thread
+        replica)."""
+        cached = getattr(self.fleet, "flights", {}).get(rid)
+        if cached is not None:
+            return cached
+        rep = self.fleet.replica(rid)
+        harvest = getattr(rep.service, "harvest_flight", None) \
+            if rep is not None else None
+        return harvest() if harvest is not None else None
+
+    def _flight_detail(self, rid: str) -> str:
+        """Compact ``flight=...`` clause for :meth:`members` /
+        ``respawn`` marks — last mirrored reason + last span name, the
+        two facts a post-mortem reader wants before opening the full
+        harvest."""
+        flight = self.flight(rid)
+        if not flight:
+            return ""
+        spans = flight.get("spans") or []
+        last = spans[-1].get("name") if spans else None
+        out = f"flight={flight.get('reason', '?')}"
+        if last:
+            out += f" last_span={last}"
+        return out + f" spans={len(spans)}"
+
     # -- the live inventory ------------------------------------------------
 
     def members(self) -> List[Member]:
@@ -140,11 +171,19 @@ class TopologyController:
                 "replica_thread"
             status = probe.get(rid, state if state != "healthy"
                                else "live")
+            detail = f"transport={getattr(svc, 'transport', 'thread')}"
+            if status in _REPAIRABLE or status == "dead":
+                # the probe verdict carries its post-mortem: the flight
+                # tail harvested from the member's shm annex (survives
+                # SIGKILL — the mirror protocol is commit-last)
+                fl = self._flight_detail(rid)
+                if fl:
+                    detail += f" {fl}"
             rows.append(Member(
                 kind=kind, ident=rid,
                 pid=getattr(svc, "pid", None),
                 status=status,
-                detail=f"transport={getattr(svc, 'transport', 'thread')}",
+                detail=detail,
             ))
         pool = self.pool
         if pool is not None:
@@ -242,7 +281,7 @@ class TopologyController:
             took = time.perf_counter() - t0
             self._ring_marks.pop(rid, None)
             self._mark("respawn", replica=rid, replacement=new_rid,
-                       cause=st)
+                       cause=st, flight=self._flight_detail(rid) or None)
             self._m_respawns.inc()
             self._g_respawn_s.set(took)
             actions.append(f"respawn:{rid}->{new_rid}:{st}")

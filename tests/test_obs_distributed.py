@@ -1,0 +1,584 @@
+"""Distributed observability plane (ISSUE 20).
+
+Evidence in four layers, cheapest first:
+
+- the WIRE: ``trace_env`` round-trips the router's span context into a
+  child env and back into child root spans; the shm/socket frame header
+  carries ``(t_send_ns, trace_id, parent_span)`` stamps when armed and
+  all-zeros when not; ``record_span`` turns cross-process stamp pairs
+  into spans and no-ops on unarmed peers.
+- the AGGREGATOR: child registry deltas fold under ``{proc=}`` labels,
+  a departed proc's monotone series land in ``proc="departed"`` so
+  fleet totals NEVER move backwards across a kill+respawn, and every
+  read/write shares ``SNAPSHOT_LOCK`` — a scrape can never tear.
+- the ANNEX: a double-buffered commit-last shm mailbox whose previous
+  mirror survives a SIGKILL landing exactly between the payload write
+  and the commit flip — 30/30 deterministic chaos rounds on real
+  processes; garbage harvests as absent, never as an exception.
+- the TIMELINE: per-process exports re-anchor onto the router's clock
+  EXACTLY, merge into one Perfetto document deterministically, and the
+  per-hop table attributes e2e latency with a router-side share — plus
+  the regress sentinel's disabled-section disclosure (skipped, never
+  missing, never gated).
+
+The slow tier drives a REAL process fleet on both transports for
+span-propagation parity, and a chaos SIGKILL round for the controller's
+flight-attached verdict + scrape monotonicity.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fm_returnprediction_tpu import telemetry
+from fm_returnprediction_tpu.parallel.shm import shm_available
+from fm_returnprediction_tpu.telemetry import distributed as obs
+from fm_returnprediction_tpu.telemetry import regress
+from fm_returnprediction_tpu.telemetry import spans
+from fm_returnprediction_tpu.telemetry import timeline
+
+pytestmark = pytest.mark.obs
+
+_SHM = pytest.mark.skipif(not shm_available(),
+                          reason="POSIX shared memory unavailable here")
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+    spans.set_remote_context(None)
+    obs.clear_peers()
+    obs.reset_delta_state()
+    yield
+    telemetry.reset()
+    telemetry.set_trace_dir(None)
+    spans.set_remote_context(None)
+    obs.clear_peers()
+    obs.reset_delta_state()
+
+
+# -- trace context propagation ----------------------------------------------
+
+
+def test_trace_env_roundtrips_into_child_root_spans(monkeypatch):
+    monkeypatch.delenv("FMRP_TELEMETRY", raising=False)
+    monkeypatch.delenv("FMRP_TRACE_DIR", raising=False)
+    assert obs.trace_env() == {}  # unarmed spawn ships nothing
+
+    monkeypatch.setenv("FMRP_TELEMETRY", "1")
+    with spans.enabled(True):
+        with telemetry.span("router.spawn") as s:
+            env = obs.trace_env({"OTHER": "kept"})
+        assert env["OTHER"] == "kept"
+        assert env["FMRP_TELEMETRY"] == "1"
+        assert env["FMRP_TRACE_REMOTE"] == f"{s.trace_id}:{s.span_id}"
+
+        # child side: install → every ROOT span carries the remote parent
+        got = obs.install_remote_context_from_env(
+            {"FMRP_TRACE_REMOTE": env["FMRP_TRACE_REMOTE"]}
+        )
+        assert got == (s.trace_id, s.span_id)
+        with telemetry.span("child.root") as root:
+            with telemetry.span("child.nested") as nested:
+                pass
+        assert root.attrs["remote_trace"] == s.trace_id
+        assert root.attrs["remote_parent"] == s.span_id
+        assert "remote_trace" not in nested.attrs  # non-root: real parent
+    # garbage never raises, never installs
+    spans.set_remote_context(None)
+    assert obs.install_remote_context_from_env(
+        {"FMRP_TRACE_REMOTE": "not-a-context"}) is None
+
+
+def test_frame_header_carries_trace_stamps_only_when_armed():
+    from fm_returnprediction_tpu.serving import shm as fshm
+
+    cold = fshm.pack_ack([7], [0])
+    meta = fshm.frame_meta(cold)
+    assert meta["kind"] == fshm.KIND_ACK and meta["count"] == 1
+    assert (meta["t_send_ns"], meta["trace_id"], meta["parent_span"]) \
+        == (0, 0, 0)
+
+    with spans.enabled(True):
+        with telemetry.span("router.request") as s:
+            hot = fshm.pack_ack([7], [0])
+    meta = fshm.frame_meta(hot)
+    assert meta["t_send_ns"] > 0
+    assert meta["trace_id"] == s.trace_id
+    assert meta["parent_span"] == s.span_id
+    # unpack_frame stays a row decoder — stamps are frame_meta's concern
+    assert fshm.unpack_frame(hot)[0] == fshm.KIND_ACK
+
+
+def test_record_span_from_explicit_stamps():
+    assert spans.record_span("hop.x", 123) is None  # unarmed: no-op
+    with spans.enabled(True):
+        assert spans.record_span("hop.x", 0) is None  # unstamped peer
+        s = spans.record_span("hop.transport_req", 1000, 2000, req=7)
+        assert (s.t0_ns, s.t1_ns, s.attrs["req"]) == (1000, 2000, 7)
+    assert [x.name for x in spans.finished_spans()] \
+        == ["hop.transport_req"]
+
+
+def test_peer_registry_records_clock_offsets(tmp_path):
+    entry = obs.register_peer(
+        "r0", pid=123, anchor_ns=spans.EPOCH_ANCHOR_NS + 5000,
+        kind="replica",
+    )
+    assert entry["offset_ns"] == 5000
+    assert obs.peers()["r0"]["pid"] == 123
+    doc = json.loads(obs.dump_peers(tmp_path).read_text())
+    assert doc["router_anchor_ns"] == spans.EPOCH_ANCHOR_NS
+    assert doc["peers"]["r0"]["offset_ns"] == 5000
+
+
+# -- metric aggregation ------------------------------------------------------
+
+
+def test_registry_delta_ships_only_what_moved():
+    c = telemetry.registry().counter("fmrp_obstest_deltas_total")
+    c.inc(3)
+    first = obs.registry_delta()
+    assert first["fmrp_obstest_deltas_total"] == 3
+    assert "fmrp_obstest_deltas_total" not in obs.registry_delta()
+    c.inc(2)
+    assert obs.registry_delta()["fmrp_obstest_deltas_total"] == 5
+
+
+def test_aggregator_totals_monotone_across_kill_and_respawn():
+    agg = obs.MetricAggregator()
+    # bools coerce, NaN drops — ingest reports what it accepted
+    assert agg.ingest("r0", {"fmrp_req_total": 5.0,
+                             "fmrp_queue_depth": 3.0,
+                             "fmrp_up": True,
+                             "bad": float("nan")}) == 3
+    agg.ingest("r1", {"fmrp_req_total": 2.0,
+                      "fmrp_lat_seconds_sum{bucket=b16}": 0.5})
+    assert agg.procs() == ("r0", "r1")
+    snap = agg.snapshot()
+    assert snap["fmrp_req_total{proc=r0}"] == 5.0
+    assert snap["fmrp_lat_seconds_sum{bucket=b16,proc=r1}"] == 0.5
+    before = agg.totals()
+    assert before["fmrp_req_total"] == 7.0
+
+    # r0 dies: monotone series fold into proc=departed, gauges vanish
+    agg.fold_dead("r0")
+    snap = agg.snapshot()
+    assert "fmrp_req_total{proc=r0}" not in snap
+    assert "fmrp_queue_depth{proc=r0}" not in snap  # gauge: not folded
+    assert snap["fmrp_req_total{proc=departed}"] == 5.0
+    assert agg.totals()["fmrp_req_total"] == 7.0  # nothing went backwards
+
+    # the replacement counts up from zero under a NEW label
+    agg.ingest("r2", {"fmrp_req_total": 1.0})
+    after = agg.totals()
+    for key, val in before.items():
+        assert after[key] >= val, (key, val, after[key])
+    assert after["fmrp_req_total"] == 8.0
+    # double fold is idempotent; unknown proc is a no-op
+    agg.fold_dead("r0")
+    agg.fold_dead("never-lived")
+    assert agg.totals()["fmrp_req_total"] == 8.0
+
+    text = agg.prometheus_text()
+    assert 'fmrp_req_total{proc="departed"} 5.0' in text
+    assert 'fmrp_lat_seconds_sum{bucket="b16",proc="r1"} 0.5' in text
+    assert "# TYPE" not in text  # untyped: the router registry declares
+
+
+def test_scrape_and_ingest_serialize_on_the_snapshot_lock():
+    from fm_returnprediction_tpu.telemetry import metrics as _metrics
+
+    agg = obs.MetricAggregator()
+    agg.ingest("r0", {"fmrp_req_total": 1.0})
+    done = threading.Event()
+
+    with _metrics.SNAPSHOT_LOCK:  # a scrape's whole-exposition hold
+        t = threading.Thread(
+            target=lambda: (agg.ingest("r0", {"fmrp_req_total": 2.0}),
+                            done.set()),
+        )
+        t.start()
+        time.sleep(0.1)
+        # the concurrent delta is parked OUTSIDE the scrape's instant...
+        assert not done.is_set()
+        # ...while our own nested reads re-enter (RLock): one lock hold
+        # can render registry + aggregator as one consistent snapshot
+        assert agg.snapshot()["fmrp_req_total{proc=r0}"] == 1.0
+    t.join(timeout=5)
+    assert done.is_set()
+    assert agg.snapshot()["fmrp_req_total{proc=r0}"] == 2.0
+
+
+def test_build_info_gauge_in_exposition():
+    text = telemetry.prometheus_text()
+    (line,) = [l for l in text.splitlines()
+               if l.startswith("fmrp_build_info{")]
+    assert line.endswith(" 1")
+    assert 'jax="' in line and 'backend="' in line
+    assert "# TYPE fmrp_build_info gauge" in text
+
+
+# -- flight annex ------------------------------------------------------------
+
+
+@_SHM
+def test_annex_mirror_harvest_roundtrip():
+    annex = obs.FlightAnnex.create("t-roundtrip", nbytes=2048)
+    try:
+        assert annex.harvest() is None  # nothing committed yet
+        assert annex.mirror({"type": "flight", "n": 1})
+        assert annex.harvest() == {"type": "flight", "n": 1}
+        assert annex.mirror({"type": "flight", "n": 2})  # other slot
+        assert annex.harvest() == {"type": "flight", "n": 2}
+        # an oversized payload is refused; the last mirror stays whole
+        assert not annex.mirror({"blob": "x" * 4096})
+        assert annex.harvest() == {"type": "flight", "n": 2}
+        # mirror_flight sheds weight until the snapshot fits the slot
+        assert annex.mirror_flight("test", max_spans=4)
+        got = annex.harvest()
+        assert got["type"] == "flight" and got["reason"] == "test"
+    finally:
+        annex.release()
+
+
+_ANNEX_CHILD = r"""
+import json, sys
+from fm_returnprediction_tpu.resilience import FaultPlan, FaultSpec
+from fm_returnprediction_tpu.telemetry.distributed import (
+    ANNEX_MIRROR_SITE, FlightAnnex,
+)
+
+spec = json.loads(sys.argv[1])
+annex = FlightAnnex.attach(spec)
+assert annex.mirror({"type": "flight", "round": spec["round"],
+                     "payload": "survivor"})
+# the bomb: SIGKILL exactly between the payload write and the commit
+# flip of the NEXT mirror — the torn write must read as absent
+FaultPlan({ANNEX_MIRROR_SITE: FaultSpec(times=1, sigkill=True)}).__enter__()
+annex.mirror({"type": "flight", "round": spec["round"], "payload": "torn"})
+sys.exit(3)  # unreachable: the site above must have killed us
+"""
+
+
+@_SHM
+@pytest.mark.timeout(300)
+def test_annex_survives_sigkill_midwrite_30x():
+    """30/30: a child SIGKILLed at ``obs.annex_mirror`` — after the new
+    payload bytes are down but BEFORE the active-slot flip — leaves the
+    PREVIOUS mirror harvestable, never a torn one."""
+    for i in range(30):
+        annex = obs.FlightAnnex.create(f"chaos{i}", nbytes=2048)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _ANNEX_CHILD,
+                 json.dumps({**annex.describe(), "round": i})],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert proc.returncode == -signal.SIGKILL, \
+                (i, proc.returncode, proc.stderr)
+            assert annex.harvest() == {
+                "type": "flight", "round": i, "payload": "survivor",
+            }, i
+        finally:
+            annex.release()
+
+
+# -- timeline merge + per-hop attribution ------------------------------------
+
+
+def _write_export(path, anchor_ns, pid, proc_index, span_rows):
+    meta = {"type": "meta", "schema": 1, "pid": pid, "anchor_ns": anchor_ns,
+            "spans": len(span_rows), "events": 0, "dropped": 0}
+    if proc_index is not None:
+        meta["process_index"] = proc_index
+    recs = [meta]
+    for n, (name, ts_us, dur_us) in enumerate(span_rows, start=1):
+        recs.append({"type": "span", "name": name, "cat": "hop",
+                     "ts_us": ts_us, "dur_us": dur_us, "trace_id": 1,
+                     "span_id": n, "parent_id": None, "thread_id": 1,
+                     "thread_name": "main", "attrs": {}})
+    path.write_text("\n".join(json.dumps(r, sort_keys=True) for r in recs)
+                    + "\n")
+
+
+def test_merge_realigns_child_clocks_exactly_and_deterministically(tmp_path):
+    a_router, a_child = 2_000_000_000, 1_500_000_000
+    _write_export(tmp_path / "events.jsonl", a_router, 100, None,
+                  [("fleet.request", 1000.0, 10_000.0),
+                   ("hop.admit", 1000.0, 2_000.0),
+                   ("hop.complete", 9000.0, 1_000.0)])
+    _write_export(tmp_path / "events.p0.jsonl", a_child, 200, 0,
+                  [("hop.solve", 500.0, 5_000.0)])
+
+    path, doc = timeline.merge_traces(tmp_path)
+    assert path == tmp_path / "timeline.json"
+    rows = {e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert rows == {"fmrp-router", "fmrp-child[p0]"}
+    solve = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "hop.solve"]
+    # exact re-anchor: ts + (anchor_router - anchor_child)/1e3
+    assert solve[0]["ts"] == 500.0 + (a_router - a_child) / 1e3
+    admit = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "hop.admit"]
+    assert admit[0]["ts"] == 1000.0  # the router IS the anchor
+
+    first = path.read_bytes()
+    timeline.merge_traces(tmp_path)
+    assert path.read_bytes() == first  # re-merge is byte-identical
+
+
+def test_analyze_attributes_hop_shares_and_router_ceiling(tmp_path):
+    _write_export(tmp_path / "events.jsonl", 0, 100, None,
+                  [("fleet.request", 0.0, 10_000.0),
+                   ("hop.admit", 0.0, 2_000.0),
+                   ("hop.complete", 0.0, 1_000.0)])
+    _write_export(tmp_path / "events.p0.jsonl", 0, 200, 0,
+                  [("hop.solve", 0.0, 5_000.0)])
+    journal = tmp_path / "journal.jsonl"
+    journal.write_text(json.dumps({"ev": "admit", "req": 1, "seq": 1})
+                       + "\n" + json.dumps({"ev": "done", "req": 1,
+                                            "seq": 2}) + "\n")
+
+    report = timeline.analyze(tmp_path, journal_path=journal)
+    assert report["processes"] == 2 and report["requests"] == 1
+    assert report["e2e_p50_ms"] == 10.0
+    assert report["hops"]["hop.solve"]["share_pct"] == 50.0
+    assert report["attributed_pct"] == 80.0
+    assert report["router_share_pct"] == 30.0  # admit + complete
+    assert report["journal"] == {"admit": 1, "done": 1}
+    table = timeline.format_table(report)
+    assert "hop.solve" in table and "router hops 30.0%" in table
+
+    assert timeline.main(["-", str(tmp_path)]) == 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert timeline.main(["-", str(empty)]) == 2  # no e2e coverage
+
+
+# -- journal timestamps (opt-in) ---------------------------------------------
+
+
+def test_journal_t_ns_is_opt_in(tmp_path, monkeypatch):
+    from fm_returnprediction_tpu.serving.journal import RequestJournal
+
+    monkeypatch.delenv("FMRP_OBS_JOURNAL_TS", raising=False)
+    with RequestJournal(tmp_path / "off.jsonl") as j:
+        j.append("admit", 1)
+        j.append("done", 1)
+    recs = [json.loads(l) for l in
+            (tmp_path / "off.jsonl").read_text().splitlines()]
+    assert all("t_ns" not in r for r in recs)  # default: bytes stay
+    # deterministic for the replay/recovery differential tests
+    monkeypatch.setenv("FMRP_OBS_JOURNAL_TS", "1")
+    with RequestJournal(tmp_path / "on.jsonl") as j:
+        j.append("admit", 1)
+    (rec,) = [json.loads(l) for l in
+              (tmp_path / "on.jsonl").read_text().splitlines()]
+    assert isinstance(rec["t_ns"], int) and rec["t_ns"] > 0
+
+
+# -- regress: disabled-section disclosure ------------------------------------
+
+
+def test_regress_disabled_sections_skip_not_missing(tmp_path):
+    why = "FMRP_BENCH_FLEET=0 (deliberately disabled this round)"
+    r1 = {"metric": "wall_s", "value": 10.0,
+          "extra": {"fleet_p50_ms": 1.2, "other_p50_ms": 2.0,
+                    "device": "cpu"}}
+    r2 = {"metric": "wall_s", "value": 10.0,
+          "extra": {"fleet": {"disabled": why}, "device": "cpu"}}
+    p1, p2 = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    p1.write_text(json.dumps({"n": 1, "parsed": r1}))
+    p2.write_text(json.dumps({"n": 2, "parsed": r2}))
+
+    rounds = regress.load_rounds([p1, p2])
+    assert rounds[-1].disabled == {"fleet": why}
+    report = regress.analyze(rounds)
+    # series keys are device-qualified; _disabled_why matches the bare key
+    verdicts = {v.key.split("@", 1)[0]: v for v in report.verdicts}
+    # under the disabled section: disclosed absence, never a finding
+    assert verdicts["fleet_p50_ms"].status == "skipped"
+    assert why in verdicts["fleet_p50_ms"].note
+    # NOT under it: absence is still the "missing" finding it always was
+    assert verdicts["other_p50_ms"].status == "missing"
+    assert dict(report.disabled) == {"fleet": why}
+    assert report.to_json()["disabled"] == {"fleet": why}
+    text = report.format_text()
+    assert why in text and "never gated" in text
+
+
+# -- the real fleet: parity, harvest, monotone scrape (slow tier) ------------
+
+
+def _tiny_state(rng, t=36, n=60, p=4):
+    from fm_returnprediction_tpu.serving import build_serving_state
+
+    x = rng.standard_normal((t, n, p)).astype(np.float32)
+    beta = (rng.standard_normal(p) * 0.05).astype(np.float32)
+    y = (x @ beta + 0.1 * rng.standard_normal((t, n))).astype(np.float32)
+    mask = rng.random((t, n)) > 0.2
+    y = np.where(mask, y, np.nan).astype(np.float32)
+    state = build_serving_state(y, x, mask, window=18, min_periods=9)
+    months = np.nonzero(state.have_coef())[0]
+    return state, months
+
+
+def _await_exports(trace_dir, n, budget_s=20.0):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if len(list(trace_dir.glob("events*.jsonl"))) >= n:
+            return
+        time.sleep(0.1)
+    pytest.fail(f"never saw {n} exports in {trace_dir}: "
+                f"{sorted(p.name for p in trace_dir.glob('*'))}")
+
+
+@pytest.mark.slow
+@_SHM
+@pytest.mark.timeout(420)
+def test_span_propagation_parity_shm_vs_socket(tmp_path, monkeypatch):
+    """Both transports produce the SAME hop chain: router-side hops in
+    the router export, child-side hops in the child exports, child root
+    spans carrying the router's remote context — the span-propagation
+    wire is transport-independent."""
+    from fm_returnprediction_tpu.serving import ServingFleet
+
+    rng = np.random.default_rng(11)
+    state, months = _tiny_state(rng)
+    qx = rng.standard_normal(4).astype(np.float32)
+    seen = {}
+    for transport in ("shm", "socket"):
+        trace_dir = tmp_path / f"trace-{transport}"
+        monkeypatch.setenv("FMRP_TELEMETRY", "1")
+        monkeypatch.setenv("FMRP_TRACE_DIR", str(trace_dir))
+        with telemetry.tracing(str(trace_dir)):
+            # a span open at spawn time is what trace_env forwards as
+            # the children's remote parent context
+            with telemetry.span("fleet.spawn", transport=transport):
+                fleet = ServingFleet(
+                    state, 2, replica_mode="process", transport=transport,
+                    journal=str(tmp_path / f"journal-{transport}.jsonl"),
+                    max_batch=16, max_latency_ms=1.0,
+                )
+            try:
+                futs = [fleet.submit(int(months[0]), qx)
+                        for _ in range(16)]
+                vals = [f.result(timeout=60) for f in futs]
+                assert len(set(vals)) == 1 and np.isfinite(vals[0])
+            finally:
+                fleet.close()
+        _await_exports(trace_dir, 3)  # router + both children flushed
+
+        procs = timeline.load_process_traces(trace_dir)
+        children = [p for p in procs
+                    if p["meta"].get("process_index") is not None]
+        assert len(children) == 2, [p["path"] for p in procs]
+        by_side = {"router": set(), "child": set()}
+        for p in procs:
+            side = "child" if p in children else "router"
+            for r in p["records"]:
+                if r.get("type") == "span":
+                    by_side[side].add(r["name"])
+        wanted = set(timeline.HOPS) | {timeline.E2E_SPAN}
+        seen[transport] = {side: names & wanted
+                          for side, names in by_side.items()}
+        # child roots carry the router's context as remote attrs
+        assert any((r.get("attrs") or {}).get("remote_trace")
+                   for p in children for r in p["records"]
+                   if r.get("type") == "span")
+        report = timeline.analyze(
+            trace_dir,
+            journal_path=tmp_path / f"journal-{transport}.jsonl")
+        assert report["requests"] >= 16
+        assert report["attributed_pct"] > 0
+        telemetry.reset()
+
+    assert seen["shm"] == seen["socket"], seen
+    assert timeline.E2E_SPAN in seen["shm"]["router"]
+    assert "hop.admit" in seen["shm"]["router"]
+    assert "hop.solve" in seen["shm"]["child"]
+
+
+@pytest.mark.slow
+@_SHM
+@pytest.mark.timeout(420)
+def test_chaos_sigkill_flight_harvest_and_monotone_scrape(tmp_path,
+                                                          monkeypatch):
+    """A replica SIGKILLed mid-result-send: its flight annex harvests
+    through the kill, the controller attaches it to the respawn verdict
+    and journal mark, and the fleet's /metrics totals never move
+    backwards across the kill + respawn."""
+    from fm_returnprediction_tpu.resilience import FaultPlan, FaultSpec
+    from fm_returnprediction_tpu.serving import ServingFleet
+    from fm_returnprediction_tpu.topology import (
+        TopologyController,
+        TopologySpec,
+    )
+
+    monkeypatch.setenv("FMRP_OBS_ANNEX", "1")
+    rng = np.random.default_rng(13)
+    state, months = _tiny_state(rng)
+    journal = tmp_path / "journal.jsonl"
+    spec = TopologySpec(replicas=2, replica_mode="process",
+                        transport="shm")
+    # shm results leave through a ring commit, so the SIGKILL site is
+    # the commit seam (the socket flavor would be replica.result_send)
+    with FaultPlan({"shm.ring.commit":
+                    FaultSpec(times=1, sigkill=True, proc="0")}):
+        fleet = ServingFleet(state, 2, replica_mode="process",
+                             transport="shm", journal=str(journal),
+                             registry_dir=str(tmp_path / "registry"),
+                             max_batch=16, max_latency_ms=2.0)
+    ctl = TopologyController(spec, fleet=fleet, ping_timeout_s=1.0)
+    try:
+        # prime the aggregator: a stats probe ships each child's first
+        # (full) registry delta before anything dies
+        for rid in list(fleet.replica_states()):
+            try:
+                fleet.replica(rid).service.stats()
+            except Exception:  # noqa: BLE001 — victim may already be down
+                pass
+        qx = rng.standard_normal(4).astype(np.float32)
+        futs = [fleet.submit(int(months[0]), qx) for _ in range(8)]
+        vals = [f.result(timeout=60) for f in futs]
+        assert len(set(vals)) == 1 and np.isfinite(vals[0])
+
+        dead = [r for r, s in ctl.probe().items() if s != "live"]
+        assert len(dead) == 1, dead
+        victim = dead[0]
+        before = fleet.aggregator.totals()
+        (action,) = ctl.repair()
+        assert action.startswith(f"respawn:{victim}")
+
+        # the flight tail survived the SIGKILL and names its last act
+        flight = ctl.flight(victim)
+        assert flight is not None and flight["type"] == "flight"
+        assert victim in fleet.flights
+        marks = [json.loads(ln) for ln in
+                 journal.read_text().splitlines() if ln.strip()]
+        (respawn,) = [m for m in marks if m.get("ev") == "mark"
+                      and m.get("label") == "respawn"]
+        assert str(respawn.get("flight", "")).startswith("flight=")
+
+        # respawned world ships again; fleet totals stay monotone
+        for rid in list(fleet.replica_states()):
+            fleet.replica(rid).service.stats()
+        after = fleet.aggregator.totals()
+        for key, val in before.items():
+            assert after.get(key, 0.0) >= val - 1e-9, (key, val)
+
+        text = fleet.prometheus_metrics()
+        assert "fmrp_build_info{" in text
+        assert 'proc="departed"' in text  # the fold is IN the scrape
+    finally:
+        ctl.close()
+    assert ctl.sweep() == {"segments": [], "fds": []}
